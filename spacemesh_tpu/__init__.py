@@ -1,0 +1,35 @@
+"""spacemesh_tpu — a TPU-native proof-of-space-time framework.
+
+A brand-new framework with the capabilities of spacemeshos/go-spacemesh
+(reference at /root/reference): layered-mesh blockchain node with Hare and
+Tortoise consensus, randomness beacon, gossip/sync networking, deterministic
+account-template VM, and a POST (proof of space-time) compute plane that runs
+on TPUs via JAX/XLA/Pallas instead of the reference's CGo/OpenCL/RandomX
+native stack.
+
+Package map (mirrors SURVEY.md §2's component inventory):
+
+- ``ops/``        TPU compute kernels: scrypt labeler (SHA-256, Salsa20/8,
+                  ROMix in JAX + Pallas), ChaCha-based proving hash, k2pow,
+                  batch verification primitives.
+- ``models/``     POST pipeline compositions: the labeler (init), prover
+                  (nonce search) and verifier as jittable "models".
+- ``parallel/``   Device-mesh sharding helpers (jax.sharding / shard_map),
+                  multi-identity data-parallel init.
+- ``post/``       The POST worker: disk streaming with resume, the
+                  PostService contract (node <-> worker seam).
+- ``core/``       Primitives: domain types, canonical codec, hashing
+                  (blake3), ed25519 + VRF signing.
+- ``storage/``    SQLite persistence (statesql/localsql split, migrations),
+                  cached DB and in-RAM ATX cache.
+- ``consensus/``  Beacon, Hare, Tortoise, block certifier, malfeasance.
+- ``vm/``         Deterministic account-template VM (wallet, multisig,
+                  vesting, vault).
+- ``txs/``        Conservative state / mempool.
+- ``p2p/``        Gossip + request/response networking, fetch, sync.
+- ``node/``       Composition root: config, presets, clock, events, app.
+- ``api/``        gRPC-style API services and event streams.
+- ``utils/``      Small shared helpers.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,7 @@
+"""TPU compute kernels (JAX/XLA/Pallas) for the POST compute plane.
+
+These replace the reference's native stack (post-rs scrypt labeler + OpenCL
+kernels + RandomX PoW; see SURVEY.md §2.3): everything here is expressed as
+jittable JAX on uint32 lanes so XLA can vectorize across the label/proof
+batch dimension, with Pallas variants for the hot loops.
+"""

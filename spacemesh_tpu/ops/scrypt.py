@@ -1,0 +1,201 @@
+"""scrypt (N, r=1, p=1) as pure JAX — the POST labeling function.
+
+The reference fills 64 GiB Space Units with 16-byte labels computed by the
+post-rs native initializer (CGo/OpenCL; SURVEY.md §2.3, reference
+activation/post.go:355). A label is scrypt of the smesher's commitment over
+the label index. Here the whole pipeline — PBKDF2-HMAC-SHA256 envelope,
+Salsa20/8 core, BlockMix, ROMix with its data-dependent gather — is
+branch-free uint32 JAX, batched across labels (the embarrassingly parallel
+axis: 2^32 labels per Space Unit).
+
+Label definition (bit-exact against `hashlib.scrypt`, which is our CPU
+ground truth in tests):
+
+    label(commitment, i) = scrypt(password=commitment, salt=le64(i),
+                                  N=n, r=1, p=1, dklen=16)
+
+TPU layout note: the batch is the MINOR dimension everywhere — block state
+is (32, B) and the ROMix scratch V is (N, 32, B) — so u32 tiles are fully
+dense ((8,128) tiling pads a trailing dim of 32 by 4x; a trailing dim of
+B%128==0 pads nothing). Every op is then a (B,)-wide VPU lane op and the
+data-dependent V[j] read is a per-lane gather. V costs N*128 bytes per
+in-flight label (1 MiB at mainnet N=8192), so batch size trades HBM for
+throughput; see models/labeler.py.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from .sha256 import byteswap32, hmac_midstates, sha256_compress
+
+LABEL_BYTES = 16  # reference: 16-byte labels, 2^32 per 64 GiB unit
+
+
+def _rotl(x, n: int):
+    return (x << jnp.uint32(n)) | (x >> jnp.uint32(32 - n))
+
+
+def _quarter(x, a: int, b: int, c: int, d: int):
+    x[b] = x[b] ^ _rotl(x[a] + x[d], 7)
+    x[c] = x[c] ^ _rotl(x[b] + x[a], 9)
+    x[d] = x[d] ^ _rotl(x[c] + x[b], 13)
+    x[a] = x[a] ^ _rotl(x[d] + x[c], 18)
+
+
+def salsa20_8(block):
+    """Salsa20/8 core. ``block``: (16, ...) u32 LE words (lanes trailing)."""
+    x = [block[i] for i in range(16)]
+    for _ in range(4):  # 4 double-rounds = 8 rounds
+        _quarter(x, 0, 4, 8, 12)
+        _quarter(x, 5, 9, 13, 1)
+        _quarter(x, 10, 14, 2, 6)
+        _quarter(x, 15, 3, 7, 11)
+        _quarter(x, 0, 1, 2, 3)
+        _quarter(x, 5, 6, 7, 4)
+        _quarter(x, 10, 11, 8, 9)
+        _quarter(x, 15, 12, 13, 14)
+    return jnp.stack([x[i] + block[i] for i in range(16)])
+
+
+def blockmix_r1(x):
+    """scrypt BlockMix for r=1: x is (32, ...) u32 LE, two 64-byte halves."""
+    y0 = salsa20_8(x[0:16] ^ x[16:32])
+    y1 = salsa20_8(x[16:32] ^ y0)
+    return jnp.concatenate([y0, y1])
+
+
+def romix_r1(x, n: int):
+    """scrypt ROMix for r=1 over a (32, B) u32 LE block batch. ``n`` static."""
+    b = x.shape[1]
+    v0 = jnp.zeros((n, 32, b), dtype=jnp.uint32)
+
+    def fill(i, carry):
+        v, xx = carry
+        v = lax.dynamic_update_slice_in_dim(v, xx[None], i, axis=0)
+        return v, blockmix_r1(xx)
+
+    v, x = lax.fori_loop(0, n, fill, (v0, x))
+
+    def mix(_, xx):
+        j = xx[16] % jnp.uint32(n)  # Integerify: first word of B_{2r-1}, per lane
+        vj = jnp.take_along_axis(
+            v, j[None, None, :].astype(jnp.int32), axis=0
+        )[0]
+        return blockmix_r1(xx ^ vj)
+
+    return lax.fori_loop(0, n, mix, x)
+
+
+def _hmac_finish(outer_mid, inner_digest):
+    """Outer HMAC compression over a 32-byte inner digest batch (8, B)."""
+    b = inner_digest.shape[1]
+    tail = np.zeros((8, 1), dtype=np.uint32)
+    tail[0, 0] = 0x80000000
+    tail[7, 0] = (64 + 32) * 8
+    block = jnp.concatenate(
+        [inner_digest, jnp.broadcast_to(jnp.asarray(tail), (8, b))])
+    return sha256_compress(outer_mid, block)
+
+
+def _pbkdf2_first(inner_mid, outer_mid, idx_lo, idx_hi):
+    """PBKDF2(pw, salt=le64(index), c=1, dklen=128) -> (32, B) u32 LE words."""
+    b = idx_lo.shape[0]
+    out = []
+    for i in (1, 2, 3, 4):
+        # message = salt le64(index) || be32(i), then SHA padding to one block
+        tail = np.zeros((14, 1), dtype=np.uint32)
+        tail[0, 0] = i            # be32(block index)
+        tail[1, 0] = 0x80000000   # padding start
+        tail[13, 0] = (64 + 12) * 8
+        block = jnp.concatenate([
+            byteswap32(idx_lo)[None],
+            byteswap32(idx_hi)[None],
+            jnp.broadcast_to(jnp.asarray(tail), (14, b)),
+        ])
+        digest = _hmac_finish(outer_mid, sha256_compress(inner_mid, block))
+        out.append(digest)
+    return byteswap32(jnp.concatenate(out))  # repack BE digests as LE words
+
+
+def _pbkdf2_second(inner_mid, outer_mid, b_le):
+    """PBKDF2(pw, salt=B'||be32(1), c=1) -> 32-byte digests, (8, B) u32 BE."""
+    b = b_le.shape[1]
+    st = sha256_compress(inner_mid, byteswap32(b_le[0:16]))
+    st = sha256_compress(st, byteswap32(b_le[16:32]))
+    tail = np.zeros((16, 1), dtype=np.uint32)
+    tail[0, 0] = 1            # be32(block index 1)
+    tail[1, 0] = 0x80000000   # padding start
+    tail[15, 0] = (64 + 132) * 8
+    st = sha256_compress(st, jnp.broadcast_to(jnp.asarray(tail), (16, b)))
+    return _hmac_finish(outer_mid, st)
+
+
+# The label pipeline is compiled as three programs, not one: XLA:CPU's
+# algebraic simplifier loops forever on the fully fused graph (circular
+# simplification), and ROMix dominates runtime anyway so fusing the PBKDF2
+# envelopes into it buys nothing. Data stays on device between stages.
+
+
+@jax.jit
+def _stage_expand(commitment_words, idx_lo, idx_hi):
+    inner_mid, outer_mid = hmac_midstates(commitment_words)
+    inner_mid = inner_mid[:, None]  # broadcast over lanes
+    outer_mid = outer_mid[:, None]
+    return inner_mid, outer_mid, _pbkdf2_first(inner_mid, outer_mid, idx_lo, idx_hi)
+
+
+_stage_romix = jax.jit(romix_r1, static_argnames=("n",))
+
+
+@jax.jit
+def _stage_finish(inner_mid, outer_mid, blk):
+    return _pbkdf2_second(inner_mid, outer_mid, blk)[:4]
+
+
+def scrypt_labels_jit(commitment_words, idx_lo, idx_hi, *, n: int):
+    """Batch of labels. ``idx_lo/idx_hi``: (B,) u32 halves of label indices.
+
+    Returns (4, B) u32 BE words = B 16-byte labels (batch minor).
+    """
+    inner_mid, outer_mid, blk = _stage_expand(commitment_words, idx_lo, idx_hi)
+    blk = _stage_romix(blk, n=n)
+    return _stage_finish(inner_mid, outer_mid, blk)
+
+
+def commitment_to_words(commitment: bytes) -> np.ndarray:
+    if len(commitment) != 32:
+        raise ValueError("commitment must be 32 bytes")
+    return np.frombuffer(commitment, dtype=">u4").astype(np.uint32)
+
+
+def split_indices(indices: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    indices = np.asarray(indices, dtype=np.uint64)
+    lo = (indices & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+    hi = (indices >> np.uint64(32)).astype(np.uint32)
+    return lo, hi
+
+
+def labels_to_bytes(words) -> bytes:
+    """(4, B) u32 BE word batch -> concatenated 16-byte labels."""
+    return np.asarray(words, dtype=np.uint32).T.astype(">u4").tobytes()
+
+
+def scrypt_labels(commitment: bytes, indices, *, n: int = 8192) -> np.ndarray:
+    """Compute labels for ``indices`` (any u64 array). Returns (B, 16) uint8."""
+    # RFC 7914: for r=1, N must be a power of two and < 2^(128*r/8) = 2^16
+    if n < 2 or n >= 2**16 or (n & (n - 1)) != 0:
+        raise ValueError(f"scrypt n must be a power of 2 in [2, 2^16), got {n}")
+    cw = commitment_to_words(commitment)
+    indices = np.atleast_1d(np.asarray(indices)).ravel()
+    if indices.size == 0:
+        return np.zeros((0, LABEL_BYTES), dtype=np.uint8)
+    lo, hi = split_indices(indices)
+    words = scrypt_labels_jit(jnp.asarray(cw), jnp.asarray(lo), jnp.asarray(hi), n=n)
+    out = np.frombuffer(labels_to_bytes(words), dtype=np.uint8)
+    return out.reshape(-1, LABEL_BYTES)

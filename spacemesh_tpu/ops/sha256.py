@@ -1,0 +1,141 @@
+"""SHA-256 as pure JAX on uint32 words.
+
+Used by the scrypt labeler (PBKDF2-HMAC-SHA256 envelope; see ops/scrypt.py)
+and by k2pow. The reference computes these inside post-rs (Rust `scrypt`
+crate); here they are expressed as branch-free uint32 arithmetic so a single
+definition serves:
+
+- per-label scalar form (word vectors of shape ``(n,)``), which `jax.vmap`
+  batches across labels, and
+- direct batched use with a leading lane dimension.
+
+All words are big-endian packed per FIPS 180-4. Conversions to scrypt's
+little-endian layout happen in ops/scrypt.py via `byteswap32`.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+_K = np.array(
+    [
+        0x428A2F98, 0x71374491, 0xB5C0FBCF, 0xE9B5DBA5, 0x3956C25B, 0x59F111F1,
+        0x923F82A4, 0xAB1C5ED5, 0xD807AA98, 0x12835B01, 0x243185BE, 0x550C7DC3,
+        0x72BE5D74, 0x80DEB1FE, 0x9BDC06A7, 0xC19BF174, 0xE49B69C1, 0xEFBE4786,
+        0x0FC19DC6, 0x240CA1CC, 0x2DE92C6F, 0x4A7484AA, 0x5CB0A9DC, 0x76F988DA,
+        0x983E5152, 0xA831C66D, 0xB00327C8, 0xBF597FC7, 0xC6E00BF3, 0xD5A79147,
+        0x06CA6351, 0x14292967, 0x27B70A85, 0x2E1B2138, 0x4D2C6DFC, 0x53380D13,
+        0x650A7354, 0x766A0ABB, 0x81C2C92E, 0x92722C85, 0xA2BFE8A1, 0xA81A664B,
+        0xC24B8B70, 0xC76C51A3, 0xD192E819, 0xD6990624, 0xF40E3585, 0x106AA070,
+        0x19A4C116, 0x1E376C08, 0x2748774C, 0x34B0BCB5, 0x391C0CB3, 0x4ED8AA4A,
+        0x5B9CCA4F, 0x682E6FF3, 0x748F82EE, 0x78A5636F, 0x84C87814, 0x8CC70208,
+        0x90BEFFFA, 0xA4506CEB, 0xBEF9A3F7, 0xC67178F2,
+    ],
+    dtype=np.uint32,
+)
+
+IV = np.array(
+    [0x6A09E667, 0xBB67AE85, 0x3C6EF372, 0xA54FF53A,
+     0x510E527F, 0x9B05688C, 0x1F83D9AB, 0x5BE0CD19],
+    dtype=np.uint32,
+)
+
+
+def rotr(x, n: int):
+    return (x >> jnp.uint32(n)) | (x << jnp.uint32(32 - n))
+
+
+def byteswap32(x):
+    """Reverse byte order of each uint32 lane (BE <-> LE repacking)."""
+    x = x.astype(jnp.uint32)
+    return (
+        (x << jnp.uint32(24))
+        | ((x & jnp.uint32(0xFF00)) << jnp.uint32(8))
+        | ((x >> jnp.uint32(8)) & jnp.uint32(0xFF00))
+        | (x >> jnp.uint32(24))
+    )
+
+
+def sha256_compress(state, block):
+    """One SHA-256 compression. ``state``: (8, ...) u32, ``block``: (16, ...) u32.
+
+    Trailing dims are lanes (label batch). The schedule and round loops are
+    `lax.fori_loop`s rather than unrolled: the fully unrolled 64-round u32
+    graph sends XLA:CPU's algebraic simplifier into a circular-rewrite spin
+    (hang at compile time), and rolled loops also keep compiles fast.
+    SHA-256 is the envelope, not the hot path — ROMix dominates runtime.
+    """
+    state = jnp.asarray(state)
+    block = jnp.asarray(block)
+    tail = block.shape[1:]
+    if state.shape[1:] != tail:  # broadcast lanes eagerly: fori_loop carries
+        state = jnp.broadcast_to(state, (8,) + tail)  # must be shape-stable
+
+    w0 = jnp.concatenate(
+        [block, jnp.zeros((48,) + tail, jnp.uint32)], axis=0)
+
+    def extend(i, w):
+        a = lax.dynamic_index_in_dim(w, i - 15, keepdims=False)
+        b = lax.dynamic_index_in_dim(w, i - 2, keepdims=False)
+        s0 = rotr(a, 7) ^ rotr(a, 18) ^ (a >> jnp.uint32(3))
+        s1 = rotr(b, 17) ^ rotr(b, 19) ^ (b >> jnp.uint32(10))
+        wi = (lax.dynamic_index_in_dim(w, i - 16, keepdims=False) + s0
+              + lax.dynamic_index_in_dim(w, i - 7, keepdims=False) + s1)
+        return lax.dynamic_update_index_in_dim(w, wi, i, axis=0)
+
+    w = lax.fori_loop(16, 64, extend, w0)
+    k = jnp.asarray(_K)
+
+    def round_(i, carry):
+        a, b, c, d, e, f, g, h = carry
+        s1 = rotr(e, 6) ^ rotr(e, 11) ^ rotr(e, 25)
+        ch = (e & f) ^ (~e & g)
+        t1 = (h + s1 + ch + lax.dynamic_index_in_dim(k, i, keepdims=False)
+              + lax.dynamic_index_in_dim(w, i, keepdims=False))
+        s0 = rotr(a, 2) ^ rotr(a, 13) ^ rotr(a, 22)
+        maj = (a & b) ^ (a & c) ^ (b & c)
+        t2 = s0 + maj
+        return (t1 + t2, a, b, c, d + t1, e, f, g)
+
+    init = tuple(state[i] for i in range(8))
+    out = lax.fori_loop(0, 64, round_, init)
+    return jnp.stack([state[i] + out[i] for i in range(8)])
+
+
+def sha256_words(blocks):
+    """SHA-256 over pre-padded message ``blocks`` of shape (nblocks, 16) u32."""
+    state = jnp.asarray(IV)
+    nblocks = blocks.shape[0]
+    if nblocks <= 4:  # unroll short messages (the common case here)
+        for i in range(nblocks):
+            state = sha256_compress(state, blocks[i])
+        return state
+    def body(i, st):
+        return sha256_compress(st, lax.dynamic_index_in_dim(blocks, i, keepdims=False))
+    return lax.fori_loop(0, nblocks, body, state)
+
+
+def hmac_midstates(key_words):
+    """Midstates of HMAC-SHA256 for a 32-byte key given as (8,) u32 BE words.
+
+    Returns (inner, outer) compression states after absorbing key^ipad /
+    key^opad — shared across every PBKDF2 block and every label.
+    """
+    zeros = jnp.zeros(8, jnp.uint32)
+    kw = jnp.concatenate([key_words.astype(jnp.uint32), zeros])
+    ipad = kw ^ jnp.uint32(0x36363636)
+    opad = kw ^ jnp.uint32(0x5C5C5C5C)
+    iv = jnp.asarray(IV)
+    return sha256_compress(iv, ipad), sha256_compress(iv, opad)
+
+
+def pad_message_np(msg: bytes) -> np.ndarray:
+    """Host-side FIPS 180-4 padding -> (nblocks, 16) u32 BE words."""
+    ml = len(msg)
+    msg = msg + b"\x80"
+    msg += b"\x00" * ((-(len(msg) + 8)) % 64)
+    msg += (ml * 8).to_bytes(8, "big")
+    arr = np.frombuffer(msg, dtype=">u4").astype(np.uint32)
+    return arr.reshape(-1, 16)

@@ -1,0 +1,26 @@
+"""Test config: force JAX onto a virtual 8-device CPU platform.
+
+Tests must not require TPU hardware; multi-chip sharding is exercised on a
+virtual CPU mesh (SURVEY.md §7 test carry-over (f)).
+
+Subtlety: the container's sitecustomize imports jax and registers the "axon"
+TPU-tunnel PJRT plugin at interpreter startup — before pytest loads this
+conftest — and pins JAX_PLATFORMS=axon in the environment. Setting env vars
+here is therefore too late for jax's own config; we must go through
+jax.config. The XLA_FLAGS update still works because the CPU client is only
+instantiated at first backend use, which happens inside tests.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"  # for any subprocesses tests spawn
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402  (import order is the point here)
+
+jax.config.update("jax_platforms", "cpu")
