@@ -1,0 +1,94 @@
+"""Headline benchmark: POST init labels/sec on one chip (mainnet N=8192).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "labels/s", "vs_baseline": N}
+
+vs_baseline is the speedup over the reference CPU labeling path measured
+in-process (hashlib.scrypt = OpenSSL scrypt, the same labeling function the
+reference's CPU provider computes; the reference publishes no numbers of
+its own — BASELINE.md). Progress goes to stderr; stdout carries only the
+JSON line.
+
+Env knobs: BENCH_BATCH (label lanes per program), BENCH_N (scrypt N),
+BENCH_REPS, BENCH_CPU_LABELS.
+"""
+
+import hashlib
+import json
+import os
+import sys
+import time
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def cpu_labels_per_sec(commitment: bytes, n: int, count: int) -> float:
+    t0 = time.perf_counter()
+    for i in range(count):
+        hashlib.scrypt(commitment, salt=i.to_bytes(8, "little"), n=n, r=1,
+                       p=1, maxmem=256 * 1024 * 1024, dklen=16)
+    dt = time.perf_counter() - t0
+    return count / dt
+
+
+def main() -> None:
+    n = int(os.environ.get("BENCH_N", 8192))
+    reps = int(os.environ.get("BENCH_REPS", 3))
+    cpu_count = int(os.environ.get("BENCH_CPU_LABELS", 24))
+    batches = [int(b) for b in os.environ.get(
+        "BENCH_BATCH", "8192,4096,2048,1024").split(",")]
+
+    commitment = hashlib.sha256(b"bench-commitment").digest()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from spacemesh_tpu.ops import scrypt
+
+    dev = jax.devices()[0]
+    log(f"device: {dev} platform={dev.platform}")
+
+    cw = jnp.asarray(scrypt.commitment_to_words(commitment))
+    best_rate, best_batch = 0.0, 0
+    for batch in batches:
+        try:
+            idx = np.arange(batch, dtype=np.uint64)
+            lo_, hi_ = scrypt.split_indices(idx)
+            lo, hi = jnp.asarray(lo_), jnp.asarray(hi_)
+            t0 = time.perf_counter()
+            out = scrypt.scrypt_labels_jit(cw, lo, hi, n=n)
+            out.block_until_ready()
+            log(f"batch={batch}: compile+first run "
+                f"{time.perf_counter() - t0:.1f}s")
+            rate = 0.0
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                out = scrypt.scrypt_labels_jit(cw, lo, hi, n=n)
+                out.block_until_ready()
+                dt = time.perf_counter() - t0
+                rate = max(rate, batch / dt)
+            log(f"batch={batch}: {rate:,.0f} labels/s")
+            if rate > best_rate:
+                best_rate, best_batch = rate, batch
+        except Exception as e:  # noqa: BLE001 — e.g. HBM OOM at big batches
+            log(f"batch={batch}: failed ({type(e).__name__}: {e})")
+    if best_rate == 0.0:
+        raise SystemExit("all batch sizes failed")
+
+    log(f"CPU baseline: {cpu_count} labels via hashlib.scrypt ...")
+    cpu_rate = cpu_labels_per_sec(commitment, n, cpu_count)
+    log(f"cpu: {cpu_rate:,.1f} labels/s (single core, OpenSSL)")
+
+    print(json.dumps({
+        "metric": f"post_init_labels_per_sec_n{n}_b{best_batch}",
+        "value": round(best_rate, 1),
+        "unit": "labels/s",
+        "vs_baseline": round(best_rate / cpu_rate, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,122 @@
+"""k2pow — proof-gating proof-of-work as a batched TPU nonce search.
+
+The reference gates NIPoST proof generation behind a RandomX PoW ("k2pow",
+reference activation/post.go:71-81, difficulty config/mainnet.go:40-43).
+RandomX is *deliberately* CPU-serial (random code execution over a 2 GiB
+dataset) and has no sensible TPU mapping, so this framework replaces it —
+behind the same validator seam (see post/verifier.py) — with a SHA-256
+preimage search under a 256-bit big-endian target, which batches across
+nonces on the VPU:
+
+    pow_hash(challenge, node_id, nonce) = SHA256(challenge || node_id
+                                                 || le64(nonce))
+    valid <=> pow_hash < difficulty     (32-byte big-endian compare)
+
+Difficulty is expressed exactly like the reference's (a 32-byte threshold;
+lower = harder) so operator configs translate directly.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .sha256 import IV, sha256_compress
+
+# Message layout: challenge(32) || node_id(32) || le64(nonce) = 72 bytes
+# -> two 64-byte blocks with FIPS padding in the second.
+_BIT_LEN = 72 * 8
+
+
+def _words_be(data: bytes) -> np.ndarray:
+    return np.frombuffer(data, dtype=">u4").astype(np.uint32)
+
+
+@jax.jit
+def pow_hash_batch_jit(prefix_state, nonce_lo, nonce_hi):
+    """SHA-256 over the second block for a (B,) batch of nonces.
+
+    ``prefix_state``: (8,) u32 — midstate after the first 64-byte block
+    (challenge || first half of node_id). ``nonce_lo/hi``: (B,) u32.
+    Returns (8, B) u32 BE digest words.
+    """
+    from .sha256 import byteswap32
+
+    b = nonce_lo.shape[0]
+    # block 1 (in prefix_state): challenge(32) || node_id(32).
+    # block 2: le64(nonce) || 0x80 || zeros || be64(bit length) —
+    # words: [swap(lo), swap(hi), 0x80000000, 0*12, _BIT_LEN]
+    tail = np.zeros((14, 1), dtype=np.uint32)
+    tail[0, 0] = 0x80000000
+    tail[13, 0] = _BIT_LEN
+    block = jnp.concatenate([
+        byteswap32(nonce_lo)[None],
+        byteswap32(nonce_hi)[None],
+        jnp.broadcast_to(jnp.asarray(tail), (14, b)),
+    ])
+    return sha256_compress(jnp.broadcast_to(prefix_state[:, None], (8, b)), block)
+
+
+@jax.jit
+def below_target_jit(digest_words, target_words):
+    """Big-endian 256-bit compare: digest < target, per lane.
+
+    digest_words: (8, B) u32; target_words: (8,) u32. Returns (B,) bool.
+    """
+    b = digest_words.shape[1]
+    t = jnp.broadcast_to(target_words[:, None], (8, b))
+    lt = digest_words < t
+    eq = digest_words == t
+    out = lt[7]
+    for i in range(6, -1, -1):
+        out = lt[i] | (eq[i] & out)
+    return out
+
+
+def prefix_state(challenge: bytes, node_id: bytes) -> np.ndarray:
+    """Midstate after absorbing challenge||node_id (the first block)."""
+    if len(challenge) != 32 or len(node_id) != 32:
+        raise ValueError("challenge and node_id must be 32 bytes")
+    block = jnp.asarray(_words_be(challenge + node_id))
+    return np.asarray(sha256_compress(jnp.asarray(IV), block))
+
+
+def pow_hash(challenge: bytes, node_id: bytes, nonce: int) -> bytes:
+    """Single hash, host convenience (ground-truth path uses hashlib)."""
+    st = prefix_state(challenge, node_id)
+    lo = np.array([nonce & 0xFFFFFFFF], dtype=np.uint32)
+    hi = np.array([(nonce >> 32) & 0xFFFFFFFF], dtype=np.uint32)
+    d = np.asarray(pow_hash_batch_jit(jnp.asarray(st), jnp.asarray(lo),
+                                      jnp.asarray(hi)))
+    return d[:, 0].astype(">u4").tobytes()
+
+
+def search(challenge: bytes, node_id: bytes, difficulty: bytes,
+           *, batch: int = 1 << 16, start: int = 0,
+           max_batches: int = 1 << 16) -> int | None:
+    """Find a nonce whose pow_hash is below ``difficulty`` (32B BE target).
+
+    Scans ``batch`` nonces per device program; returns the smallest hit in
+    the first batch containing one, or None if exhausted.
+    """
+    if len(difficulty) != 32:
+        raise ValueError("difficulty must be 32 bytes")
+    st = jnp.asarray(prefix_state(challenge, node_id))
+    tgt = jnp.asarray(_words_be(difficulty))
+    for i in range(max_batches):
+        base = start + i * batch
+        nonces = np.arange(base, base + batch, dtype=np.uint64)
+        lo = jnp.asarray((nonces & 0xFFFFFFFF).astype(np.uint32))
+        hi = jnp.asarray((nonces >> 32).astype(np.uint32))
+        ok = np.asarray(below_target_jit(pow_hash_batch_jit(st, lo, hi), tgt))
+        hits = np.nonzero(ok)[0]
+        if hits.size:
+            return int(nonces[hits[0]])
+    return None
+
+
+def verify(challenge: bytes, node_id: bytes, difficulty: bytes, nonce: int) -> bool:
+    return pow_hash(challenge, node_id, nonce) < difficulty

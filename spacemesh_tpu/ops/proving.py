@@ -1,0 +1,112 @@
+"""POST proving & verification primitives — TPU-native nonce search.
+
+Reference semantics (post-rs `post-service`, reached through the gRPC seam at
+reference api/grpcserver/post_service.go; params reference
+activation/post.go:27-61 and config/mainnet.go:187-189):
+
+- A proof over a unit of ``total_labels`` labels is a nonce plus K2 label
+  indices whose *proving hash* falls under a difficulty threshold; K1 sets
+  the expected number of qualifying labels per nonce, so a nonce "wins"
+  with tunable probability. Verification recomputes a K3-subset of the
+  submitted indices' labels and re-checks the threshold.
+
+TPU-first redesign (NOT a port): post-rs hashes the label stream with
+AES128 keyed by the challenge — fast on CPU AES-NI, hostile on TPU (S-box
+table lookups). Our proving hash is one Salsa20/8 application (pure ARX on
+u32 lanes, the same core the labeler already uses):
+
+    state = challenge(8 words LE) || nonce || idx_lo || idx_hi || 0
+            || label(4 words LE)
+    value = salsa20_8(state)[0]          # u32, uniform
+    qualifies <=> value < threshold(k1, total_labels)
+
+so proving streams labels through the VPU at full lane width. The
+threshold is ``floor(k1 * 2^32 / total_labels)`` giving E[qualifying] = k1
+per nonce over the unit.
+
+All functions here are shape-static and jittable; the host-side scheduler
+(post/prover.py) feeds label batches and collects qualifying indices.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .scrypt import salsa20_8
+
+
+def threshold_u32(k1: int, total_labels: int) -> int:
+    """Qualifying threshold: E[#qualifying labels per nonce] == k1."""
+    if total_labels <= 0:
+        raise ValueError("total_labels must be positive")
+    t = (k1 << 32) // total_labels
+    return min(t, (1 << 32) - 1)
+
+
+def _challenge_words(challenge: bytes) -> np.ndarray:
+    if len(challenge) != 32:
+        raise ValueError("challenge must be 32 bytes")
+    return np.frombuffer(challenge, dtype="<u4").astype(np.uint32)
+
+
+@functools.partial(jax.jit, static_argnames=())
+def proving_hash_jit(challenge_words, nonce, idx_lo, idx_hi, label_words):
+    """Proving-hash values for a batch of labels.
+
+    challenge_words: (8,) u32 LE shared, or (8, B) per-lane (batch verify);
+    nonce: scalar u32 shared, or (B,) per-lane; idx_lo/idx_hi: (B,) u32;
+    label_words: (4, B) u32 LE (batch minor, as produced by the labeler).
+    Returns (B,) u32 hash values.
+    """
+    b = idx_lo.shape[0]
+    ch = challenge_words.astype(jnp.uint32)
+    if ch.ndim == 1:
+        ch = ch[:, None]
+    ch = jnp.broadcast_to(ch, (8, b))
+    nv = jnp.broadcast_to(jnp.asarray(nonce, jnp.uint32).reshape(-1), (b,))
+    state = jnp.concatenate([
+        ch,
+        nv[None],
+        idx_lo[None],
+        idx_hi[None],
+        jnp.zeros((1, b), jnp.uint32),
+        label_words,
+    ])
+    return salsa20_8(state)[0]
+
+
+@functools.partial(jax.jit, static_argnames=("n_nonces",))
+def proving_scan_jit(challenge_words, nonce_base, idx_lo, idx_hi, label_words,
+                     threshold, *, n_nonces: int):
+    """Evaluate ``n_nonces`` consecutive nonces over one label batch.
+
+    Returns (n_nonces, B) bool qualification mask. The host accumulates
+    per-nonce hit counts/indices across label batches; n_nonces is static
+    so the whole sweep is one compiled program.
+    """
+    def one(k):
+        vals = proving_hash_jit(challenge_words, nonce_base + jnp.uint32(k),
+                                idx_lo, idx_hi, label_words)
+        return vals < threshold.astype(jnp.uint32)
+    return jnp.stack([one(k) for k in range(n_nonces)])
+
+
+def proving_hashes(challenge: bytes, nonce: int, indices, labels: np.ndarray
+                   ) -> np.ndarray:
+    """Host entry: hash values for (nonce, labels[i]) pairs.
+
+    ``labels``: (B, 16) uint8 as returned by the labeler. Returns (B,) u32.
+    """
+    from .scrypt import split_indices
+
+    cw = _challenge_words(challenge)
+    lo, hi = split_indices(np.atleast_1d(np.asarray(indices)).ravel())
+    lw = np.ascontiguousarray(labels).view("<u4").reshape(-1, 4).T
+    out = proving_hash_jit(jnp.asarray(cw), jnp.uint32(nonce),
+                           jnp.asarray(lo), jnp.asarray(hi),
+                           jnp.asarray(lw.astype(np.uint32)))
+    return np.asarray(out)

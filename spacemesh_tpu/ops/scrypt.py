@@ -144,9 +144,12 @@ def _pbkdf2_second(inner_mid, outer_mid, b_le):
 
 @jax.jit
 def _stage_expand(commitment_words, idx_lo, idx_hi):
+    # commitment_words: (8,) shared across the batch, or (8, B) per-lane
+    # (the batched verifier recomputes labels of many smeshers at once)
     inner_mid, outer_mid = hmac_midstates(commitment_words)
-    inner_mid = inner_mid[:, None]  # broadcast over lanes
-    outer_mid = outer_mid[:, None]
+    if inner_mid.ndim == 1:
+        inner_mid = inner_mid[:, None]  # broadcast over lanes
+        outer_mid = outer_mid[:, None]
     return inner_mid, outer_mid, _pbkdf2_first(inner_mid, outer_mid, idx_lo, idx_hi)
 
 
@@ -186,16 +189,42 @@ def labels_to_bytes(words) -> bytes:
     return np.asarray(words, dtype=np.uint32).T.astype(">u4").tobytes()
 
 
-def scrypt_labels(commitment: bytes, indices, *, n: int = 8192) -> np.ndarray:
-    """Compute labels for ``indices`` (any u64 array). Returns (B, 16) uint8."""
+def _check_n(n: int) -> None:
     # RFC 7914: for r=1, N must be a power of two and < 2^(128*r/8) = 2^16
     if n < 2 or n >= 2**16 or (n & (n - 1)) != 0:
         raise ValueError(f"scrypt n must be a power of 2 in [2, 2^16), got {n}")
-    cw = commitment_to_words(commitment)
-    indices = np.atleast_1d(np.asarray(indices)).ravel()
+
+
+def _run(cw: np.ndarray, indices: np.ndarray, n: int) -> np.ndarray:
+    """Shared tail: split indices, run the jit pipeline, pack (B,16) bytes."""
     if indices.size == 0:
         return np.zeros((0, LABEL_BYTES), dtype=np.uint8)
     lo, hi = split_indices(indices)
     words = scrypt_labels_jit(jnp.asarray(cw), jnp.asarray(lo), jnp.asarray(hi), n=n)
     out = np.frombuffer(labels_to_bytes(words), dtype=np.uint8)
     return out.reshape(-1, LABEL_BYTES)
+
+
+def scrypt_labels_multi(commitments: np.ndarray, indices, *, n: int = 8192
+                        ) -> np.ndarray:
+    """Labels for (commitment[i], index[i]) pairs — one program, many keys.
+
+    ``commitments``: (B, 32) uint8. Used by the batched verifier to
+    recompute labels for many smeshers in a single device pass.
+    """
+    _check_n(n)
+    commitments = np.ascontiguousarray(np.asarray(commitments, dtype=np.uint8))
+    if commitments.ndim != 2 or commitments.shape[1] != 32:
+        raise ValueError("commitments must be (B, 32) bytes")
+    indices = np.atleast_1d(np.asarray(indices)).ravel()
+    if indices.shape[0] != commitments.shape[0]:
+        raise ValueError("commitments and indices must have equal batch size")
+    cw = commitments.view(">u4").astype(np.uint32).T  # (8, B)
+    return _run(cw, indices, n)
+
+
+def scrypt_labels(commitment: bytes, indices, *, n: int = 8192) -> np.ndarray:
+    """Compute labels for ``indices`` (any u64 array). Returns (B, 16) uint8."""
+    _check_n(n)
+    indices = np.atleast_1d(np.asarray(indices)).ravel()
+    return _run(commitment_to_words(commitment), indices, n)

@@ -70,6 +70,8 @@ def sha256_compress(state, block):
     state = jnp.asarray(state)
     block = jnp.asarray(block)
     tail = block.shape[1:]
+    if state.ndim < block.ndim:  # add lane axes: words are ALWAYS axis 0
+        state = state.reshape(state.shape + (1,) * (block.ndim - state.ndim))
     if state.shape[1:] != tail:  # broadcast lanes eagerly: fori_loop carries
         state = jnp.broadcast_to(state, (8,) + tail)  # must be shape-stable
 
@@ -123,8 +125,8 @@ def hmac_midstates(key_words):
     Returns (inner, outer) compression states after absorbing key^ipad /
     key^opad — shared across every PBKDF2 block and every label.
     """
-    zeros = jnp.zeros(8, jnp.uint32)
-    kw = jnp.concatenate([key_words.astype(jnp.uint32), zeros])
+    key_words = jnp.asarray(key_words, jnp.uint32)
+    kw = jnp.concatenate([key_words, jnp.zeros_like(key_words)])
     ipad = kw ^ jnp.uint32(0x36363636)
     opad = kw ^ jnp.uint32(0x5C5C5C5C)
     iv = jnp.asarray(IV)

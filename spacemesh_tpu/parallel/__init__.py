@@ -1,0 +1,16 @@
+"""Device-mesh sharding for the POST compute plane.
+
+The reference scales by (a) running many identities on one machine
+(multi-smesher, reference activation/activation.go:218 Register) and
+(b) per-device OpenCL providers (provider id selects a GPU). The TPU-native
+equivalent is SPMD over a `jax.sharding.Mesh`: the label index space —
+across one identity's unit range or across many identities — is the data
+axis, sharded over devices; XLA inserts the (few) collectives, which ride
+ICI. See mesh.py.
+"""
+
+from .mesh import (  # noqa: F401
+    data_mesh,
+    init_step_sharded,
+    scrypt_labels_sharded,
+)

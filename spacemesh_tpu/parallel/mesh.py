@@ -1,0 +1,90 @@
+"""SPMD sharding of the POST pipeline over a device mesh.
+
+One parallelism axis matters for this workload (SURVEY.md §2.4): the label
+batch — spanning one identity's index range, or many identities' ranges
+concatenated (multi-smesher DP; per-lane commitments). Everything is lane
+arithmetic with no cross-lane dataflow except reductions (init stats, VRF
+scan), so: shard the batch axis over the mesh, let XLA all-reduce the
+scalar stats over ICI.
+
+Mesh axis name: "data". Mainnet-scale example (BASELINE config 5): 16
+smeshers x 4 SU on a v5e-8 = batch lanes striped across 8 chips; each chip
+labels its stripe and the host shards disk writes per smesher.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops import scrypt
+from ..ops.sha256 import byteswap32
+
+DATA_AXIS = "data"
+
+
+def data_mesh(devices=None) -> Mesh:
+    """A 1-D data mesh over all (or the given) devices."""
+    devices = np.asarray(devices if devices is not None else jax.devices())
+    return Mesh(devices.reshape(-1), (DATA_AXIS,))
+
+
+def _batch_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P(DATA_AXIS))
+
+
+def _lane_sharding(mesh: Mesh) -> NamedSharding:
+    # word-major arrays: (words, B) — shard the minor/lane axis
+    return NamedSharding(mesh, P(None, DATA_AXIS))
+
+
+def scrypt_labels_sharded(mesh: Mesh, commitment_words, idx_lo, idx_hi,
+                          *, n: int):
+    """Label batch sharded over the mesh. Batch size must divide evenly.
+
+    ``commitment_words``: (8,) shared or (8, B) per-lane (multi-identity).
+    Returns (4, B) u32 BE words with the lane axis sharded.
+    """
+    bs = _batch_sharding(mesh)
+    idx_lo = jax.device_put(jnp.asarray(idx_lo), bs)
+    idx_hi = jax.device_put(jnp.asarray(idx_hi), bs)
+    cw = jnp.asarray(commitment_words)
+    if cw.ndim == 2:
+        cw = jax.device_put(cw, _lane_sharding(mesh))
+    return scrypt.scrypt_labels_jit(cw, idx_lo, idx_hi, n=n)
+
+
+@functools.partial(jax.jit, static_argnames=("n",))
+def _init_step(commitment_words, idx_lo, idx_hi, threshold, *, n: int):
+    words = scrypt.scrypt_labels_jit(commitment_words, idx_lo, idx_hi, n=n)
+    # init statistics, all-reduced across the mesh by XLA:
+    #  - how many labels fall under the proving threshold (K1 calibration)
+    #  - running minimum of the labels' top-64-bit keys (VRF-nonce scan;
+    #    exact LE-u128 argmin stays host-side in post/initializer.py)
+    k_hi = byteswap32(words[3]).astype(jnp.uint32)
+    k_lo = byteswap32(words[2]).astype(jnp.uint32)
+    qualifying = jnp.sum((words[0] < threshold).astype(jnp.int32))
+    min_hi = jnp.min(k_hi)
+    is_min = k_hi == min_hi
+    min_lo = jnp.min(jnp.where(is_min, k_lo, jnp.uint32(0xFFFFFFFF)))
+    return words, qualifying, min_hi, min_lo
+
+
+def init_step_sharded(mesh: Mesh, commitment_words, idx_lo, idx_hi,
+                      threshold: int, *, n: int):
+    """One sharded init step: labels + global stats (the multichip path).
+
+    The label computation is embarrassingly parallel over lanes; the three
+    scalar stats are cross-device reductions XLA lowers to ICI all-reduces.
+    """
+    bs = _batch_sharding(mesh)
+    idx_lo = jax.device_put(jnp.asarray(idx_lo), bs)
+    idx_hi = jax.device_put(jnp.asarray(idx_hi), bs)
+    cw = jnp.asarray(commitment_words)
+    if cw.ndim == 2:
+        cw = jax.device_put(cw, _lane_sharding(mesh))
+    return _init_step(cw, idx_lo, idx_hi, jnp.uint32(threshold), n=n)

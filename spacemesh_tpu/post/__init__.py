@@ -1,0 +1,7 @@
+"""POST worker: initialization (disk fill), proving, verification.
+
+The TPU-native replacement for the reference's post-rs initializer +
+post-service prover + CGo verifier (SURVEY.md §2.2-2.3). The node talks to
+this worker through the PostService seam (post/service.py), mirroring the
+process boundary at reference api/grpcserver/post_service.go.
+"""

@@ -1,0 +1,154 @@
+"""tpu-post-worker CLI: init / prove / verify / benchmark.
+
+The operator surface of the standalone POST worker (SURVEY.md §7 M0
+deliverable), mirroring what post-rs ships as separate binaries (the
+initializer, the post-service prover, and the profiler — reference
+Makefile-libs.Inc fetches them prebuilt; activation/post_supervisor.go:105-127
+exposes Providers()/Benchmark()).
+
+Usage:
+  python -m spacemesh_tpu.post init --data-dir D --node-id-hex .. \
+      --commitment-hex .. --num-units 1 --labels-per-unit 1024 [--scrypt-n N]
+  python -m spacemesh_tpu.post prove --data-dir D --challenge-hex ..
+  python -m spacemesh_tpu.post verify --data-dir D --proof-file P.json \
+      --challenge-hex ..
+  python -m spacemesh_tpu.post benchmark [--batch B] [--scrypt-n N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+
+def _hex32(s: str) -> bytes:
+    b = bytes.fromhex(s)
+    if len(b) != 32:
+        raise argparse.ArgumentTypeError("expected 32 bytes of hex")
+    return b
+
+
+def cmd_init(a) -> int:
+    from . import initializer
+
+    def progress(done, total):
+        print(f"\r{done}/{total} labels ({100 * done / total:.1f}%)",
+              end="", file=sys.stderr, flush=True)
+
+    meta, res = initializer.initialize(
+        a.data_dir, node_id=a.node_id_hex, commitment=a.commitment_hex,
+        num_units=a.num_units, labels_per_unit=a.labels_per_unit,
+        scrypt_n=a.scrypt_n, max_file_size=a.max_file_size,
+        batch_size=a.batch, progress=progress)
+    print("", file=sys.stderr)
+    print(json.dumps({
+        "labels_written": res.labels_written,
+        "vrf_nonce": res.vrf_nonce,
+        "labels_per_s": round(res.labels_per_s, 1),
+        "elapsed_s": round(res.elapsed_s, 2),
+    }))
+    return 0
+
+
+def cmd_prove(a) -> int:
+    from .prover import ProofParams, Prover
+
+    params = ProofParams(k1=a.k1, k2=a.k2, k3=a.k3)
+    t0 = time.monotonic()
+    proof = Prover(a.data_dir, params, batch_labels=a.batch).prove(
+        a.challenge_hex)
+    out = proof.to_dict() | {"elapsed_s": round(time.monotonic() - t0, 2)}
+    if a.out:
+        Path(a.out).write_text(json.dumps(proof.to_dict()))
+    print(json.dumps(out))
+    return 0
+
+
+def cmd_verify(a) -> int:
+    from . import verifier
+    from .data import PostMetadata
+    from .prover import Proof, ProofParams
+
+    meta = PostMetadata.load(a.data_dir)
+    proof = Proof.from_dict(json.loads(Path(a.proof_file).read_text()))
+    params = ProofParams(k1=a.k1, k2=a.k2, k3=a.k3)
+    ok = verifier.verify(verifier.VerifyItem(
+        proof=proof, challenge=a.challenge_hex,
+        node_id=bytes.fromhex(meta.node_id),
+        commitment=bytes.fromhex(meta.commitment),
+        scrypt_n=meta.scrypt_n, total_labels=meta.total_labels), params)
+    print(json.dumps({"valid": ok}))
+    return 0 if ok else 1
+
+
+def cmd_benchmark(a) -> int:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..ops import scrypt
+
+    dev = jax.devices()[0]
+    cw = jnp.asarray(scrypt.commitment_to_words(bytes(32)))
+    idx = np.arange(a.batch, dtype=np.uint64)
+    lo_, hi_ = scrypt.split_indices(idx)
+    lo, hi = jnp.asarray(lo_), jnp.asarray(hi_)
+    scrypt.scrypt_labels_jit(cw, lo, hi, n=a.scrypt_n).block_until_ready()
+    t0 = time.perf_counter()
+    scrypt.scrypt_labels_jit(cw, lo, hi, n=a.scrypt_n).block_until_ready()
+    dt = time.perf_counter() - t0
+    print(json.dumps({
+        "device": str(dev), "batch": a.batch, "scrypt_n": a.scrypt_n,
+        "labels_per_s": round(a.batch / dt, 1),
+    }))
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="spacemesh_tpu.post")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    pi = sub.add_parser("init", help="fill a POST data directory with labels")
+    pi.add_argument("--data-dir", required=True)
+    pi.add_argument("--node-id-hex", type=_hex32, required=True)
+    pi.add_argument("--commitment-hex", type=_hex32, required=True)
+    pi.add_argument("--num-units", type=int, required=True)
+    pi.add_argument("--labels-per-unit", type=int, required=True)
+    pi.add_argument("--scrypt-n", type=int, default=8192)
+    pi.add_argument("--max-file-size", type=int, default=64 * 1024 * 1024)
+    pi.add_argument("--batch", type=int, default=1 << 13)
+    pi.set_defaults(fn=cmd_init)
+
+    pp = sub.add_parser("prove", help="generate a proof over the challenge")
+    pp.add_argument("--data-dir", required=True)
+    pp.add_argument("--challenge-hex", type=_hex32, required=True)
+    pp.add_argument("--k1", type=int, default=26)
+    pp.add_argument("--k2", type=int, default=37)
+    pp.add_argument("--k3", type=int, default=37)
+    pp.add_argument("--batch", type=int, default=1 << 14)
+    pp.add_argument("--out", help="write proof JSON here as well")
+    pp.set_defaults(fn=cmd_prove)
+
+    pv = sub.add_parser("verify", help="verify a proof file")
+    pv.add_argument("--data-dir", required=True)
+    pv.add_argument("--proof-file", required=True)
+    pv.add_argument("--challenge-hex", type=_hex32, required=True)
+    pv.add_argument("--k1", type=int, default=26)
+    pv.add_argument("--k2", type=int, default=37)
+    pv.add_argument("--k3", type=int, default=37)
+    pv.set_defaults(fn=cmd_verify)
+
+    pb = sub.add_parser("benchmark", help="time the labeler on this device")
+    pb.add_argument("--batch", type=int, default=2048)
+    pb.add_argument("--scrypt-n", type=int, default=8192)
+    pb.set_defaults(fn=cmd_benchmark)
+
+    a = p.parse_args(argv)
+    return a.fn(a)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

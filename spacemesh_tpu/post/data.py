@@ -1,0 +1,98 @@
+"""On-disk POST data layout: label files + resume metadata.
+
+Mirrors the reference initializer's data directory contract (post-rs writes
+``postdata_N.bin`` label files plus a metadata file; resume is driven by the
+number of labels already on disk — reference activation/post.go:267-270
+"initialization will resume from NumLabelsWritten"). Here metadata is JSON,
+written atomically (tmp + rename) after every flushed batch so a killed
+init resumes exactly where the bytes stopped.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from pathlib import Path
+
+from ..ops.scrypt import LABEL_BYTES
+
+METADATA_FILE = "postdata_metadata.json"
+
+
+@dataclasses.dataclass
+class PostMetadata:
+    """Identity + geometry of one smesher's POST data directory."""
+
+    node_id: str               # hex, 32 bytes
+    commitment: str            # hex, 32 bytes (commitment = H(node_id, atx))
+    scrypt_n: int
+    num_units: int
+    labels_per_unit: int
+    max_file_size: int         # bytes per postdata file
+    labels_written: int = 0    # resume cursor
+    vrf_nonce: int | None = None       # index of the numerically smallest label
+    vrf_nonce_value: str | None = None  # hex of that label (16 bytes)
+
+    @property
+    def total_labels(self) -> int:
+        return self.num_units * self.labels_per_unit
+
+    @property
+    def labels_per_file(self) -> int:
+        return self.max_file_size // LABEL_BYTES
+
+    def save(self, data_dir: str | Path) -> None:
+        path = Path(data_dir) / METADATA_FILE
+        tmp = path.with_suffix(".tmp")
+        tmp.write_text(json.dumps(dataclasses.asdict(self), indent=1))
+        os.replace(tmp, path)
+
+    @classmethod
+    def load(cls, data_dir: str | Path) -> "PostMetadata":
+        return cls(**json.loads((Path(data_dir) / METADATA_FILE).read_text()))
+
+
+class LabelStore:
+    """Reads/writes the ``postdata_N.bin`` files for one data directory."""
+
+    def __init__(self, data_dir: str | Path, meta: PostMetadata):
+        self.dir = Path(data_dir)
+        self.meta = meta
+        self.dir.mkdir(parents=True, exist_ok=True)
+
+    def _file(self, i: int) -> Path:
+        return self.dir / f"postdata_{i}.bin"
+
+    def write_labels(self, start_index: int, labels: bytes) -> None:
+        """Append ``labels`` (concatenated 16B records) at ``start_index``."""
+        lpf = self.meta.labels_per_file
+        idx = start_index
+        off = 0
+        while off < len(labels):
+            fi, within = divmod(idx, lpf)
+            take = min(len(labels) - off, (lpf - within) * LABEL_BYTES)
+            with open(self._file(fi), "r+b" if self._file(fi).exists() else "wb") as f:
+                f.seek(within * LABEL_BYTES)
+                f.write(labels[off:off + take])
+            off += take
+            idx += take // LABEL_BYTES
+
+    def read_labels(self, start_index: int, count: int) -> bytes:
+        lpf = self.meta.labels_per_file
+        out = bytearray()
+        idx = start_index
+        remaining = count
+        while remaining > 0:
+            fi, within = divmod(idx, lpf)
+            take = min(remaining, lpf - within)
+            with open(self._file(fi), "rb") as f:
+                f.seek(within * LABEL_BYTES)
+                chunk = f.read(take * LABEL_BYTES)
+            if len(chunk) != take * LABEL_BYTES:
+                raise IOError(
+                    f"short read at label {idx}: file {fi} truncated")
+            out += chunk
+            idx += take
+            remaining -= take
+        return bytes(out)

@@ -1,0 +1,178 @@
+"""POST initialization: fill the data directory with scrypt labels.
+
+The PostSetupManager equivalent (reference activation/post.go:185-449 drives
+CGo `initialization.Initialize`; here the labeler is the JAX kernel in
+ops/scrypt.py). Design:
+
+- the label space [0, total_labels) is processed in device-sized batches;
+- dispatch is double-buffered: batch k+1 is enqueued on the accelerator
+  before batch k's bytes are fetched to host and written to disk, so disk
+  and TPU overlap;
+- after every flushed batch the resume metadata is atomically rewritten
+  (labels_written cursor + running VRF-nonce minimum), matching the
+  reference's NumLabelsWritten resume semantics;
+- the VRF nonce is the index of the numerically smallest label seen
+  (little-endian u128 compare), tracked on the fly as post-rs does during
+  init.
+
+Progress/status mirrors the reference's state machine
+(NotStarted/InProgress/Complete — activation/post.go:128-137).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import time
+from pathlib import Path
+from typing import Callable
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops import scrypt
+from .data import LabelStore, PostMetadata
+
+DEFAULT_BATCH = 1 << 13  # 8192 labels = 8 MiB ROMix scratch per 1k... tuned in bench
+
+
+class Status(enum.Enum):
+    NOT_STARTED = "not_started"
+    IN_PROGRESS = "in_progress"
+    COMPLETE = "complete"
+    STOPPED = "stopped"
+    ERROR = "error"
+
+
+@dataclasses.dataclass
+class InitResult:
+    labels_written: int
+    vrf_nonce: int
+    elapsed_s: float
+    labels_per_s: float
+
+
+def _le128_min(labels: np.ndarray) -> tuple[int, tuple[int, int]]:
+    """Index + (hi, lo) u64 pair of the numerically smallest LE-u128 label."""
+    flat = np.ascontiguousarray(labels)
+    lo = flat[:, :8].copy().view("<u8").ravel()
+    hi = flat[:, 8:].copy().view("<u8").ravel()
+    k = int(np.lexsort((lo, hi))[0])
+    return k, (int(hi[k]), int(lo[k]))
+
+
+class Initializer:
+    """Fills (or resumes) one identity's POST data directory."""
+
+    def __init__(self, data_dir: str | Path, meta: PostMetadata,
+                 batch_size: int = DEFAULT_BATCH,
+                 progress: Callable[[int, int], None] | None = None):
+        self.store = LabelStore(data_dir, meta)
+        self.meta = meta
+        self.batch = batch_size
+        self.progress = progress
+        self.status = (Status.COMPLETE
+                       if meta.labels_written >= meta.total_labels
+                       else Status.NOT_STARTED)
+        self._stop = False
+
+    def stop(self) -> None:
+        self._stop = True
+
+    def run(self) -> InitResult:
+        meta = self.meta
+        commitment = bytes.fromhex(meta.commitment)
+        total = meta.total_labels
+        self.status = Status.IN_PROGRESS
+        t0 = time.monotonic()
+        written0 = meta.labels_written
+
+        self._vrf = meta.vrf_nonce
+        self._vrf_key = None
+        if meta.vrf_nonce_value is not None:
+            v = bytes.fromhex(meta.vrf_nonce_value)
+            self._vrf_key = (int.from_bytes(v[8:], "little"),
+                             int.from_bytes(v[:8], "little"))
+
+        def batches():
+            start = meta.labels_written
+            while start < total:
+                count = min(self.batch, total - start)
+                idx = np.arange(start, start + count, dtype=np.uint64)
+                lo, hi = scrypt.split_indices(idx)
+                words = scrypt.scrypt_labels_jit(
+                    jnp.asarray(scrypt.commitment_to_words(commitment)),
+                    jnp.asarray(lo), jnp.asarray(hi), n=meta.scrypt_n)
+                yield start, count, words
+                start += count
+
+        # double buffer: batch k+1 is already enqueued on the device while
+        # batch k is fetched and written to disk
+        pending = None
+        for nxt in batches():
+            if pending is not None:
+                self._flush(pending)
+            if self._stop:
+                self.status = Status.STOPPED
+                pending = None
+                break
+            pending = nxt
+        if pending is not None:
+            self._flush(pending)
+
+        if meta.labels_written >= total:
+            self.status = Status.COMPLETE
+        elapsed = time.monotonic() - t0
+        done = meta.labels_written - written0
+        return InitResult(
+            labels_written=meta.labels_written,
+            vrf_nonce=self._vrf if self._vrf is not None else -1,
+            elapsed_s=elapsed,
+            labels_per_s=done / elapsed if elapsed > 0 else 0.0,
+        )
+
+    def _flush(self, item) -> None:
+        start, count, words = item
+        labels = np.frombuffer(scrypt.labels_to_bytes(words), dtype=np.uint8)
+        labels = labels.reshape(count, scrypt.LABEL_BYTES)
+        k, key = _le128_min(labels)
+        if self._vrf_key is None or key < self._vrf_key:
+            self._vrf = start + k
+            self._vrf_key = key
+        self.store.write_labels(start, labels.tobytes())
+        self.meta.labels_written = start + count
+        self.meta.vrf_nonce = self._vrf
+        hi, lo = self._vrf_key
+        self.meta.vrf_nonce_value = (
+            lo.to_bytes(8, "little") + hi.to_bytes(8, "little")).hex()
+        self.meta.save(self.store.dir)
+        if self.progress:
+            self.progress(self.meta.labels_written, self.meta.total_labels)
+
+
+def initialize(data_dir: str | Path, *, node_id: bytes, commitment: bytes,
+               num_units: int, labels_per_unit: int, scrypt_n: int = 8192,
+               max_file_size: int = 64 * 1024 * 1024,
+               batch_size: int = DEFAULT_BATCH,
+               progress: Callable[[int, int], None] | None = None
+               ) -> tuple[PostMetadata, InitResult]:
+    """Create-or-resume an init session (the `PostSetupManager.StartSession`
+    equivalent). Returns final metadata + timing."""
+    dir_ = Path(data_dir)
+    if (dir_ / "postdata_metadata.json").exists():
+        meta = PostMetadata.load(dir_)
+        if (meta.commitment != commitment.hex()
+                or meta.scrypt_n != scrypt_n
+                or meta.labels_per_unit != labels_per_unit
+                or meta.num_units != num_units):
+            raise ValueError(
+                "existing POST data directory was initialized with different "
+                "parameters; refusing to mix label sets")
+    else:
+        meta = PostMetadata(
+            node_id=node_id.hex(), commitment=commitment.hex(),
+            scrypt_n=scrypt_n, num_units=num_units,
+            labels_per_unit=labels_per_unit, max_file_size=max_file_size)
+    init = Initializer(dir_, meta, batch_size=batch_size, progress=progress)
+    res = init.run()
+    return meta, res

@@ -1,0 +1,103 @@
+"""POST proving: k2pow gate + nonce search over the stored labels.
+
+The post-service equivalent (reference's external Rust prover, spawned by
+activation/post_supervisor.go:220-298 with --nonces/--threads flags; proof
+shape reference common/types/poet.go `Post{Nonce, Indices, Pow}`). Here the
+label stream is read back from disk in batches and swept through
+``proving_scan_jit`` — a (n_nonces x batch) qualification mask per program —
+so a whole nonce group rides one device dispatch per label batch.
+
+A proof for challenge ``ch`` is:
+    nonce     — the winning proving nonce
+    indices   — the first k2 label indices qualifying under nonce
+    pow_nonce — k2pow witness for (ch, node_id) (ops/pow.py)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from pathlib import Path
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops import pow as k2pow
+from ..ops import proving, scrypt
+from .data import LabelStore, PostMetadata
+
+
+@dataclasses.dataclass
+class Proof:
+    nonce: int
+    indices: list[int]          # k2 qualifying label indices, ascending
+    pow_nonce: int
+    k2: int
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Proof":
+        return cls(**d)
+
+
+@dataclasses.dataclass
+class ProofParams:
+    """Difficulty parameters (reference defaults activation/post.go:148,
+    mainnet config/mainnet.go:187-189)."""
+
+    k1: int = 26
+    k2: int = 37
+    k3: int = 37
+    pow_difficulty: bytes = bytes([0, 255]) + bytes([255]) * 30
+
+
+class Prover:
+    def __init__(self, data_dir: str | Path, params: ProofParams | None = None,
+                 batch_labels: int = 1 << 14, nonce_group: int = 16):
+        self.meta = PostMetadata.load(data_dir)
+        if self.meta.labels_written < self.meta.total_labels:
+            raise ValueError("POST data is not fully initialized")
+        self.store = LabelStore(data_dir, self.meta)
+        self.params = params or ProofParams()
+        self.batch_labels = batch_labels
+        self.nonce_group = nonce_group
+
+    def prove(self, challenge: bytes) -> Proof:
+        meta, p = self.meta, self.params
+        node_id = bytes.fromhex(meta.node_id)
+        pow_nonce = k2pow.search(challenge, node_id, p.pow_difficulty)
+        if pow_nonce is None:
+            raise RuntimeError("k2pow search exhausted")
+
+        t = proving.threshold_u32(p.k1, meta.total_labels)
+        cw = jnp.asarray(np.frombuffer(challenge, dtype="<u4").astype(np.uint32))
+        group = 0
+        while True:
+            hits: list[list[int]] = [[] for _ in range(self.nonce_group)]
+            start = 0
+            while start < meta.total_labels:
+                count = min(self.batch_labels, meta.total_labels - start)
+                idx = np.arange(start, start + count, dtype=np.uint64)
+                labels = np.frombuffer(
+                    self.store.read_labels(start, count), dtype=np.uint8
+                ).reshape(count, scrypt.LABEL_BYTES)
+                lo, hi = scrypt.split_indices(idx)
+                lw = labels.copy().view("<u4").reshape(-1, 4).T.astype(np.uint32)
+                mask = np.asarray(proving.proving_scan_jit(
+                    cw, jnp.uint32(group * self.nonce_group),
+                    jnp.asarray(lo), jnp.asarray(hi), jnp.asarray(lw),
+                    jnp.uint32(t), n_nonces=self.nonce_group))
+                for k in range(self.nonce_group):
+                    if len(hits[k]) < p.k2:
+                        found = np.nonzero(mask[k])[0]
+                        hits[k].extend((start + found).tolist())
+                start += count
+            for k in range(self.nonce_group):
+                if len(hits[k]) >= p.k2:
+                    return Proof(nonce=group * self.nonce_group + k,
+                                 indices=[int(i) for i in hits[k][:p.k2]],
+                                 pow_nonce=pow_nonce, k2=p.k2)
+            group += 1
+            if group > 1024:
+                raise RuntimeError("no winning nonce found (k1/k2 mismatch?)")

@@ -1,0 +1,83 @@
+"""The PostService seam: node <-> TPU-worker contract.
+
+Mirrors the reference's process boundary (reference
+api/grpcserver/post_service.go:24-174: the external post-service registers
+per node_id and the node requests proofs/info over the stream;
+activation/post_supervisor.go babysits the worker process). Here the
+contract is a small Python interface with an in-proc implementation; the
+gRPC transport wraps the same interface when the worker runs out-of-process
+so the node side is identical either way.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from pathlib import Path
+
+from .data import PostMetadata
+from .prover import Proof, ProofParams, Prover
+
+
+@dataclasses.dataclass
+class PostInfo:
+    node_id: bytes
+    commitment: bytes
+    num_units: int
+    labels_per_unit: int
+    scrypt_n: int
+    vrf_nonce: int
+
+
+class PostClient:
+    """What the node sees for one registered identity (reference
+    api/grpcserver/post_client.go:69 `Proof()` / `Info()`)."""
+
+    def __init__(self, data_dir: str | Path, params: ProofParams | None = None,
+                 batch_labels: int = 1 << 14):
+        self.data_dir = Path(data_dir)
+        self.params = params or ProofParams()
+        self._batch = batch_labels
+        self._lock = threading.Lock()
+
+    def info(self) -> PostInfo:
+        meta = PostMetadata.load(self.data_dir)
+        return PostInfo(
+            node_id=bytes.fromhex(meta.node_id),
+            commitment=bytes.fromhex(meta.commitment),
+            num_units=meta.num_units,
+            labels_per_unit=meta.labels_per_unit,
+            scrypt_n=meta.scrypt_n,
+            vrf_nonce=meta.vrf_nonce if meta.vrf_nonce is not None else -1,
+        )
+
+    def proof(self, challenge: bytes) -> tuple[Proof, PostMetadata]:
+        with self._lock:  # one proving session per identity at a time
+            prover = Prover(self.data_dir, self.params,
+                            batch_labels=self._batch)
+            return prover.prove(challenge), prover.meta
+
+
+class PostService:
+    """Worker-side registry of identities -> clients (the `Register`
+    stream equivalent). The node looks clients up by node_id."""
+
+    def __init__(self) -> None:
+        self._clients: dict[bytes, PostClient] = {}
+        self._lock = threading.Lock()
+
+    def register(self, node_id: bytes, client: PostClient) -> None:
+        with self._lock:
+            self._clients[node_id] = client
+
+    def deregister(self, node_id: bytes) -> None:
+        with self._lock:
+            self._clients.pop(node_id, None)
+
+    def client(self, node_id: bytes) -> PostClient | None:
+        with self._lock:
+            return self._clients.get(node_id)
+
+    def registered(self) -> list[bytes]:
+        with self._lock:
+            return list(self._clients)

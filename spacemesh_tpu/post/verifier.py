@@ -1,0 +1,113 @@
+"""POST verification: recompute-and-check, batched across proofs.
+
+The PostVerifier equivalent (reference activation/post_verifier.go:122-405
+runs a CGo worker pool; validation semantics activation/validation.go:182).
+TPU-first design: verification of MANY proofs is one batched label
+recompute — all (proof, index) pairs are flattened into a single scrypt
+batch, then a single proving-hash batch — instead of a per-proof worker
+pool. The K3 spot-check subset (reference validation.go:206 PostSubset)
+subsamples each proof's indices deterministically from a verifier seed.
+
+Also verifies the k2pow witness (ops/pow.py replaces RandomX behind the
+same seam).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+
+import numpy as np
+
+from ..ops import pow as k2pow
+from ..ops import proving, scrypt
+from .prover import Proof, ProofParams
+
+
+@dataclasses.dataclass
+class VerifyItem:
+    """One proof plus the identity/geometry it claims to cover."""
+
+    proof: Proof
+    challenge: bytes
+    node_id: bytes
+    commitment: bytes
+    scrypt_n: int
+    total_labels: int
+
+
+def _k3_subset(item: VerifyItem, k3: int, seed: bytes) -> list[int]:
+    """Deterministic K3-subsample of the proof's indices (verifier-seeded)."""
+    idx = item.proof.indices
+    if k3 >= len(idx):
+        return list(idx)
+    h = hashlib.sha256(seed + item.challenge + item.node_id).digest()
+    rng = np.random.default_rng(np.frombuffer(h[:8], dtype=np.uint64)[0])
+    pick = rng.choice(len(idx), size=k3, replace=False)
+    return [idx[i] for i in sorted(pick)]
+
+
+def verify_many(items: list[VerifyItem], params: ProofParams | None = None,
+                seed: bytes = b"") -> list[bool]:
+    """Verify a batch of proofs; returns per-proof validity.
+
+    One scrypt recompute + one proving-hash pass over the union of all
+    spot-checked indices — the TPU replacement for the reference's
+    worker-pool verify (proofs are lanes, not queue items).
+    """
+    p = params or ProofParams()
+    results = [True] * len(items)
+
+    # 1) structural + pow checks (host, cheap)
+    flat_idx: list[int] = []
+    flat_owner: list[int] = []
+    for i, it in enumerate(items):
+        pr = it.proof
+        if (len(pr.indices) < p.k2
+                or len(set(pr.indices)) != len(pr.indices)
+                or any(not (0 <= j < it.total_labels) for j in pr.indices)
+                or not k2pow.verify(it.challenge, it.node_id,
+                                    p.pow_difficulty, pr.pow_nonce)):
+            results[i] = False
+            continue
+        for j in _k3_subset(it, p.k3, seed):
+            flat_idx.append(j)
+            flat_owner.append(i)
+    if not flat_idx:
+        return results
+
+    # 2) one batched label recompute + proving-hash pass over ALL proofs.
+    # scrypt_n must be uniform per compiled program; group by n (usually 1).
+    import jax.numpy as jnp
+
+    owners = np.array(flat_owner)
+    idx = np.array(flat_idx, dtype=np.uint64)
+    commits = np.stack([
+        np.frombuffer(items[o].commitment, dtype=np.uint8) for o in flat_owner])
+    chals = np.stack([
+        np.frombuffer(items[o].challenge, dtype="<u4").astype(np.uint32)
+        for o in flat_owner]).T  # (8, B)
+    nonces = np.array([items[o].proof.nonce for o in flat_owner], dtype=np.uint32)
+    values = np.empty(len(idx), dtype=np.uint32)
+    for n in sorted({items[o].scrypt_n for o in flat_owner}):
+        sel = np.array([items[o].scrypt_n == n for o in flat_owner])
+        labels = scrypt.scrypt_labels_multi(commits[sel], idx[sel], n=n)
+        lo, hi = scrypt.split_indices(idx[sel])
+        lw = labels.copy().view("<u4").reshape(-1, 4).T.astype(np.uint32)
+        vals = np.asarray(proving.proving_hash_jit(
+            jnp.asarray(chals[:, sel]), jnp.asarray(nonces[sel]),
+            jnp.asarray(lo), jnp.asarray(hi), jnp.asarray(lw)))
+        values[sel] = vals
+
+    # 3) threshold check per item
+    thr = np.array([proving.threshold_u32(p.k1, items[o].total_labels)
+                    for o in flat_owner], dtype=np.uint64)
+    bad_owners = set(owners[values >= thr].tolist())
+    for o in bad_owners:
+        results[o] = False
+    return results
+
+
+def verify(item: VerifyItem, params: ProofParams | None = None,
+           seed: bytes = b"") -> bool:
+    return verify_many([item], params, seed)[0]

@@ -1,0 +1,67 @@
+"""Sharded label computation on the virtual 8-device CPU mesh."""
+
+import hashlib
+
+import jax
+import numpy as np
+import pytest
+
+from spacemesh_tpu.ops import proving, scrypt
+from spacemesh_tpu.parallel import data_mesh, init_step_sharded, scrypt_labels_sharded
+
+COMMIT = hashlib.sha256(b"c").digest()
+
+
+def test_mesh_has_8_devices():
+    assert len(jax.devices()) == 8
+
+
+def test_sharded_labels_match_single_device():
+    mesh = data_mesh()
+    idx = np.arange(256, dtype=np.uint64)
+    lo, hi = scrypt.split_indices(idx)
+    cw = scrypt.commitment_to_words(COMMIT)
+    words = scrypt_labels_sharded(mesh, cw, lo, hi, n=4)
+    want = scrypt.scrypt_labels(COMMIT, idx, n=4)
+    got = np.frombuffer(scrypt.labels_to_bytes(np.asarray(words)), dtype=np.uint8)
+    assert np.array_equal(got.reshape(-1, 16), want)
+
+
+def test_sharded_multi_identity():
+    # 4 identities x 64 labels striped across the mesh, per-lane commitments
+    mesh = data_mesh()
+    commits = np.stack([
+        np.frombuffer(hashlib.sha256(b"id%d" % i).digest(), dtype=np.uint8)
+        for i in range(4) for _ in range(64)])
+    idx = np.tile(np.arange(64, dtype=np.uint64), 4)
+    cw = commits.view(">u4").astype(np.uint32).reshape(-1, 8).T
+    lo, hi = scrypt.split_indices(idx)
+    words = scrypt_labels_sharded(mesh, cw, lo, hi, n=4)
+    got = np.frombuffer(scrypt.labels_to_bytes(np.asarray(words)), dtype=np.uint8)
+    got = got.reshape(-1, 16)
+    for i in range(4):
+        want = scrypt.scrypt_labels(
+            hashlib.sha256(b"id%d" % i).digest(),
+            np.arange(64, dtype=np.uint64), n=4)
+        assert np.array_equal(got[i * 64:(i + 1) * 64], want), f"identity {i}"
+
+
+def test_init_step_stats():
+    mesh = data_mesh()
+    total = 512
+    idx = np.arange(total, dtype=np.uint64)
+    lo, hi = scrypt.split_indices(idx)
+    cw = scrypt.commitment_to_words(COMMIT)
+    t = proving.threshold_u32(64, total)
+    words, qualifying, min_hi, min_lo = init_step_sharded(
+        mesh, cw, lo, hi, t, n=2)
+    labels = scrypt.scrypt_labels(COMMIT, idx, n=2)
+    # qualifying count matches host recount of words[0] < t
+    w0 = np.asarray(words)[0]
+    assert int(qualifying) == int((w0 < t).sum())
+    # min over byteswapped top words equals host min of top-32 LE key
+    k_hi = (labels[:, 15].astype(np.uint64) << 24
+            | labels[:, 14].astype(np.uint64) << 16
+            | labels[:, 13].astype(np.uint64) << 8
+            | labels[:, 12].astype(np.uint64))
+    assert int(min_hi) == int(k_hi.min())

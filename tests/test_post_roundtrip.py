@@ -1,0 +1,156 @@
+"""End-to-end POST cycle: initialize -> resume -> prove -> verify.
+
+The TPU-build analogue of the reference's activation/e2e tests (real CGo
+post with tiny units): tiny label counts, fastnet-style scrypt N=2,
+full byte-level roundtrip through the disk format.
+"""
+
+import dataclasses
+import hashlib
+
+import numpy as np
+import pytest
+
+from spacemesh_tpu.ops import scrypt
+from spacemesh_tpu.post import initializer, verifier
+from spacemesh_tpu.post.data import PostMetadata
+from spacemesh_tpu.post.prover import Proof, ProofParams, Prover
+
+NODE = hashlib.sha256(b"node-1").digest()
+COMMIT = hashlib.sha256(b"commitment-1").digest()
+CH = hashlib.sha256(b"poet-ref").digest()
+
+PARAMS = ProofParams(k1=64, k2=16, k3=8,
+                     pow_difficulty=bytes([32]) + bytes([255]) * 31)
+
+
+@pytest.fixture(scope="module")
+def unit(tmp_path_factory):
+    d = tmp_path_factory.mktemp("post")
+    meta, res = initializer.initialize(
+        d, node_id=NODE, commitment=COMMIT, num_units=2,
+        labels_per_unit=512, scrypt_n=2, max_file_size=4096,
+        batch_size=256)
+    return d, meta, res
+
+
+def test_init_writes_correct_labels(unit):
+    d, meta, res = unit
+    assert meta.labels_written == 1024
+    assert res.labels_per_s > 0
+    store = initializer.Initializer(d, meta).store
+    got = np.frombuffer(store.read_labels(100, 8), dtype=np.uint8).reshape(8, 16)
+    want = scrypt.scrypt_labels(COMMIT, np.arange(100, 108, dtype=np.uint64), n=2)
+    assert np.array_equal(got, want)
+    # multiple files were produced (max_file_size 4096 = 256 labels/file)
+    assert (d / "postdata_0.bin").exists() and (d / "postdata_3.bin").exists()
+
+
+def test_vrf_nonce_is_global_min(unit):
+    d, meta, _ = unit
+    labels = scrypt.scrypt_labels(COMMIT, np.arange(1024, dtype=np.uint64), n=2)
+    lo = labels[:, :8].copy().view("<u8").ravel()
+    hi = labels[:, 8:].copy().view("<u8").ravel()
+    k = int(np.lexsort((lo, hi))[0])
+    assert meta.vrf_nonce == k
+    assert bytes.fromhex(meta.vrf_nonce_value) == bytes(labels[k])
+
+
+def test_resume_after_partial_init(tmp_path):
+    # first pass: stop after 1 batch via the progress callback
+    calls = []
+
+    def stop_soon(done, total):
+        calls.append(done)
+        if done >= 256:
+            init.stop()
+
+    meta = PostMetadata(node_id=NODE.hex(), commitment=COMMIT.hex(),
+                        scrypt_n=2, num_units=1, labels_per_unit=1024,
+                        max_file_size=1 << 20)
+    init = initializer.Initializer(tmp_path, meta, batch_size=256,
+                                   progress=stop_soon)
+    init.run()
+    assert init.status == initializer.Status.STOPPED
+    partial = PostMetadata.load(tmp_path)
+    assert 0 < partial.labels_written < 1024
+
+    # second pass: resume to completion; data must equal a fresh init
+    meta2, _ = initializer.initialize(
+        tmp_path, node_id=NODE, commitment=COMMIT, num_units=1,
+        labels_per_unit=1024, scrypt_n=2, max_file_size=1 << 20,
+        batch_size=256)
+    assert meta2.labels_written == 1024
+    store = initializer.Initializer(tmp_path, meta2).store
+    got = np.frombuffer(store.read_labels(0, 1024), dtype=np.uint8).reshape(-1, 16)
+    want = scrypt.scrypt_labels(COMMIT, np.arange(1024, dtype=np.uint64), n=2)
+    assert np.array_equal(got, want)
+
+
+def test_mismatched_params_rejected(unit):
+    d, _, _ = unit
+    with pytest.raises(ValueError, match="different"):
+        initializer.initialize(d, node_id=NODE, commitment=COMMIT,
+                               num_units=2, labels_per_unit=512, scrypt_n=4,
+                               max_file_size=4096)
+
+
+@pytest.fixture(scope="module")
+def proof(unit):
+    d, meta, _ = unit
+    return Prover(d, PARAMS, batch_labels=512).prove(CH)
+
+
+def _item(meta: PostMetadata, pr: Proof) -> verifier.VerifyItem:
+    return verifier.VerifyItem(
+        proof=pr, challenge=CH, node_id=NODE, commitment=COMMIT,
+        scrypt_n=meta.scrypt_n, total_labels=meta.total_labels)
+
+
+def test_prove_verify_roundtrip(unit, proof):
+    _, meta, _ = unit
+    assert len(proof.indices) == PARAMS.k2
+    assert proof.indices == sorted(proof.indices)
+    assert verifier.verify(_item(meta, proof), PARAMS)
+
+
+def test_tampered_proofs_rejected(unit, proof):
+    _, meta, _ = unit
+    good = _item(meta, proof)
+
+    bad_idx = dataclasses.replace(
+        proof, indices=[(i + 1) % meta.total_labels for i in proof.indices])
+    assert not verifier.verify(dataclasses.replace(good, proof=bad_idx), PARAMS)
+
+    bad_nonce = dataclasses.replace(proof, nonce=proof.nonce + 1)
+    assert not verifier.verify(dataclasses.replace(good, proof=bad_nonce), PARAMS)
+
+    bad_pow = dataclasses.replace(proof, pow_nonce=proof.pow_nonce + 1)
+    assert not verifier.verify(dataclasses.replace(good, proof=bad_pow), PARAMS)
+
+    dup = dataclasses.replace(
+        proof, indices=[proof.indices[0]] * PARAMS.k2)
+    assert not verifier.verify(dataclasses.replace(good, proof=dup), PARAMS)
+
+    short = dataclasses.replace(proof, indices=proof.indices[:PARAMS.k2 - 1])
+    assert not verifier.verify(dataclasses.replace(good, proof=short), PARAMS)
+
+    oob = dataclasses.replace(
+        proof, indices=proof.indices[:-1] + [meta.total_labels])
+    assert not verifier.verify(dataclasses.replace(good, proof=oob), PARAMS)
+
+    wrong_commit = dataclasses.replace(good, commitment=hashlib.sha256(b"x").digest())
+    assert not verifier.verify(wrong_commit, PARAMS)
+
+
+def test_batch_verify_mixed(unit, proof):
+    _, meta, _ = unit
+    good = _item(meta, proof)
+    bad = dataclasses.replace(
+        good, proof=dataclasses.replace(proof, nonce=proof.nonce + 3))
+    out = verifier.verify_many([good, bad, good], PARAMS)
+    assert out == [True, False, True]
+
+
+def test_proof_dict_roundtrip(proof):
+    assert Proof.from_dict(proof.to_dict()) == proof

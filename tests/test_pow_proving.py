@@ -1,0 +1,87 @@
+"""k2pow and proving-hash primitives: ground truth + statistics."""
+
+import hashlib
+
+import numpy as np
+import pytest
+
+from spacemesh_tpu.ops import pow as k2pow
+from spacemesh_tpu.ops import proving, scrypt
+
+CH = hashlib.sha256(b"challenge").digest()
+NID = hashlib.sha256(b"node").digest()
+
+
+def cpu_pow_hash(challenge, node_id, nonce):
+    return hashlib.sha256(challenge + node_id + int(nonce).to_bytes(8, "little")).digest()
+
+
+def test_pow_hash_matches_hashlib():
+    for nonce in (0, 1, 12345, 2**32 + 7, 2**63 - 1):
+        assert k2pow.pow_hash(CH, NID, nonce) == cpu_pow_hash(CH, NID, nonce)
+
+
+def test_pow_search_and_verify():
+    # easy difficulty: top byte 0x04 -> ~1/64 chance per nonce
+    difficulty = bytes([0x04]) + bytes(31)
+    nonce = k2pow.search(CH, NID, difficulty, batch=512, max_batches=8)
+    assert nonce is not None
+    assert k2pow.verify(CH, NID, difficulty, nonce)
+    assert cpu_pow_hash(CH, NID, nonce) < difficulty
+    # the found nonce is the first qualifying one in scan order
+    for earlier in range(min(nonce, 200)):
+        assert cpu_pow_hash(CH, NID, earlier) >= difficulty
+    assert not k2pow.verify(CH, NID, bytes(32), nonce)  # impossible target
+
+
+def test_pow_input_validation():
+    with pytest.raises(ValueError):
+        k2pow.search(CH, NID, b"short")
+    with pytest.raises(ValueError):
+        k2pow.prefix_state(b"x", NID)
+
+
+def test_proving_hash_deterministic_and_keyed():
+    idx = np.arange(64, dtype=np.uint64)
+    labels = scrypt.scrypt_labels(NID, idx, n=4)
+    a = proving.proving_hashes(CH, 7, idx, labels)
+    b = proving.proving_hashes(CH, 7, idx, labels)
+    assert np.array_equal(a, b)
+    # nonce, challenge, index, and label all key the hash
+    assert not np.array_equal(a, proving.proving_hashes(CH, 8, idx, labels))
+    other_ch = hashlib.sha256(b"other").digest()
+    assert not np.array_equal(a, proving.proving_hashes(other_ch, 7, idx, labels))
+    labels2 = np.array(labels)
+    labels2[0] ^= 1
+    assert a[0] != proving.proving_hashes(CH, 7, idx, labels2)[0]
+
+
+def test_threshold_statistics():
+    # E[qualifying] = k1: with 4096 labels and k1=256, expect ~256 +- 5 sigma
+    total = 4096
+    k1 = 256
+    t = proving.threshold_u32(k1, total)
+    idx = np.arange(total, dtype=np.uint64)
+    labels = scrypt.scrypt_labels(NID, idx, n=2)
+    vals = proving.proving_hashes(CH, 0, idx, labels)
+    count = int((vals < t).sum())
+    sigma = (k1 * (1 - k1 / total)) ** 0.5
+    assert abs(count - k1) < 6 * sigma, (count, k1)
+
+
+def test_proving_scan_matches_single_nonce():
+    import jax.numpy as jnp
+
+    idx = np.arange(128, dtype=np.uint64)
+    labels = scrypt.scrypt_labels(NID, idx, n=2)
+    t = proving.threshold_u32(16, 128)
+    lo, hi = scrypt.split_indices(idx)
+    lw = np.ascontiguousarray(labels).view("<u4").reshape(-1, 4).T.astype(np.uint32)
+    cw = np.frombuffer(CH, dtype="<u4").astype(np.uint32)
+    mask = np.asarray(proving.proving_scan_jit(
+        jnp.asarray(cw), jnp.uint32(3), jnp.asarray(lo), jnp.asarray(hi),
+        jnp.asarray(lw), jnp.uint32(t), n_nonces=4))
+    assert mask.shape == (4, 128)
+    for k in range(4):
+        vals = proving.proving_hashes(CH, 3 + k, idx, labels)
+        assert np.array_equal(mask[k], vals < t)

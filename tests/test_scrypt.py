@@ -54,6 +54,28 @@ def test_input_validation():
     assert bytes(got[0]) == cpu_label(COMMIT, 5, 4)
 
 
+def test_multi_commitment_labels_match_hashlib():
+    # per-lane keys: B=5 distinct commitments, non-contiguous indices
+    commits = [hashlib.sha256(b"m%d" % i).digest() for i in range(5)]
+    idx = np.array([0, 3, 9, 2**33, 77], dtype=np.uint64)
+    got = scrypt.scrypt_labels_multi(
+        np.stack([np.frombuffer(c, dtype=np.uint8) for c in commits]), idx, n=16)
+    for k in range(5):
+        want = hashlib.scrypt(commits[k], salt=int(idx[k]).to_bytes(8, "little"),
+                              n=16, r=1, p=1, dklen=16)
+        assert bytes(got[k]) == want, f"lane {k}"
+    # B=1 and empty
+    one = scrypt.scrypt_labels_multi(
+        np.frombuffer(commits[0], dtype=np.uint8)[None], [7], n=16)
+    assert bytes(one[0]) == cpu_label(commits[0], 7, 16)
+    empty = scrypt.scrypt_labels_multi(
+        np.zeros((0, 32), dtype=np.uint8), np.array([], dtype=np.uint64), n=16)
+    assert empty.shape == (0, 16)
+    with pytest.raises(ValueError):
+        scrypt.scrypt_labels_multi(
+            np.zeros((2, 32), dtype=np.uint8), [1, 2, 3], n=16)
+
+
 def test_sha256_words_vs_hashlib():
     from spacemesh_tpu.ops import sha256 as s
     for msg in (b"", b"abc", b"x" * 55, b"y" * 56, b"z" * 200):
