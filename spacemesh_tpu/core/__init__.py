@@ -1,0 +1,6 @@
+"""Core primitives: canonical codec, hashing, signing, domain types.
+
+The layer-0 of SURVEY.md §1 (reference codec/, hash/, signing/,
+common/types/): everything above — storage, consensus, networking, the VM —
+speaks these types and their canonical byte encodings.
+"""

@@ -1,0 +1,264 @@
+"""Identity signatures and VRF.
+
+Mirrors the reference signing package (reference signing/signer.go:157
+EdSigner with domain separation + genesis-prefix, signing/verifier.go
+EdVerifier, signing/vrf.go ECVRF via curve25519-voi):
+
+- EdSigner/EdVerifier: ed25519 (via the `cryptography` library) over
+  ``prefix || domain_byte || message`` where prefix is the genesis id —
+  signatures from different networks or domains never collide.
+- VrfSigner/VrfVerifier: ECVRF-EDWARDS25519-SHA512-TAI (RFC 9381 suite
+  0x03), implemented from spec in pure Python (curve arithmetic below).
+  The VRF output (beta) drives eligibility sampling and the beacon's weak
+  coin, so it must be a *proof* (unique, verifiable), not a bare signature.
+"""
+
+from __future__ import annotations
+
+import enum
+import hashlib
+
+from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+    Ed25519PrivateKey,
+    Ed25519PublicKey,
+)
+from cryptography.hazmat.primitives import serialization
+from cryptography.exceptions import InvalidSignature
+
+PUBLIC_KEY_SIZE = 32
+PRIVATE_KEY_SIZE = 64  # seed || public, like the reference's ed25519
+SIGNATURE_SIZE = 64
+VRF_PROOF_SIZE = 80
+VRF_OUTPUT_SIZE = 64
+
+
+class Domain(enum.IntEnum):
+    """Signature domains (reference signing/signer.go:18-38)."""
+
+    ATX = 0
+    BEACON_FIRST_MSG = 1
+    BEACON_FOLLOWUP_MSG = 2
+    BALLOT = 3
+    HARE = 4
+    POET = 5
+    BEACON_PROPOSAL = 6
+    MALFEASANCE = 7
+
+
+# --- ed25519 identity signatures -----------------------------------------
+
+
+class EdSigner:
+    def __init__(self, seed: bytes | None = None, prefix: bytes = b""):
+        if seed is None:
+            self._sk = Ed25519PrivateKey.generate()
+        else:
+            if len(seed) not in (32, 64):
+                raise ValueError("seed must be 32 (seed) or 64 (seed||pub) bytes")
+            self._sk = Ed25519PrivateKey.from_private_bytes(seed[:32])
+        self.prefix = prefix
+        self._pub = self._sk.public_key().public_bytes(
+            serialization.Encoding.Raw, serialization.PublicFormat.Raw)
+
+    @property
+    def node_id(self) -> bytes:
+        return self._pub
+
+    @property
+    def public_key(self) -> bytes:
+        return self._pub
+
+    def private_bytes(self) -> bytes:
+        seed = self._sk.private_bytes(
+            serialization.Encoding.Raw, serialization.PrivateFormat.Raw,
+            serialization.NoEncryption())
+        return seed + self._pub
+
+    def sign(self, domain: Domain, msg: bytes) -> bytes:
+        return self._sk.sign(self.prefix + bytes([domain]) + msg)
+
+    def vrf_signer(self) -> "VrfSigner":
+        seed = self._sk.private_bytes(
+            serialization.Encoding.Raw, serialization.PrivateFormat.Raw,
+            serialization.NoEncryption())
+        return VrfSigner(seed, self._pub)
+
+
+class EdVerifier:
+    def __init__(self, prefix: bytes = b""):
+        self.prefix = prefix
+
+    def verify(self, domain: Domain, public_key: bytes, msg: bytes,
+               sig: bytes) -> bool:
+        if len(sig) != SIGNATURE_SIZE or len(public_key) != PUBLIC_KEY_SIZE:
+            return False
+        try:
+            Ed25519PublicKey.from_public_bytes(public_key).verify(
+                sig, self.prefix + bytes([domain]) + msg)
+            return True
+        except (InvalidSignature, ValueError):
+            return False
+
+
+# --- edwards25519 arithmetic (for the VRF) --------------------------------
+
+_P = 2**255 - 19
+_Q = 2**252 + 27742317777372353535851937790883648493
+_D = (-121665 * pow(121666, _P - 2, _P)) % _P
+_SQRT_M1 = pow(2, (_P - 1) // 4, _P)
+
+
+def _inv(x: int) -> int:
+    return pow(x, _P - 2, _P)
+
+
+# extended homogeneous coordinates (X, Y, Z, T), x*y == z*t
+_BX = 15112221349535400772501151409588531511454012693041857206046113283949847762202
+_BY = 46316835694926478169428394003475163141307993866256225615783033603165251855960
+_B = (_BX, _BY, 1, (_BX * _BY) % _P)
+_ID = (0, 1, 1, 0)
+
+
+def _pt_add(p, q):
+    x1, y1, z1, t1 = p
+    x2, y2, z2, t2 = q
+    a = ((y1 - x1) * (y2 - x2)) % _P
+    b = ((y1 + x1) * (y2 + x2)) % _P
+    c = (2 * t1 * t2 * _D) % _P
+    dd = (2 * z1 * z2) % _P
+    e, f, g, h = b - a, dd - c, dd + c, b + a
+    return ((e * f) % _P, (g * h) % _P, (f * g) % _P, (e * h) % _P)
+
+
+def _pt_mul(s: int, p):
+    out = _ID
+    while s:
+        if s & 1:
+            out = _pt_add(out, p)
+        p = _pt_add(p, p)
+        s >>= 1
+    return out
+
+
+def _pt_eq(p, q) -> bool:
+    # cross-multiply to compare projective points
+    return ((p[0] * q[2] - q[0] * p[2]) % _P == 0
+            and (p[1] * q[2] - q[1] * p[2]) % _P == 0)
+
+
+def _pt_encode(p) -> bytes:
+    zi = _inv(p[2])
+    x = (p[0] * zi) % _P
+    y = (p[1] * zi) % _P
+    return (y | ((x & 1) << 255)).to_bytes(32, "little")
+
+
+def _pt_decode(data: bytes):
+    if len(data) != 32:
+        return None
+    y = int.from_bytes(data, "little")
+    sign = y >> 255
+    y &= (1 << 255) - 1
+    if y >= _P:
+        return None
+    # recover x: x^2 = (y^2 - 1) / (d*y^2 + 1)
+    u = (y * y - 1) % _P
+    v = (_D * y * y + 1) % _P
+    x = (u * v**3 % _P) * pow(u * v**7 % _P, (_P - 5) // 8, _P) % _P
+    vx2 = (v * x * x) % _P
+    if vx2 == u % _P:
+        pass
+    elif vx2 == (-u) % _P:
+        x = (x * _SQRT_M1) % _P
+    else:
+        return None
+    if x == 0 and sign:
+        return None
+    if x & 1 != sign:
+        x = _P - x
+    return (x, y, 1, (x * y) % _P)
+
+
+# --- ECVRF-EDWARDS25519-SHA512-TAI (RFC 9381, suite 0x03) -----------------
+
+_SUITE = b"\x03"
+
+
+def _expand_key(seed32: bytes) -> tuple[int, bytes]:
+    h = hashlib.sha512(seed32).digest()
+    x = int.from_bytes(h[:32], "little")
+    x &= (1 << 254) - 8
+    x |= 1 << 254
+    return x, h[32:]
+
+
+def _hash_to_curve_tai(y_bytes: bytes, alpha: bytes):
+    ctr = 0
+    while ctr < 256:
+        h = hashlib.sha512(
+            _SUITE + b"\x01" + y_bytes + alpha + bytes([ctr]) + b"\x00"
+        ).digest()[:32]
+        pt = _pt_decode(h)
+        if pt is not None:
+            return _pt_mul(8, pt)  # clear cofactor
+        ctr += 1
+    raise RuntimeError("hash_to_curve failed")  # pragma: no cover
+
+
+def _challenge(points: list) -> int:
+    data = _SUITE + b"\x02" + b"".join(_pt_encode(p) for p in points) + b"\x00"
+    return int.from_bytes(hashlib.sha512(data).digest()[:16], "little")
+
+
+class VrfSigner:
+    def __init__(self, seed32: bytes, public_key: bytes | None = None):
+        if len(seed32) != 32:
+            raise ValueError("vrf seed must be 32 bytes")
+        self._x, self._nonce_key = _expand_key(seed32)
+        self._y = _pt_mul(self._x, _B)
+        self.public_key = _pt_encode(self._y)
+        if public_key is not None and public_key != self.public_key:
+            raise ValueError("public key mismatch")
+
+    def prove(self, alpha: bytes) -> bytes:
+        h_pt = _hash_to_curve_tai(self.public_key, alpha)
+        h_bytes = _pt_encode(h_pt)
+        gamma = _pt_mul(self._x, h_pt)
+        k = int.from_bytes(
+            hashlib.sha512(self._nonce_key + h_bytes).digest(), "little") % _Q
+        c = _challenge([self._y, h_pt, gamma, _pt_mul(k, _B), _pt_mul(k, h_pt)])
+        s = (k + c * self._x) % _Q
+        return (_pt_encode(gamma) + c.to_bytes(16, "little")
+                + s.to_bytes(32, "little"))
+
+    def sign(self, alpha: bytes) -> bytes:  # reference naming: vrf "signature"
+        return self.prove(alpha)
+
+
+def vrf_output(proof: bytes) -> bytes:
+    """beta = proof_to_hash(pi): the uniform VRF output (64 bytes)."""
+    gamma = _pt_decode(proof[:32])
+    if gamma is None:
+        raise ValueError("invalid vrf proof")
+    cg = _pt_mul(8, gamma)
+    return hashlib.sha512(_SUITE + b"\x03" + _pt_encode(cg) + b"\x00").digest()
+
+
+class VrfVerifier:
+    def verify(self, public_key: bytes, alpha: bytes, proof: bytes) -> bool:
+        if len(proof) != VRF_PROOF_SIZE:
+            return False
+        y = _pt_decode(public_key)
+        gamma = _pt_decode(proof[:32])
+        if y is None or gamma is None:
+            return False
+        c = int.from_bytes(proof[32:48], "little")
+        s = int.from_bytes(proof[48:80], "little")
+        if s >= _Q:
+            return False
+        h_pt = _hash_to_curve_tai(public_key, alpha)
+        # U = s*B - c*Y ; V = s*H - c*Gamma
+        neg = lambda p: ((-p[0]) % _P, p[1], p[2], (-p[3]) % _P)  # noqa: E731
+        u = _pt_add(_pt_mul(s, _B), _pt_mul(c, neg(y)))
+        v = _pt_add(_pt_mul(s, h_pt), _pt_mul(c, neg(gamma)))
+        return _challenge([y, h_pt, gamma, u, v]) == c

@@ -1,0 +1,5 @@
+"""Node composition: config, presets, clock, events, the App wiring.
+
+The layer-9 of SURVEY.md §1 (reference node/node.go App + config/): all
+cross-component wiring happens here, nowhere else.
+"""

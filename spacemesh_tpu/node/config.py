@@ -1,0 +1,200 @@
+"""Configuration tree + presets.
+
+Mirrors the reference's config package (reference config/config.go: every
+subsystem owns a Config struct embedded in the root; config/presets
+register whole profiles — fastnet/testnet/standalone; genesis id =
+hash(time || extra) per config/genesis.go). JSON files merge over a preset;
+explicit kwargs merge over both.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from pathlib import Path
+
+from ..core.hashing import sum256
+
+
+@dataclasses.dataclass
+class GenesisConfig:
+    time: float = 0.0            # unix seconds
+    extra_data: str = "tpu-mainnet"
+
+    @property
+    def genesis_id(self) -> bytes:
+        """20-byte network id (reference config/genesis.go GenesisID)."""
+        return sum256(str(int(self.time)).encode(), self.extra_data.encode())[:20]
+
+
+@dataclasses.dataclass
+class PostConfig:
+    """Protocol POST params (reference activation/post.go:27-61,
+    config/mainnet.go:184-190)."""
+
+    min_num_units: int = 4
+    max_num_units: int = 1 << 20
+    labels_per_unit: int = 4294967296
+    scrypt_n: int = 8192
+    k1: int = 26
+    k2: int = 37
+    k3: int = 37
+    pow_difficulty: str = "000dfb23b0979b4b" + "00" * 24  # hex, 32 bytes
+
+    @property
+    def pow_difficulty_bytes(self) -> bytes:
+        return bytes.fromhex(self.pow_difficulty)
+
+
+@dataclasses.dataclass
+class SmeshingConfig:
+    start: bool = False
+    coinbase: str = ""           # bech32
+    data_dir: str = "post-data"
+    num_units: int = 4
+    init_batch: int = 1 << 13
+
+
+@dataclasses.dataclass
+class HareConfig:
+    committee_size: int = 800
+    leader_count: int = 5
+    round_duration: float = 25.0
+    preround_delay: float = 25.0
+    iteration_limit: int = 4
+
+
+@dataclasses.dataclass
+class BeaconConfig:
+    kappa: int = 40
+    q: str = "1/3"
+    rounds_number: int = 300
+    grace_period: float = 10.0
+    proposal_duration: float = 30.0
+    first_voting_round_duration: float = 210.0
+    voting_round_duration: float = 30.0
+    weak_coin_round_duration: float = 30.0
+    theta: float = 0.00004
+    votes_limit: int = 100
+
+
+@dataclasses.dataclass
+class TortoiseConfig:
+    hdist: int = 10              # hare result trust distance
+    zdist: int = 8
+    window_size: int = 1000
+    delay_layers: int = 10
+
+
+@dataclasses.dataclass
+class P2PConfig:
+    listen: str = "0.0.0.0:7513"
+    bootnodes: list[str] = dataclasses.field(default_factory=list)
+    min_peers: int = 20
+    max_peers: int = 100
+    network_cookie: str = ""
+
+
+@dataclasses.dataclass
+class APIConfig:
+    public_listener: str = "0.0.0.0:9092"
+    private_listener: str = "127.0.0.1:9093"
+    post_listener: str = "127.0.0.1:0"
+
+
+@dataclasses.dataclass
+class Config:
+    preset: str = ""
+    data_dir: str = "data"
+    layer_duration: float = 300.0          # mainnet: 5 min layers
+    layers_per_epoch: int = 4032           # 2 weeks
+    genesis: GenesisConfig = dataclasses.field(default_factory=GenesisConfig)
+    post: PostConfig = dataclasses.field(default_factory=PostConfig)
+    smeshing: SmeshingConfig = dataclasses.field(default_factory=SmeshingConfig)
+    hare: HareConfig = dataclasses.field(default_factory=HareConfig)
+    beacon: BeaconConfig = dataclasses.field(default_factory=BeaconConfig)
+    tortoise: TortoiseConfig = dataclasses.field(default_factory=TortoiseConfig)
+    p2p: P2PConfig = dataclasses.field(default_factory=P2PConfig)
+    api: APIConfig = dataclasses.field(default_factory=APIConfig)
+    poet_servers: list[str] = dataclasses.field(default_factory=list)
+    poet_cycle_gap: float = 43200.0        # 12 h
+    standalone: bool = False
+
+    def epoch_of(self, layer: int) -> int:
+        return layer // self.layers_per_epoch
+
+
+def _merge(obj, overrides: dict):
+    for key, val in overrides.items():
+        if not hasattr(obj, key):
+            raise ValueError(f"unknown config key: {key}")
+        cur = getattr(obj, key)
+        if dataclasses.is_dataclass(cur) and isinstance(val, dict):
+            _merge(cur, val)
+        else:
+            setattr(obj, key, val)
+
+
+PRESETS = {}
+
+
+def preset(name):
+    def deco(fn):
+        PRESETS[name] = fn
+        return fn
+    return deco
+
+
+@preset("mainnet")
+def _mainnet() -> Config:
+    return Config(preset="mainnet")
+
+
+@preset("fastnet")
+def _fastnet() -> Config:
+    """Small/fast everything (reference config/presets/fastnet.go:19:
+    15 s layers, 4 layers/epoch, scrypt N=2, small committees)."""
+    c = Config(preset="fastnet")
+    c.genesis.extra_data = "tpu-fastnet"
+    c.layer_duration = 15.0
+    c.layers_per_epoch = 4
+    c.post = PostConfig(
+        min_num_units=1, labels_per_unit=1024, scrypt_n=2, k1=12, k2=4, k3=4,
+        pow_difficulty="08" + "ff" * 31)
+    c.hare = HareConfig(committee_size=50, round_duration=0.7,
+                        preround_delay=1.0, iteration_limit=2)
+    c.beacon = BeaconConfig(kappa=40, rounds_number=4, grace_period=0.5,
+                            proposal_duration=0.7,
+                            first_voting_round_duration=1.4,
+                            voting_round_duration=0.7,
+                            weak_coin_round_duration=0.7)
+    c.tortoise = TortoiseConfig(hdist=4, zdist=2, window_size=100,
+                                delay_layers=4)
+    c.poet_cycle_gap = 30.0
+    return c
+
+
+@preset("standalone")
+def _standalone() -> Config:
+    """One in-proc node: own poet, own post worker, no external network
+    (reference config/presets/standalone.go + node.go:1293
+    launchStandalone)."""
+    c = _fastnet()
+    c.preset = "standalone"
+    c.genesis.extra_data = "tpu-standalone"
+    c.standalone = True
+    c.smeshing.start = True
+    c.smeshing.num_units = 1
+    c.p2p.listen = ""
+    return c
+
+
+def load(preset_name: str = "", file: str | Path | None = None,
+         overrides: dict | None = None) -> Config:
+    """Preset -> JSON file -> explicit overrides (later wins)."""
+    cfg = PRESETS[preset_name]() if preset_name else Config()
+    if file is not None:
+        _merge(cfg, json.loads(Path(file).read_text()))
+    if overrides:
+        _merge(cfg, overrides)
+    return cfg

@@ -1,0 +1,99 @@
+"""In-process event bus with buffered fan-out subscriptions.
+
+Mirrors the reference's events package (reference events/reporter.go:
+global reporter, typed Emit*/Subscribe*, buffered subscriptions with an
+overflow signal streamed to the API event service). asyncio-native: each
+subscription is a bounded queue; on overflow the subscription is marked
+lossy (consumers resync from storage, as the reference does).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+from collections import defaultdict
+from typing import Any, Type
+
+
+@dataclasses.dataclass
+class LayerUpdate:
+    layer: int
+    status: str          # "tick" | "hare_done" | "applied"
+
+
+@dataclasses.dataclass
+class AtxEvent:
+    atx_id: bytes
+    node_id: bytes
+    epoch: int
+
+
+@dataclasses.dataclass
+class BeaconEvent:
+    epoch: int
+    beacon: bytes
+
+
+@dataclasses.dataclass
+class TxEvent:
+    tx_id: bytes
+    valid: bool
+
+
+@dataclasses.dataclass
+class PostEvent:
+    node_id: bytes
+    kind: str            # "init_start" | "init_complete" | "post_start" | "post_complete"
+    detail: str = ""
+
+
+@dataclasses.dataclass
+class AtxPublished:
+    atx_id: bytes
+    node_id: bytes
+    epoch: int
+
+
+@dataclasses.dataclass
+class Malfeasance:
+    node_id: bytes
+
+
+class Subscription:
+    def __init__(self, bus: "EventBus", types: tuple, size: int):
+        self._bus = bus
+        self.types = types
+        self.queue: asyncio.Queue = asyncio.Queue(maxsize=size)
+        self.overflowed = False
+
+    def _offer(self, ev) -> None:
+        try:
+            self.queue.put_nowait(ev)
+        except asyncio.QueueFull:
+            self.overflowed = True
+
+    async def next(self):
+        return await self.queue.get()
+
+    def close(self) -> None:
+        self._bus._drop(self)
+
+
+class EventBus:
+    def __init__(self) -> None:
+        self._subs: dict[type, list[Subscription]] = defaultdict(list)
+
+    def subscribe(self, *types: Type, size: int = 256) -> Subscription:
+        sub = Subscription(self, types, size)
+        for t in types:
+            self._subs[t].append(sub)
+        return sub
+
+    def emit(self, ev: Any) -> None:
+        for sub in list(self._subs.get(type(ev), ())):
+            sub._offer(ev)
+
+    def _drop(self, sub: Subscription) -> None:
+        for t in sub.types:
+            if sub in self._subs.get(t, ()):
+                self._subs[t].remove(sub)

@@ -85,13 +85,14 @@ def prefix_state(challenge: bytes, node_id: bytes) -> np.ndarray:
 
 
 def pow_hash(challenge: bytes, node_id: bytes, nonce: int) -> bytes:
-    """Single hash, host convenience (ground-truth path uses hashlib)."""
-    st = prefix_state(challenge, node_id)
-    lo = np.array([nonce & 0xFFFFFFFF], dtype=np.uint32)
-    hi = np.array([(nonce >> 32) & 0xFFFFFFFF], dtype=np.uint32)
-    d = np.asarray(pow_hash_batch_jit(jnp.asarray(st), jnp.asarray(lo),
-                                      jnp.asarray(hi)))
-    return d[:, 0].astype(">u4").tobytes()
+    """Single hash on host (verification path: one 2-block SHA-256 is far
+    cheaper than a device round-trip; the device path is for search)."""
+    import hashlib
+
+    if len(challenge) != 32 or len(node_id) != 32:
+        raise ValueError("challenge and node_id must be 32 bytes")
+    return hashlib.sha256(
+        challenge + node_id + int(nonce).to_bytes(8, "little")).digest()
 
 
 def search(challenge: bytes, node_id: bytes, difficulty: bytes,

@@ -47,10 +47,13 @@ def threshold_u32(k1: int, total_labels: int) -> int:
     return min(t, (1 << 32) - 1)
 
 
-def _challenge_words(challenge: bytes) -> np.ndarray:
+def challenge_words(challenge: bytes) -> np.ndarray:
     if len(challenge) != 32:
         raise ValueError("challenge must be 32 bytes")
     return np.frombuffer(challenge, dtype="<u4").astype(np.uint32)
+
+
+_challenge_words = challenge_words  # compat alias
 
 
 @functools.partial(jax.jit, static_argnames=())
@@ -101,12 +104,11 @@ def proving_hashes(challenge: bytes, nonce: int, indices, labels: np.ndarray
 
     ``labels``: (B, 16) uint8 as returned by the labeler. Returns (B,) u32.
     """
-    from .scrypt import split_indices
+    from .scrypt import labels_to_words, split_indices
 
-    cw = _challenge_words(challenge)
+    cw = challenge_words(challenge)
     lo, hi = split_indices(np.atleast_1d(np.asarray(indices)).ravel())
-    lw = np.ascontiguousarray(labels).view("<u4").reshape(-1, 4).T
+    lw = labels_to_words(labels)
     out = proving_hash_jit(jnp.asarray(cw), jnp.uint32(nonce),
-                           jnp.asarray(lo), jnp.asarray(hi),
-                           jnp.asarray(lw.astype(np.uint32)))
+                           jnp.asarray(lo), jnp.asarray(hi), jnp.asarray(lw))
     return np.asarray(out)

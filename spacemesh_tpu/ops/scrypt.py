@@ -189,6 +189,11 @@ def labels_to_bytes(words) -> bytes:
     return np.asarray(words, dtype=np.uint32).T.astype(">u4").tobytes()
 
 
+def labels_to_words(labels: np.ndarray) -> np.ndarray:
+    """(B, 16) uint8 labels -> (4, B) u32 LE words (proving-hash input)."""
+    return np.ascontiguousarray(labels).view("<u4").reshape(-1, 4).T.astype(np.uint32)
+
+
 def _check_n(n: int) -> None:
     # RFC 7914: for r=1, N must be a power of two and < 2^(128*r/8) = 2^16
     if n < 2 or n >= 2**16 or (n & (n - 1)) != 0:

@@ -161,10 +161,12 @@ def initialize(data_dir: str | Path, *, node_id: bytes, commitment: bytes,
     dir_ = Path(data_dir)
     if (dir_ / "postdata_metadata.json").exists():
         meta = PostMetadata.load(dir_)
-        if (meta.commitment != commitment.hex()
+        if (meta.node_id != node_id.hex()
+                or meta.commitment != commitment.hex()
                 or meta.scrypt_n != scrypt_n
                 or meta.labels_per_unit != labels_per_unit
-                or meta.num_units != num_units):
+                or meta.num_units != num_units
+                or meta.max_file_size != max_file_size):
             raise ValueError(
                 "existing POST data directory was initialized with different "
                 "parameters; refusing to mix label sets")

@@ -71,7 +71,7 @@ class Prover:
             raise RuntimeError("k2pow search exhausted")
 
         t = proving.threshold_u32(p.k1, meta.total_labels)
-        cw = jnp.asarray(np.frombuffer(challenge, dtype="<u4").astype(np.uint32))
+        cw = jnp.asarray(proving.challenge_words(challenge))
         group = 0
         while True:
             hits: list[list[int]] = [[] for _ in range(self.nonce_group)]
@@ -83,7 +83,7 @@ class Prover:
                     self.store.read_labels(start, count), dtype=np.uint8
                 ).reshape(count, scrypt.LABEL_BYTES)
                 lo, hi = scrypt.split_indices(idx)
-                lw = labels.copy().view("<u4").reshape(-1, 4).T.astype(np.uint32)
+                lw = scrypt.labels_to_words(labels)
                 mask = np.asarray(proving.proving_scan_jit(
                     cw, jnp.uint32(group * self.nonce_group),
                     jnp.asarray(lo), jnp.asarray(hi), jnp.asarray(lw),

@@ -37,7 +37,13 @@ class VerifyItem:
 
 
 def _k3_subset(item: VerifyItem, k3: int, seed: bytes) -> list[int]:
-    """Deterministic K3-subsample of the proof's indices (verifier-seeded)."""
+    """K3-subsample of the proof's indices, keyed by the VERIFIER's seed.
+
+    The seed must be unpredictable to the prover (reference
+    validation.go:206 seeds PostSubset by the verifying node's id): a
+    prover who can predict the sampled positions could stuff the k2-k3
+    unsampled slots with garbage indices.
+    """
     idx = item.proof.indices
     if k3 >= len(idx):
         return list(idx)
@@ -48,14 +54,23 @@ def _k3_subset(item: VerifyItem, k3: int, seed: bytes) -> list[int]:
 
 
 def verify_many(items: list[VerifyItem], params: ProofParams | None = None,
-                seed: bytes = b"") -> list[bool]:
+                seed: bytes | None = None) -> list[bool]:
     """Verify a batch of proofs; returns per-proof validity.
 
     One scrypt recompute + one proving-hash pass over the union of all
     spot-checked indices — the TPU replacement for the reference's
     worker-pool verify (proofs are lanes, not queue items).
+
+    ``seed`` keys the K3 spot-check subset; by default a fresh random seed
+    is drawn per call so provers cannot predict which indices get checked.
+    Pass an explicit seed only for reproducible verification (tests,
+    deterministic replay).
     """
+    import os
+
     p = params or ProofParams()
+    if seed is None:
+        seed = os.urandom(32)
     results = [True] * len(items)
 
     # 1) structural + pow checks (host, cheap)
@@ -93,7 +108,7 @@ def verify_many(items: list[VerifyItem], params: ProofParams | None = None,
         sel = np.array([items[o].scrypt_n == n for o in flat_owner])
         labels = scrypt.scrypt_labels_multi(commits[sel], idx[sel], n=n)
         lo, hi = scrypt.split_indices(idx[sel])
-        lw = labels.copy().view("<u4").reshape(-1, 4).T.astype(np.uint32)
+        lw = scrypt.labels_to_words(labels)
         vals = np.asarray(proving.proving_hash_jit(
             jnp.asarray(chals[:, sel]), jnp.asarray(nonces[sel]),
             jnp.asarray(lo), jnp.asarray(hi), jnp.asarray(lw)))
@@ -109,5 +124,5 @@ def verify_many(items: list[VerifyItem], params: ProofParams | None = None,
 
 
 def verify(item: VerifyItem, params: ProofParams | None = None,
-           seed: bytes = b"") -> bool:
+           seed: bytes | None = None) -> bool:
     return verify_many([item], params, seed)[0]

@@ -1,0 +1,60 @@
+"""ATX queries (reference sql/atxs)."""
+
+from __future__ import annotations
+
+from ..core.types import ActivationTx
+from .db import Database
+
+
+def add(db: Database, atx: ActivationTx, *, tick_height: int = 0,
+        received: int = 0) -> None:
+    db.exec(
+        "INSERT OR IGNORE INTO atxs (id, node_id, publish_epoch, num_units,"
+        " tick_height, vrf_nonce, coinbase, received, data)"
+        " VALUES (?,?,?,?,?,?,?,?,?)",
+        (atx.id, atx.node_id, atx.publish_epoch, atx.num_units, tick_height,
+         atx.vrf_nonce, atx.coinbase, received, atx.to_bytes()))
+
+
+def get(db: Database, atx_id: bytes) -> ActivationTx | None:
+    row = db.one("SELECT data FROM atxs WHERE id=?", (atx_id,))
+    return ActivationTx.from_bytes(row["data"]) if row else None
+
+
+def has(db: Database, atx_id: bytes) -> bool:
+    return db.one("SELECT 1 FROM atxs WHERE id=?", (atx_id,)) is not None
+
+
+def tick_height(db: Database, atx_id: bytes) -> int | None:
+    row = db.one("SELECT tick_height FROM atxs WHERE id=?", (atx_id,))
+    return row["tick_height"] if row else None
+
+
+def by_node_in_epoch(db: Database, node_id: bytes, epoch: int
+                     ) -> ActivationTx | None:
+    row = db.one(
+        "SELECT data FROM atxs WHERE node_id=? AND publish_epoch=?",
+        (node_id, epoch))
+    return ActivationTx.from_bytes(row["data"]) if row else None
+
+
+def latest_by_node(db: Database, node_id: bytes) -> ActivationTx | None:
+    row = db.one(
+        "SELECT data FROM atxs WHERE node_id=? ORDER BY publish_epoch DESC"
+        " LIMIT 1", (node_id,))
+    return ActivationTx.from_bytes(row["data"]) if row else None
+
+
+def ids_in_epoch(db: Database, epoch: int) -> list[bytes]:
+    return [r["id"] for r in
+            db.all("SELECT id FROM atxs WHERE publish_epoch=?", (epoch,))]
+
+
+def all_in_epoch(db: Database, epoch: int) -> list[ActivationTx]:
+    return [ActivationTx.from_bytes(r["data"]) for r in
+            db.all("SELECT data FROM atxs WHERE publish_epoch=?", (epoch,))]
+
+
+def count_in_epoch(db: Database, epoch: int) -> int:
+    return db.one("SELECT COUNT(*) c FROM atxs WHERE publish_epoch=?",
+                  (epoch,))["c"]

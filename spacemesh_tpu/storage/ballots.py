@@ -1,0 +1,52 @@
+"""Ballot queries (reference sql/ballots)."""
+
+from __future__ import annotations
+
+from ..core.types import Ballot
+from .db import Database
+
+
+def add(db: Database, ballot: Ballot) -> None:
+    db.exec(
+        "INSERT OR IGNORE INTO ballots (id, layer, atx_id, node_id, data)"
+        " VALUES (?,?,?,?,?)",
+        (ballot.id, ballot.layer, ballot.atx_id, ballot.node_id,
+         ballot.to_bytes()))
+
+
+def get(db: Database, ballot_id: bytes) -> Ballot | None:
+    row = db.one("SELECT data FROM ballots WHERE id=?", (ballot_id,))
+    return Ballot.from_bytes(row["data"]) if row else None
+
+
+def has(db: Database, ballot_id: bytes) -> bool:
+    return db.one("SELECT 1 FROM ballots WHERE id=?", (ballot_id,)) is not None
+
+
+def in_layer(db: Database, layer: int) -> list[Ballot]:
+    return [Ballot.from_bytes(r["data"]) for r in
+            db.all("SELECT data FROM ballots WHERE layer=?", (layer,))]
+
+
+def ids_in_layer(db: Database, layer: int) -> list[bytes]:
+    return [r["id"] for r in
+            db.all("SELECT id FROM ballots WHERE layer=?", (layer,))]
+
+
+def by_node_in_layer(db: Database, node_id: bytes, layer: int) -> list[Ballot]:
+    return [Ballot.from_bytes(r["data"]) for r in
+            db.all("SELECT data FROM ballots WHERE node_id=? AND layer=?",
+                   (node_id, layer))]
+
+
+def refballot(db: Database, node_id: bytes, epoch_start: int, epoch_end: int
+              ) -> Ballot | None:
+    """First ballot of the node within [epoch_start, epoch_end) that carries
+    epoch data (the epoch's reference ballot)."""
+    for r in db.all(
+            "SELECT data FROM ballots WHERE node_id=? AND layer>=? AND layer<?"
+            " ORDER BY layer", (node_id, epoch_start, epoch_end)):
+        b = Ballot.from_bytes(r["data"])
+        if b.epoch_data is not None:
+            return b
+    return None

@@ -1,0 +1,258 @@
+"""SQLite wrapper: connections, transactions, versioned migrations.
+
+Reference parity (reference sql/database.go:244 Open, :562 Database
+interface; migrations sql/migrations.go with versioned .sql files; schema
+drift check sql/schema.go): migrations are ordered Python-side DDL lists,
+the applied version lives in ``PRAGMA user_version``, and opening verifies
+the schema version matches the code. In-memory databases (``:memory:``)
+give every test real persistence semantics — the reference's
+statesql.InMemory pattern (SURVEY.md §4.2).
+
+sqlite3 is used in autocommit mode with explicit BEGIN IMMEDIATE
+transactions; WAL journaling for file databases.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import sqlite3
+import threading
+from pathlib import Path
+
+
+class Database:
+    """A single sqlite database handle, thread-safe via a lock.
+
+    The control plane is asyncio/single-threaded per subsystem; the lock
+    makes cross-thread use (post worker callbacks, API server) safe.
+    """
+
+    def __init__(self, path: str | Path, migrations: list[str],
+                 name: str = "db"):
+        self.path = str(path)
+        self.name = name
+        self._conn = sqlite3.connect(
+            self.path, isolation_level=None, check_same_thread=False)
+        self._conn.row_factory = sqlite3.Row
+        self._lock = threading.RLock()
+        if self.path != ":memory:":
+            self._conn.execute("PRAGMA journal_mode=WAL")
+        self._conn.execute("PRAGMA foreign_keys=ON")
+        self._migrate(migrations)
+
+    def _migrate(self, migrations: list[str]) -> None:
+        # NOTE: executescript() implicitly commits any open transaction, so
+        # migrations run outside tx(); each script is itself atomic enough
+        # (DDL) and user_version advances only after a script completes.
+        with self._lock:
+            version = self._conn.execute("PRAGMA user_version").fetchone()[0]
+            if version > len(migrations):
+                raise RuntimeError(
+                    f"{self.name}: database schema version {version} is newer "
+                    f"than this build supports ({len(migrations)})")
+            for i in range(version, len(migrations)):
+                self._conn.executescript(migrations[i])
+                self._conn.execute(f"PRAGMA user_version={i + 1}")
+
+    @contextlib.contextmanager
+    def tx(self):
+        """BEGIN IMMEDIATE transaction; commits on success, rolls back on
+        error. Reentrant (nested use joins the outer transaction)."""
+        with self._lock:
+            if self._conn.in_transaction:
+                yield self._conn
+                return
+            self._conn.execute("BEGIN IMMEDIATE")
+            try:
+                yield self._conn
+            except BaseException:
+                self._conn.execute("ROLLBACK")
+                raise
+            else:
+                self._conn.execute("COMMIT")
+
+    def exec(self, sql: str, params=()) -> sqlite3.Cursor:
+        with self._lock:
+            return self._conn.execute(sql, params)
+
+    def one(self, sql: str, params=()):
+        with self._lock:
+            return self._conn.execute(sql, params).fetchone()
+
+    def all(self, sql: str, params=()):
+        with self._lock:
+            return self._conn.execute(sql, params).fetchall()
+
+    def close(self) -> None:
+        with self._lock:
+            self._conn.close()
+
+    def vacuum(self) -> None:
+        with self._lock:
+            self._conn.execute("VACUUM")
+
+
+# --- state database (replicated consensus data) ---------------------------
+
+STATE_MIGRATIONS = [
+    # 0001: core mesh entities
+    """
+    CREATE TABLE atxs (
+        id BLOB PRIMARY KEY,
+        node_id BLOB NOT NULL,
+        publish_epoch INT NOT NULL,
+        num_units INT NOT NULL,
+        tick_height INT NOT NULL DEFAULT 0,
+        vrf_nonce INT NOT NULL DEFAULT 0,
+        coinbase BLOB,
+        received INT NOT NULL DEFAULT 0,
+        data BLOB NOT NULL
+    );
+    CREATE INDEX atxs_by_epoch ON atxs (publish_epoch);
+    CREATE INDEX atxs_by_node ON atxs (node_id, publish_epoch);
+
+    CREATE TABLE ballots (
+        id BLOB PRIMARY KEY,
+        layer INT NOT NULL,
+        atx_id BLOB NOT NULL,
+        node_id BLOB NOT NULL,
+        data BLOB NOT NULL
+    );
+    CREATE INDEX ballots_by_layer ON ballots (layer);
+    CREATE INDEX ballots_by_node_layer ON ballots (node_id, layer);
+
+    CREATE TABLE blocks (
+        id BLOB PRIMARY KEY,
+        layer INT NOT NULL,
+        validity INT NOT NULL DEFAULT 0,  -- 0 undecided, 1 valid, -1 invalid
+        data BLOB NOT NULL
+    );
+    CREATE INDEX blocks_by_layer ON blocks (layer);
+
+    CREATE TABLE layers (
+        id INT PRIMARY KEY,
+        processed INT NOT NULL DEFAULT 0,
+        applied_block BLOB,
+        state_hash BLOB,
+        aggregated_hash BLOB
+    );
+
+    CREATE TABLE certificates (
+        layer INT NOT NULL,
+        block_id BLOB NOT NULL,
+        cert BLOB,
+        valid INT NOT NULL DEFAULT 1,
+        PRIMARY KEY (layer, block_id)
+    );
+
+    CREATE TABLE beacons (
+        epoch INT PRIMARY KEY,
+        beacon BLOB NOT NULL
+    );
+
+    CREATE TABLE identities (
+        node_id BLOB PRIMARY KEY,
+        proof BLOB,
+        received INT NOT NULL DEFAULT 0,
+        marriage_atx BLOB
+    );
+
+    CREATE TABLE transactions (
+        id BLOB PRIMARY KEY,
+        raw BLOB NOT NULL,
+        principal BLOB,
+        nonce INT,
+        layer INT,
+        block BLOB,
+        result BLOB
+    );
+    CREATE INDEX txs_by_principal ON transactions (principal, nonce);
+
+    CREATE TABLE accounts (
+        address BLOB NOT NULL,
+        layer INT NOT NULL,
+        balance INT NOT NULL DEFAULT 0,
+        next_nonce INT NOT NULL DEFAULT 0,
+        template BLOB,
+        state BLOB,
+        PRIMARY KEY (address, layer)
+    );
+
+    CREATE TABLE rewards (
+        coinbase BLOB NOT NULL,
+        layer INT NOT NULL,
+        total_reward INT NOT NULL,
+        layer_reward INT NOT NULL,
+        PRIMARY KEY (coinbase, layer)
+    );
+
+    CREATE TABLE poet_proofs (
+        ref BLOB PRIMARY KEY,
+        poet_id BLOB NOT NULL,
+        round_id TEXT NOT NULL,
+        ticks INT NOT NULL,
+        data BLOB NOT NULL
+    );
+
+    CREATE TABLE active_sets (
+        id BLOB PRIMARY KEY,
+        epoch INT NOT NULL,
+        data BLOB NOT NULL
+    );
+    """,
+]
+
+# --- local database (node-private progress) -------------------------------
+
+LOCAL_MIGRATIONS = [
+    """
+    CREATE TABLE nipost_state (
+        node_id BLOB PRIMARY KEY,
+        phase INT NOT NULL DEFAULT 0,
+        challenge BLOB,
+        poet_ref BLOB,
+        nipost BLOB,
+        updated INT NOT NULL DEFAULT 0
+    );
+
+    CREATE TABLE poet_registrations (
+        node_id BLOB NOT NULL,
+        poet_id BLOB NOT NULL,
+        round_id TEXT NOT NULL,
+        challenge BLOB NOT NULL,
+        round_end INT NOT NULL,
+        PRIMARY KEY (node_id, poet_id, round_id)
+    );
+
+    CREATE TABLE initial_post (
+        node_id BLOB PRIMARY KEY,
+        post BLOB NOT NULL,
+        commitment_atx BLOB NOT NULL
+    );
+
+    CREATE TABLE atx_sync_state (
+        epoch INT PRIMARY KEY,
+        downloaded INT NOT NULL DEFAULT 0,
+        total INT NOT NULL DEFAULT 0
+    );
+
+    CREATE TABLE prepared_activeset (
+        kind INT NOT NULL,
+        epoch INT NOT NULL,
+        id BLOB NOT NULL,
+        weight INT NOT NULL,
+        data BLOB NOT NULL,
+        PRIMARY KEY (kind, epoch)
+    );
+    """,
+]
+
+
+def open_state(path: str | Path = ":memory:") -> Database:
+    """The replicated consensus database (reference sql/statesql)."""
+    return Database(path, STATE_MIGRATIONS, name="state")
+
+
+def open_local(path: str | Path = ":memory:") -> Database:
+    """The node-private database (reference sql/localsql)."""
+    return Database(path, LOCAL_MIGRATIONS, name="local")

@@ -1,0 +1,124 @@
+"""Small per-entity query modules bundled: beacons, certificates,
+identities (malfeasance), rewards, poet proofs, active sets
+(reference sql/beacons, sql/certificates, sql/identities, sql/rewards,
+sql/poets, sql/activesets)."""
+
+from __future__ import annotations
+
+from ..core.types import Certificate, MalfeasanceProof, PoetProof
+from .db import Database
+
+
+# --- beacons ---------------------------------------------------------------
+
+
+def set_beacon(db: Database, epoch: int, beacon: bytes) -> None:
+    db.exec("INSERT OR REPLACE INTO beacons (epoch, beacon) VALUES (?,?)",
+            (epoch, beacon))
+
+
+def get_beacon(db: Database, epoch: int) -> bytes | None:
+    row = db.one("SELECT beacon FROM beacons WHERE epoch=?", (epoch,))
+    return row["beacon"] if row else None
+
+
+# --- certificates ----------------------------------------------------------
+
+
+def add_certificate(db: Database, layer: int, cert: Certificate) -> None:
+    db.exec(
+        "INSERT OR REPLACE INTO certificates (layer, block_id, cert, valid)"
+        " VALUES (?,?,?,1)", (layer, cert.block_id, cert.to_bytes()))
+
+
+def certificate(db: Database, layer: int) -> Certificate | None:
+    row = db.one(
+        "SELECT cert FROM certificates WHERE layer=? AND valid=1", (layer,))
+    return Certificate.from_bytes(row["cert"]) if row and row["cert"] else None
+
+
+def certified_block(db: Database, layer: int) -> bytes | None:
+    row = db.one(
+        "SELECT block_id FROM certificates WHERE layer=? AND valid=1", (layer,))
+    return row["block_id"] if row else None
+
+
+# --- identities (malfeasance) ---------------------------------------------
+
+
+def set_malicious(db: Database, node_id: bytes, proof: MalfeasanceProof,
+                  received: int = 0) -> None:
+    db.exec(
+        "INSERT OR IGNORE INTO identities (node_id, proof, received)"
+        " VALUES (?,?,?)", (node_id, proof.to_bytes(), received))
+
+
+def is_malicious(db: Database, node_id: bytes) -> bool:
+    return db.one("SELECT 1 FROM identities WHERE node_id=?",
+                  (node_id,)) is not None
+
+
+def malfeasance_proof(db: Database, node_id: bytes) -> MalfeasanceProof | None:
+    row = db.one("SELECT proof FROM identities WHERE node_id=?", (node_id,))
+    return MalfeasanceProof.from_bytes(row["proof"]) if row and row["proof"] else None
+
+
+def all_malicious(db: Database) -> list[bytes]:
+    return [r["node_id"] for r in db.all("SELECT node_id FROM identities")]
+
+
+# --- rewards ---------------------------------------------------------------
+
+
+def add_reward(db: Database, coinbase: bytes, layer: int, total: int,
+               layer_reward: int) -> None:
+    db.exec(
+        "INSERT OR REPLACE INTO rewards (coinbase, layer, total_reward,"
+        " layer_reward) VALUES (?,?,?,?)", (coinbase, layer, total, layer_reward))
+
+
+def rewards_for(db: Database, coinbase: bytes) -> list[tuple[int, int]]:
+    return [(r["layer"], r["total_reward"]) for r in
+            db.all("SELECT layer, total_reward FROM rewards WHERE coinbase=?"
+                   " ORDER BY layer", (coinbase,))]
+
+
+# --- poet proofs -----------------------------------------------------------
+
+
+def add_poet_proof(db: Database, proof: PoetProof) -> None:
+    db.exec(
+        "INSERT OR IGNORE INTO poet_proofs (ref, poet_id, round_id, ticks,"
+        " data) VALUES (?,?,?,?,?)",
+        (proof.id, proof.poet_id, proof.round_id, proof.ticks,
+         proof.to_bytes()))
+
+
+def poet_proof(db: Database, ref: bytes) -> PoetProof | None:
+    row = db.one("SELECT data FROM poet_proofs WHERE ref=?", (ref,))
+    return PoetProof.from_bytes(row["data"]) if row else None
+
+
+def poet_proof_for_round(db: Database, poet_id: bytes, round_id: str
+                         ) -> PoetProof | None:
+    row = db.one(
+        "SELECT data FROM poet_proofs WHERE poet_id=? AND round_id=?",
+        (poet_id, round_id))
+    return PoetProof.from_bytes(row["data"]) if row else None
+
+
+# --- active sets -----------------------------------------------------------
+
+
+def add_active_set(db: Database, set_id: bytes, epoch: int,
+                   atx_ids: list[bytes]) -> None:
+    db.exec("INSERT OR IGNORE INTO active_sets (id, epoch, data) VALUES (?,?,?)",
+            (set_id, epoch, b"".join(atx_ids)))
+
+
+def active_set(db: Database, set_id: bytes) -> list[bytes] | None:
+    row = db.one("SELECT data FROM active_sets WHERE id=?", (set_id,))
+    if row is None:
+        return None
+    data = row["data"]
+    return [data[i:i + 32] for i in range(0, len(data), 32)]

@@ -1,0 +1,207 @@
+"""Core primitives: codec canonicality, blake3 vectors, signatures, types."""
+
+import dataclasses
+import io
+
+import pytest
+
+from spacemesh_tpu.core import codec, hashing, signing, types
+
+
+# --- codec -----------------------------------------------------------------
+
+
+def test_uint_roundtrip_and_bounds():
+    for c, width in ((codec.u8, 1), (codec.u16, 2), (codec.u32, 4), (codec.u64, 8)):
+        hi = (1 << (8 * width)) - 1
+        for v in (0, 1, hi):
+            assert codec.decode(codec.encode(v, c), c) == v
+        with pytest.raises(ValueError):
+            codec.encode(hi + 1, c)
+
+
+def test_compact_minimal_encoding_enforced():
+    for v in (0, 1, 127, 128, 300, 2**32, 2**63):
+        data = codec.encode(v, codec.compact)
+        assert codec.decode(data, codec.compact) == v
+    # 0 encoded with a redundant continuation byte must be rejected
+    with pytest.raises(codec.DecodeError):
+        codec.decode(b"\x80\x00", codec.compact)
+    with pytest.raises(codec.DecodeError):
+        codec.decode(b"\xff" * 10 + b"\x01", codec.compact)
+
+
+def test_trailing_bytes_rejected():
+    data = codec.encode(5, codec.u8) + b"\x00"
+    with pytest.raises(codec.DecodeError):
+        codec.decode(data, codec.u8)
+
+
+def test_option_vec_string():
+    c = codec.vec(codec.option(codec.string))
+    v = ["a", None, "xyz", ""]
+    assert codec.decode(codec.encode(v, c), c) == v
+    with pytest.raises(codec.DecodeError):
+        codec.decode(b"\x02", codec.option(codec.u8))  # invalid tag
+
+
+def test_bool_strictness():
+    assert codec.decode(b"\x01", codec.boolean) is True
+    with pytest.raises(codec.DecodeError):
+        codec.decode(b"\x02", codec.boolean)
+
+
+# --- hashing ---------------------------------------------------------------
+
+
+def test_blake3_official_vectors():
+    # official test vectors from the BLAKE3 repository
+    assert hashing.sum256(b"").hex() == (
+        "af1349b9f5f9a1a6a0404dea36dcc9499bcb25c9adc112b7cc9a93cae41f3262")
+    assert hashing.sum256(b"abc").hex() == (
+        "6437b3ac38465133ffb63b75273a8db548c558465d79db03fd359c6cd5bd9d85")
+
+
+def test_blake3_incremental_and_multichunk():
+    data = bytes(range(256)) * 17  # > 4 chunks
+    one = hashing.sum256(data)
+    h = hashing.Hasher()
+    for i in range(0, len(data), 100):
+        h.update(data[i:i + 100])
+    assert h.digest() == one
+    assert hashing.sum160(data) == one[:0] + hashing.sum160(data)
+    assert len(hashing.sum160(data)) == 20
+    assert hashing.sum256(data[:1024]) != hashing.sum256(data[:1025])
+
+
+def test_blake3_keyed():
+    k1 = bytes(32)
+    k2 = bytes([1]) + bytes(31)
+    assert hashing.keyed(k1, b"m") != hashing.keyed(k2, b"m")
+    assert hashing.keyed(k1, b"m") != hashing.sum256(b"m")
+
+
+# --- signing ---------------------------------------------------------------
+
+
+def test_ed25519_domains_and_prefix():
+    s = signing.EdSigner(prefix=b"net1")
+    v = signing.EdVerifier(prefix=b"net1")
+    sig = s.sign(signing.Domain.ATX, b"hello")
+    assert v.verify(signing.Domain.ATX, s.public_key, b"hello", sig)
+    assert not v.verify(signing.Domain.BALLOT, s.public_key, b"hello", sig)
+    assert not v.verify(signing.Domain.ATX, s.public_key, b"hellx", sig)
+    v2 = signing.EdVerifier(prefix=b"net2")
+    assert not v2.verify(signing.Domain.ATX, s.public_key, b"hello", sig)
+
+
+def test_ed25519_key_persistence():
+    s = signing.EdSigner()
+    s2 = signing.EdSigner(seed=s.private_bytes())
+    assert s2.public_key == s.public_key
+
+
+def test_vrf_prove_verify():
+    s = signing.EdSigner()
+    vs = s.vrf_signer()
+    vv = signing.VrfVerifier()
+    proof = vs.prove(b"alpha")
+    assert len(proof) == signing.VRF_PROOF_SIZE
+    assert vv.verify(vs.public_key, b"alpha", proof)
+    assert not vv.verify(vs.public_key, b"beta", proof)
+    other = signing.EdSigner().vrf_signer()
+    assert not vv.verify(other.public_key, b"alpha", proof)
+    # deterministic + unique output
+    assert vs.prove(b"alpha") == proof
+    out = signing.vrf_output(proof)
+    assert len(out) == signing.VRF_OUTPUT_SIZE
+    assert out != signing.vrf_output(vs.prove(b"alpha2"))
+
+
+def test_vrf_proof_malleability_rejected():
+    s = signing.EdSigner().vrf_signer()
+    vv = signing.VrfVerifier()
+    proof = bytearray(s.prove(b"x"))
+    proof[40] ^= 1  # flip a challenge bit
+    assert not vv.verify(s.public_key, b"x", bytes(proof))
+    assert not vv.verify(s.public_key, b"x", b"\x00" * 80)
+    assert not vv.verify(s.public_key, b"x", bytes(10))
+
+
+# --- types -----------------------------------------------------------------
+
+
+def _post():
+    return types.Post(nonce=3, indices=[1, 5, 9], pow_nonce=42)
+
+
+def _nipost():
+    return types.NIPost(
+        membership=types.MerkleProof(leaf_index=2, nodes=[bytes(32), bytes(32)]),
+        post=_post(),
+        post_metadata=types.PostMetadataWire(challenge=bytes(32),
+                                             labels_per_unit=1024))
+
+
+def test_atx_roundtrip_and_id():
+    atx = types.ActivationTx(
+        publish_epoch=7, prev_atx=bytes(32), pos_atx=bytes([1]) * 32,
+        commitment_atx=bytes([2]) * 32, initial_post=_post(),
+        nipost=_nipost(), num_units=4, vrf_nonce=99,
+        coinbase=bytes(24), node_id=bytes([3]) * 32, signature=bytes(64))
+    data = atx.to_bytes()
+    back = types.ActivationTx.from_bytes(data)
+    assert back == atx
+    assert back.id == atx.id
+    # id commits to content
+    other = dataclasses.replace(atx, num_units=5)
+    assert other.id != atx.id
+    assert atx.target_epoch() == 8
+
+
+def test_ballot_proposal_block_roundtrip():
+    ballot = types.Ballot(
+        layer=12, atx_id=bytes([7]) * 32,
+        epoch_data=types.EpochData(beacon=b"\x01\x02\x03\x04",
+                                   active_set_root=bytes(32),
+                                   eligibility_count=5),
+        ref_ballot=bytes(32),
+        eligibilities=[types.VotingEligibility(j=0, sig=bytes(80))],
+        opinion=types.Opinion(base=bytes(32), support=[bytes([9]) * 32],
+                              against=[], abstain=[3]),
+        node_id=bytes([1]) * 32, signature=bytes(64))
+    assert types.Ballot.from_bytes(ballot.to_bytes()) == ballot
+
+    prop = types.Proposal(ballot=ballot, tx_ids=[bytes([5]) * 32],
+                          mesh_hash=bytes(32))
+    assert types.Proposal.from_bytes(prop.to_bytes()) == prop
+
+    blk = types.Block(layer=12, tick_height=1000,
+                      rewards=[types.Reward(coinbase=bytes(24), weight=10)],
+                      tx_ids=[bytes([5]) * 32])
+    assert types.Block.from_bytes(blk.to_bytes()) == blk
+    cert = types.Certificate(
+        block_id=blk.id,
+        signatures=[types.CertifyMessage(
+            layer=12, block_id=blk.id, eligibility_count=1,
+            proof=bytes(80), node_id=bytes(32), signature=bytes(64))])
+    assert types.Certificate.from_bytes(cert.to_bytes()) == cert
+
+
+def test_address_bech32_roundtrip():
+    a = types.Address.from_public_key(b"wallet-template", bytes(32))
+    s = a.encode()
+    assert s.startswith("sm1")
+    assert types.Address.decode(s) == a
+    with pytest.raises(ValueError):
+        types.Address.decode(s[:-1] + ("q" if s[-1] != "q" else "p"))
+    with pytest.raises(ValueError):
+        types.Address(b"short")
+
+
+def test_layer_epoch_math():
+    lyr = types.LayerID(4032 * 3 + 5)
+    assert lyr.epoch(4032) == 3
+    assert not lyr.first_in_epoch(4032)
+    assert types.epoch_first_layer(3, 4032) == 4032 * 3
+    assert types.LayerID(8064).first_in_epoch(4032)

@@ -1,0 +1,104 @@
+"""Node infrastructure: config presets, layer clock, event bus."""
+
+import asyncio
+import json
+
+import pytest
+
+from spacemesh_tpu.node import clock as clock_mod
+from spacemesh_tpu.node import config as config_mod
+from spacemesh_tpu.node import events as events_mod
+
+
+def test_presets():
+    main = config_mod.load("mainnet")
+    assert main.layer_duration == 300.0 and main.layers_per_epoch == 4032
+    assert main.post.scrypt_n == 8192 and main.post.labels_per_unit == 2**32
+    fast = config_mod.load("fastnet")
+    assert fast.layer_duration == 15.0 and fast.post.scrypt_n == 2
+    sa = config_mod.load("standalone")
+    assert sa.standalone and sa.smeshing.start
+    assert main.genesis.genesis_id != b""
+    assert len(main.genesis.genesis_id) == 20
+
+
+def test_config_file_and_overrides(tmp_path):
+    f = tmp_path / "c.json"
+    f.write_text(json.dumps({"layer_duration": 1.5,
+                             "post": {"k1": 99}}))
+    cfg = config_mod.load("fastnet", file=f, overrides={"data_dir": "/x"})
+    assert cfg.layer_duration == 1.5
+    assert cfg.post.k1 == 99
+    assert cfg.data_dir == "/x"
+    assert cfg.post.scrypt_n == 2  # preset value survives partial override
+    with pytest.raises(ValueError, match="unknown config key"):
+        config_mod.load("fastnet", overrides={"nope": 1})
+
+
+def test_genesis_id_depends_on_time_and_extra():
+    a = config_mod.GenesisConfig(time=100, extra_data="x").genesis_id
+    b = config_mod.GenesisConfig(time=101, extra_data="x").genesis_id
+    c = config_mod.GenesisConfig(time=100, extra_data="y").genesis_id
+    assert a != b and a != c
+
+
+def test_clock_layers():
+    ft = clock_mod.FakeTime(start=1000.0)
+    c = clock_mod.LayerClock(genesis_time=1000.0, layer_duration=10.0,
+                             time_source=ft)
+    assert c.current_layer() == 0
+    ft.advance(25)
+    assert c.current_layer() == 2
+    assert c.time_of(3) == 1030.0
+    ft.t = 990.0
+    assert c.current_layer() == 0
+    assert not c.genesis_reached()
+
+
+def test_clock_await_and_ticks():
+    async def run():
+        ft = clock_mod.FakeTime(start=1000.0)
+        c = clock_mod.LayerClock(1000.0, 10.0, time_source=ft)
+        seen = []
+
+        async def consume():
+            async for lyr in c.ticks():
+                seen.append(int(lyr))
+                if len(seen) >= 3:
+                    return
+
+        task = asyncio.create_task(consume())
+        await asyncio.sleep(0.05)
+        ft.advance(10)   # layer 1
+        await asyncio.sleep(0.1)
+        ft.advance(20)   # layers 2,3
+        await asyncio.wait_for(task, timeout=2)
+        assert seen == [1, 2, 3]
+    asyncio.run(run())
+
+
+def test_event_bus():
+    async def run():
+        bus = events_mod.EventBus()
+        sub = bus.subscribe(events_mod.LayerUpdate, events_mod.BeaconEvent)
+        bus.emit(events_mod.LayerUpdate(layer=1, status="tick"))
+        bus.emit(events_mod.AtxEvent(atx_id=b"", node_id=b"", epoch=0))  # not subscribed
+        bus.emit(events_mod.BeaconEvent(epoch=2, beacon=b"\x01"))
+        ev1 = await sub.next()
+        ev2 = await sub.next()
+        assert isinstance(ev1, events_mod.LayerUpdate)
+        assert isinstance(ev2, events_mod.BeaconEvent)
+        assert sub.queue.empty()
+        sub.close()
+        bus.emit(events_mod.BeaconEvent(epoch=3, beacon=b"\x02"))
+        assert sub.queue.empty()
+    asyncio.run(run())
+
+
+def test_event_bus_overflow():
+    bus = events_mod.EventBus()
+    sub = bus.subscribe(events_mod.LayerUpdate, size=2)
+    for i in range(5):
+        bus.emit(events_mod.LayerUpdate(layer=i, status="tick"))
+    assert sub.overflowed
+    assert sub.queue.qsize() == 2

@@ -16,9 +16,19 @@ def cpu_pow_hash(challenge, node_id, nonce):
     return hashlib.sha256(challenge + node_id + int(nonce).to_bytes(8, "little")).digest()
 
 
-def test_pow_hash_matches_hashlib():
-    for nonce in (0, 1, 12345, 2**32 + 7, 2**63 - 1):
-        assert k2pow.pow_hash(CH, NID, nonce) == cpu_pow_hash(CH, NID, nonce)
+def test_pow_hash_device_path_matches_hashlib():
+    # the DEVICE batch path (used by search) against the hashlib ground truth
+    import jax.numpy as jnp
+
+    nonces = np.array([0, 1, 12345, 2**32 + 7, 2**63 - 1], dtype=np.uint64)
+    st = jnp.asarray(k2pow.prefix_state(CH, NID))
+    lo = jnp.asarray((nonces & 0xFFFFFFFF).astype(np.uint32))
+    hi = jnp.asarray((nonces >> 32).astype(np.uint32))
+    d = np.asarray(k2pow.pow_hash_batch_jit(st, lo, hi))
+    for k, nonce in enumerate(nonces):
+        want = cpu_pow_hash(CH, NID, int(nonce))
+        assert d[:, k].astype(">u4").tobytes() == want
+        assert k2pow.pow_hash(CH, NID, int(nonce)) == want
 
 
 def test_pow_search_and_verify():

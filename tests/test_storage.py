@@ -1,0 +1,173 @@
+"""Storage layer: migrations, per-entity queries, tx semantics, cache."""
+
+import pytest
+
+from spacemesh_tpu.core import types
+from spacemesh_tpu.storage import atxs, ballots, blocks, cache, db, layers, misc, transactions
+
+
+@pytest.fixture
+def state():
+    return db.open_state()
+
+
+def _atx(epoch=1, node=b"\x01" * 32, units=4):
+    return types.ActivationTx(
+        publish_epoch=epoch, prev_atx=bytes(32), pos_atx=bytes(32),
+        commitment_atx=None, initial_post=None,
+        nipost=types.NIPost(
+            membership=types.MerkleProof(leaf_index=0, nodes=[]),
+            post=types.Post(nonce=0, indices=[1], pow_nonce=0),
+            post_metadata=types.PostMetadataWire(challenge=bytes(32),
+                                                 labels_per_unit=64)),
+        num_units=units, vrf_nonce=7, coinbase=bytes(24), node_id=node,
+        signature=bytes(64))
+
+
+def test_atx_roundtrip(state):
+    a = _atx()
+    atxs.add(state, a, tick_height=100)
+    assert atxs.has(state, a.id)
+    assert atxs.get(state, a.id) == a
+    assert atxs.tick_height(state, a.id) == 100
+    assert atxs.by_node_in_epoch(state, a.node_id, 1) == a
+    assert atxs.ids_in_epoch(state, 1) == [a.id]
+    assert atxs.count_in_epoch(state, 1) == 1
+    assert atxs.count_in_epoch(state, 2) == 0
+    b = _atx(epoch=2)
+    atxs.add(state, b)
+    assert atxs.latest_by_node(state, a.node_id).publish_epoch == 2
+
+
+def test_migration_version_check(tmp_path):
+    path = tmp_path / "s.db"
+    db.open_state(path).close()
+    # a database from a newer build (higher user_version) is refused
+    import sqlite3
+    conn = sqlite3.connect(path)
+    conn.execute("PRAGMA user_version=99")
+    conn.close()
+    with pytest.raises(RuntimeError, match="newer"):
+        db.open_state(path)
+
+
+def test_tx_rollback(state):
+    a = _atx()
+    with pytest.raises(RuntimeError):
+        with state.tx():
+            atxs.add(state, a)
+            raise RuntimeError("boom")
+    assert not atxs.has(state, a.id)
+
+
+def test_layers_and_blocks(state):
+    blk = types.Block(layer=3, tick_height=0, rewards=[], tx_ids=[])
+    blocks.add(state, blk)
+    assert blocks.get(state, blk.id) == blk
+    assert blocks.validity(state, blk.id) == blocks.UNDECIDED
+    blocks.set_valid(state, blk.id)
+    assert blocks.contextually_valid(state, 3) == [blk.id]
+    blocks.set_invalid(state, blk.id)
+    assert blocks.contextually_valid(state, 3) == []
+
+    assert layers.processed(state) == -1
+    layers.set_processed(state, 0)
+    layers.set_processed(state, 1)
+    assert layers.processed(state) == 1
+    layers.set_applied(state, 1, blk.id, b"\x09" * 32)
+    assert layers.applied_block(state, 1) == blk.id
+    assert layers.state_hash(state, 1) == b"\x09" * 32
+    assert layers.last_applied(state) == 1
+
+
+def test_ballots_refballot(state):
+    ed = types.EpochData(beacon=b"\x01\x02\x03\x04",
+                         active_set_root=bytes(32), eligibility_count=3)
+    b1 = types.Ballot(layer=8, atx_id=bytes(32), epoch_data=ed,
+                      ref_ballot=bytes(32), eligibilities=[],
+                      opinion=types.Opinion(base=bytes(32), support=[],
+                                            against=[], abstain=[]),
+                      node_id=b"\x05" * 32, signature=bytes(64))
+    b2 = types.Ballot(layer=9, atx_id=bytes(32), epoch_data=None,
+                      ref_ballot=b1.id, eligibilities=[],
+                      opinion=types.Opinion(base=bytes(32), support=[],
+                                            against=[], abstain=[]),
+                      node_id=b"\x05" * 32, signature=bytes(64))
+    ballots.add(state, b1)
+    ballots.add(state, b2)
+    assert ballots.refballot(state, b"\x05" * 32, 8, 12) == b1
+    assert {b.id for b in ballots.in_layer(state, 9)} == {b2.id}
+
+
+def test_misc_entities(state):
+    misc.set_beacon(state, 2, b"\xaa\xbb\xcc\xdd")
+    assert misc.get_beacon(state, 2) == b"\xaa\xbb\xcc\xdd"
+    assert misc.get_beacon(state, 3) is None
+
+    proof = types.MalfeasanceProof(domain=1, msg1=b"a", sig1=bytes(64),
+                                   msg2=b"b", sig2=bytes(64),
+                                   node_id=b"\x07" * 32)
+    misc.set_malicious(state, b"\x07" * 32, proof)
+    assert misc.is_malicious(state, b"\x07" * 32)
+    assert misc.malfeasance_proof(state, b"\x07" * 32) == proof
+    assert misc.all_malicious(state) == [b"\x07" * 32]
+
+    pp = types.PoetProof(poet_id=bytes(32), round_id="5", root=b"\x01" * 32,
+                         ticks=777)
+    misc.add_poet_proof(state, pp)
+    assert misc.poet_proof(state, pp.id) == pp
+    assert misc.poet_proof_for_round(state, bytes(32), "5") == pp
+
+    misc.add_active_set(state, b"\x0a" * 32, 2, [b"\x01" * 32, b"\x02" * 32])
+    assert misc.active_set(state, b"\x0a" * 32) == [b"\x01" * 32, b"\x02" * 32]
+
+    cert = types.Certificate(block_id=b"\x03" * 32, signatures=[])
+    misc.add_certificate(state, 4, cert)
+    assert misc.certificate(state, 4) == cert
+    assert misc.certified_block(state, 4) == b"\x03" * 32
+
+
+def test_transactions_accounts(state):
+    tx = types.Transaction(raw=b"\x01\x02\x03")
+    transactions.add_tx(state, tx, principal=b"\x0b" * 24, nonce=0)
+    assert transactions.get_tx(state, tx.id) == tx
+    assert transactions.has_tx(state, tx.id)
+    assert len(transactions.pending_by_principal(state, b"\x0b" * 24)) == 1
+    res = types.TransactionResult(status=0, message="", gas_consumed=100,
+                                  fee=5, layer=3, block=bytes(32))
+    transactions.set_result(state, tx.id, 3, bytes(32), res)
+    assert transactions.result(state, tx.id) == res
+    assert transactions.pending_by_principal(state, b"\x0b" * 24) == []
+
+    transactions.update_account(state, b"\x0c" * 24, 1, 100, 0)
+    transactions.update_account(state, b"\x0c" * 24, 5, 80, 1)
+    assert transactions.account(state, b"\x0c" * 24)["balance"] == 80
+    assert transactions.account(state, b"\x0c" * 24, at_layer=3)["balance"] == 100
+    transactions.revert_accounts_above(state, 3)
+    assert transactions.account(state, b"\x0c" * 24)["balance"] == 100
+
+
+def test_atx_cache():
+    c = cache.AtxCache()
+    c.add(2, b"\x01" * 32, cache.AtxInfo(node_id=b"\xaa" * 32, weight=40,
+                                         base_height=0, height=10,
+                                         num_units=4, vrf_nonce=1))
+    c.add(2, b"\x02" * 32, cache.AtxInfo(node_id=b"\xbb" * 32, weight=60,
+                                         base_height=0, height=12,
+                                         num_units=6, vrf_nonce=2))
+    assert c.epoch_weight(2) == 100
+    assert c.weight_for_set(2, [b"\x01" * 32]) == 40
+    c.set_malicious(b"\xaa" * 32)
+    assert c.epoch_weight(2) == 60
+    assert c.is_malicious(b"\xaa" * 32)
+    assert c.get(2, b"\x01" * 32).malicious
+    c.evict(3)
+    assert c.get(2, b"\x01" * 32) is None
+
+
+def test_local_db():
+    local = db.open_local()
+    local.exec("INSERT INTO nipost_state (node_id, phase) VALUES (?,?)",
+               (b"\x01" * 32, 1))
+    assert local.one("SELECT phase FROM nipost_state WHERE node_id=?",
+                     (b"\x01" * 32,))["phase"] == 1
