@@ -1,0 +1,3 @@
+"""Consensus layer: eligibility oracle, beacon, hare, tortoise, certifier,
+malfeasance, plus the mesh/miner/block-generator pipeline they drive
+(SURVEY.md §1 layers 4-6)."""

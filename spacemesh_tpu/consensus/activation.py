@@ -1,0 +1,230 @@
+"""Activation: building and validating ATXs (the identity/weight layer).
+
+Mirrors the reference activation package (SURVEY.md §2.2): the Builder is
+each smesher's per-epoch loop — POST init once, then per epoch: register
+the NIPoST challenge at the poet, wait out the round, prove over the poet
+statement with the POST prover, assemble + sign + publish the ATX
+(reference activation/activation.go:421 run, nipost.go:188 BuildNIPost).
+The Handler ingests gossip/sync ATXs: signature, poet membership, POST
+proof verification (through post/verifier.py — the TPU-vmapped path),
+then store + cache + consensus notifications
+(reference activation/handler.go:189).
+
+Commitment derivation: commitment = blake3(node_id || golden_atx)
+binding the label set to the identity and chain genesis.
+NIPoST challenge for epoch E: blake3(prev_atx_id or zeros || le32(E)).
+POST challenge: blake3(poet_root || nipost_challenge).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import struct
+from typing import Awaitable, Callable, Optional
+
+from ..core import codec
+from ..core.hashing import sum256
+from ..core.signing import Domain, EdSigner, EdVerifier
+from ..core.types import (
+    EMPTY32,
+    ActivationTx,
+    MerkleProof,
+    NIPost,
+    PoetProof,
+    Post,
+    PostMetadataWire,
+)
+from ..p2p.pubsub import TOPIC_ATX, PubSub
+from ..post.prover import Proof as PostProof, ProofParams
+from ..post import verifier as post_verifier
+from ..storage import atxs as atxstore
+from ..storage import misc as miscstore
+from ..storage.cache import AtxCache, AtxInfo
+from ..storage.db import Database
+from .poet import PoetService, verify_membership
+
+
+def commitment_of(node_id: bytes, golden_atx: bytes) -> bytes:
+    return sum256(node_id, golden_atx)
+
+
+def nipost_challenge(prev_atx: bytes, epoch: int) -> bytes:
+    return sum256(prev_atx, struct.pack("<I", epoch))
+
+
+def post_challenge(poet_root: bytes, challenge: bytes) -> bytes:
+    return sum256(poet_root, challenge)
+
+
+class Handler:
+    """Gossip/sync ATX ingestion + validation."""
+
+    def __init__(self, *, db: Database, cache: AtxCache, verifier: EdVerifier,
+                 golden_atx: bytes, post_params: ProofParams,
+                 labels_per_unit: int, scrypt_n: int, pubsub: PubSub,
+                 on_atx: Optional[Callable[[ActivationTx], None]] = None):
+        self.db = db
+        self.cache = cache
+        self.verifier = verifier
+        self.golden_atx = golden_atx
+        self.post_params = post_params
+        self.labels_per_unit = labels_per_unit
+        self.scrypt_n = scrypt_n
+        self.on_atx = on_atx
+        pubsub.register(TOPIC_ATX, self._gossip)
+
+    async def _gossip(self, peer: bytes, data: bytes) -> bool:
+        try:
+            atx = ActivationTx.from_bytes(data)
+        except (codec.DecodeError, ValueError):
+            return False
+        return self.process(atx)
+
+    def process(self, atx: ActivationTx) -> bool:
+        if atxstore.has(self.db, atx.id):
+            return True
+        if not self.verifier.verify(Domain.ATX, atx.node_id,
+                                    atx.signed_bytes(), atx.signature):
+            return False
+        # poet proof must be known and the challenge a member of its round
+        poet = miscstore.poet_proof(self.db, atx.nipost.post_metadata.challenge)
+        if poet is None:
+            return False
+        prev = atx.prev_atx
+        challenge = nipost_challenge(prev, atx.publish_epoch)
+        if not verify_membership(challenge, atx.nipost.membership, poet.root,
+                                 leaf_count=self._leaf_count(poet)):
+            return False
+        # POST proof: recompute labels at spot-checked indices
+        commitment = commitment_of(atx.node_id, self.golden_atx)
+        item = post_verifier.VerifyItem(
+            proof=PostProof(nonce=atx.nipost.post.nonce,
+                            indices=list(atx.nipost.post.indices),
+                            pow_nonce=atx.nipost.post.pow_nonce,
+                            k2=self.post_params.k2),
+            challenge=post_challenge(poet.root, challenge),
+            node_id=atx.node_id, commitment=commitment,
+            scrypt_n=self.scrypt_n,
+            total_labels=atx.num_units * self.labels_per_unit)
+        if not post_verifier.verify(item, self.post_params):
+            return False
+        # double-publish detection (same node, same epoch, different atx)
+        existing = atxstore.by_node_in_epoch(self.db, atx.node_id,
+                                             atx.publish_epoch)
+        if existing is not None and existing.id != atx.id:
+            self.cache.set_malicious(atx.node_id)
+            return False
+        self.store(atx, ticks=poet.ticks)
+        return True
+
+    def _leaf_count(self, poet: PoetProof) -> int:
+        # leaf count travels beside the proof in storage
+        row = self.db.one("SELECT data FROM active_sets WHERE id=?",
+                          (b"poetcnt!" + poet.id[:24],))
+        if row is None:
+            return 1 << 20  # unknown: bounded above, membership still binds
+        return int.from_bytes(row["data"], "little")
+
+    def store(self, atx: ActivationTx, ticks: int) -> None:
+        prev_height = 0
+        if atx.prev_atx != EMPTY32:
+            prev_height = atxstore.tick_height(self.db, atx.prev_atx) or 0
+        height = prev_height + ticks
+        with self.db.tx():
+            atxstore.add(self.db, atx, tick_height=height)
+        self.cache.add(atx.target_epoch(), atx.id, AtxInfo(
+            node_id=atx.node_id, weight=atx.num_units * ticks,
+            base_height=prev_height, height=height, num_units=atx.num_units,
+            vrf_nonce=atx.vrf_nonce, vrf_public_key=atx.vrf_public_key))
+        if self.on_atx:
+            self.on_atx(atx)
+
+
+class Builder:
+    """One smesher's ATX publication loop (single-shot per epoch; the app
+    drives it at epoch boundaries). Multi-identity: one Builder per signer,
+    as the reference registers many signers into one builder."""
+
+    def __init__(self, *, signer: EdSigner, db: Database, pubsub: PubSub,
+                 poet: PoetService, post_client, golden_atx: bytes,
+                 coinbase: bytes, handler: Handler,
+                 num_units: int):
+        self.signer = signer
+        self.db = db
+        self.pubsub = pubsub
+        self.poet = poet
+        self.post_client = post_client   # post.service.PostClient
+        self.golden_atx = golden_atx
+        self.coinbase = coinbase
+        self.handler = handler
+        self.num_units = num_units
+
+    async def build_and_publish(self, publish_epoch: int,
+                                execute_round: bool = False) -> ActivationTx:
+        """One NIPoST cycle for ``publish_epoch``.
+
+        Standalone mode sets execute_round=True: this node drives the poet
+        round itself (reference launchStandalone runs an in-proc poet).
+        """
+        node_id = self.signer.node_id
+        prev = atxstore.latest_by_node(self.db, node_id)
+        prev_id = prev.id if prev is not None else EMPTY32
+        challenge = nipost_challenge(prev_id, publish_epoch)
+        round_id = str(publish_epoch)
+
+        # phase 0: register at the poet before the round starts
+        await self.poet.register(round_id, challenge)
+        # phase 1: poet round runs (await its result)
+        if execute_round:
+            result = await self.poet.execute_round(round_id)
+        else:
+            while (result := self.poet.result(round_id)) is None:
+                await asyncio.sleep(0.05)
+        membership = result.membership(challenge)
+        if membership is None:
+            raise RuntimeError("challenge missing from poet round")
+        # persist the poet proof under the challenge ref the wire carries
+        proof = result.proof
+        with self.db.tx():
+            self.db.exec(
+                "INSERT OR REPLACE INTO poet_proofs (ref, poet_id, round_id,"
+                " ticks, data) VALUES (?,?,?,?,?)",
+                (proof.id, proof.poet_id, proof.round_id, proof.ticks,
+                 proof.to_bytes()))
+            self.db.exec(
+                "INSERT OR REPLACE INTO active_sets (id, epoch, data)"
+                " VALUES (?,?,?)",
+                (b"poetcnt!" + proof.id[:24], publish_epoch,
+                 len(result.members).to_bytes(8, "little")))
+
+        # phase 2: POST proof over the poet statement
+        ch = post_challenge(proof.root, challenge)
+        post_proof, meta = await asyncio.to_thread(self.post_client.proof, ch)
+        info = self.post_client.info()
+
+        atx = ActivationTx(
+            publish_epoch=publish_epoch,
+            prev_atx=prev_id,
+            pos_atx=prev_id if prev is not None else self.golden_atx,
+            commitment_atx=(commitment_of(node_id, self.golden_atx)
+                            if prev is None else None),
+            initial_post=None,
+            nipost=NIPost(
+                membership=membership,
+                post=Post(nonce=post_proof.nonce,
+                          indices=post_proof.indices,
+                          pow_nonce=post_proof.pow_nonce),
+                post_metadata=PostMetadataWire(
+                    challenge=proof.id,
+                    labels_per_unit=info.labels_per_unit)),
+            num_units=info.num_units,
+            vrf_nonce=info.vrf_nonce,
+            vrf_public_key=self.signer.vrf_signer().public_key,
+            coinbase=self.coinbase,
+            node_id=node_id,
+            signature=bytes(64))
+        atx = dataclasses.replace(
+            atx, signature=self.signer.sign(Domain.ATX, atx.signed_bytes()))
+        await self.pubsub.publish(TOPIC_ATX, atx.to_bytes())
+        return atx

@@ -1,0 +1,134 @@
+"""Beacon: per-epoch shared randomness.
+
+Mirrors the reference beacon's role (reference beacon/beacon.go: VRF
+proposal phase, grading, voting rounds with a weak-coin tie break, a
+weighted majority fixing a 4-byte beacon per epoch; fallback to bootstrap
+values when the protocol cannot complete). M2 implements the proposal
+phase + deterministic aggregation (lowest-k VRF proposals hashed); the
+multi-round voting and weak coin land with M4 — the seam (`get`,
+`run_epoch`, the gossip topic) is final.
+
+Genesis epochs 0 and 1 use hash(genesis_id || epoch), as the reference
+does (bootstrap beacon).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import struct
+
+from ..core import codec
+from ..core.codec import fixed, u32
+from ..core.hashing import sum256
+from ..core.signing import vrf_output, VrfVerifier
+from ..p2p.pubsub import TOPIC_BEACON_PROPOSAL, PubSub
+from ..storage import misc as miscstore
+from ..storage.db import Database
+from .eligibility import Oracle
+
+BEACON_SIZE = 4
+K_BEST = 8
+
+
+def proposal_alpha(epoch: int) -> bytes:
+    return b"BEACON" + struct.pack("<I", epoch)
+
+
+@codec.register
+class BeaconProposal:
+    epoch: int
+    atx_id: bytes
+    node_id: bytes
+    vrf_proof: bytes
+
+    FIELDS = [("epoch", u32), ("atx_id", fixed(32)), ("node_id", fixed(32)),
+              ("vrf_proof", fixed(80))]
+
+
+class ProtocolDriver:
+    def __init__(self, *, db: Database, oracle: Oracle, pubsub: PubSub,
+                 genesis_id: bytes, proposal_duration: float = 1.0):
+        self.db = db
+        self.oracle = oracle
+        self.pubsub = pubsub
+        self.genesis_id = genesis_id
+        self.proposal_duration = proposal_duration
+        # epoch -> node_id -> vrf output (dedup: replayed/duplicate
+        # deliveries must not change the lowest-K selection)
+        self._proposals: dict[int, dict[bytes, bytes]] = {}
+        self._ready: dict[int, asyncio.Event] = {}
+        self._vrf = VrfVerifier()
+        pubsub.register(TOPIC_BEACON_PROPOSAL, self._gossip)
+
+    def _bootstrap(self, epoch: int) -> bytes:
+        return sum256(self.genesis_id, struct.pack("<I", epoch))[:BEACON_SIZE]
+
+    async def get(self, epoch: int) -> bytes:
+        """The beacon for ``epoch`` (blocks until decided or bootstraps)."""
+        if epoch <= 1:
+            return self._bootstrap(epoch)
+        stored = miscstore.get_beacon(self.db, epoch)
+        if stored is not None:
+            return stored
+        ev = self._ready.setdefault(epoch, asyncio.Event())
+        try:
+            await asyncio.wait_for(ev.wait(), timeout=self.proposal_duration * 4)
+        except asyncio.TimeoutError:
+            pass
+        stored = miscstore.get_beacon(self.db, epoch)
+        return stored if stored is not None else self._bootstrap(epoch)
+
+    def get_now(self, epoch: int) -> bytes:
+        if epoch <= 1:
+            return self._bootstrap(epoch)
+        stored = miscstore.get_beacon(self.db, epoch)
+        return stored if stored is not None else self._bootstrap(epoch)
+
+    # --- gossip -----------------------------------------------------
+
+    async def _gossip(self, peer: bytes, data: bytes) -> bool:
+        try:
+            msg = BeaconProposal.from_bytes(data)
+        except (codec.DecodeError, ValueError):
+            return False
+        # proposer must hold an ATX targeting this epoch
+        key = self.oracle.vrf_key(msg.epoch, msg.atx_id)
+        if key is None:
+            return False
+        if not self._vrf.verify(key, proposal_alpha(msg.epoch), msg.vrf_proof):
+            return False
+        out = vrf_output(msg.vrf_proof)
+        self._proposals.setdefault(msg.epoch, {}).setdefault(msg.node_id, out)
+        return True
+
+    # --- per-epoch run ----------------------------------------------
+
+    async def run_epoch(self, epoch: int, signer, vrf_signer,
+                        atx_id: bytes | None) -> bytes:
+        """Participate in the protocol for ``epoch`` (call at the start of
+        the last layers of epoch-1, i.e. before it begins; standalone calls
+        it right at epoch start)."""
+        if epoch <= 1:
+            return self._bootstrap(epoch)
+        if atx_id is not None:
+            msg = BeaconProposal(epoch=epoch, atx_id=atx_id,
+                                 node_id=signer.node_id,
+                                 vrf_proof=vrf_signer.prove(proposal_alpha(epoch)))
+            await self.pubsub.publish(TOPIC_BEACON_PROPOSAL, msg.to_bytes())
+        await asyncio.sleep(self.proposal_duration)
+        props = sorted(self._proposals.get(epoch, {}).values())[:K_BEST]
+        if props:
+            beacon = sum256(*props)[:BEACON_SIZE]
+        else:
+            beacon = self._bootstrap(epoch)
+        miscstore.set_beacon(self.db, epoch, beacon)
+        ev = self._ready.setdefault(epoch, asyncio.Event())
+        ev.set()
+        return beacon
+
+    def on_fallback(self, epoch: int, beacon: bytes) -> None:
+        """Bootstrap-provided beacon (reference beacon.go:239 UpdateBeacon)."""
+        if miscstore.get_beacon(self.db, epoch) is None:
+            miscstore.set_beacon(self.db, epoch, beacon)
+            self._ready.setdefault(epoch, asyncio.Event()).set()

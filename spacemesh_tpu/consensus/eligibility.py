@@ -1,0 +1,142 @@
+"""VRF eligibility oracle: proposal slots and hare committees.
+
+Mirrors the reference's two oracles:
+
+- miner slots (reference miner/proposal_builder.go:482 initSignerData +
+  proposals/eligibility_validator.go): an ATX of weight w gets
+  ceil(w * slots_per_epoch / W_total) proposal eligibilities per epoch;
+  slot j's VRF output places it in a layer of the epoch.
+- hare committee (reference hare3/eligibility/oracle.go:344
+  CalcEligibility): per (layer, round), an identity is eligible with
+  probability committee_size * w_i / W_total, decided by its VRF output;
+  the eligibility proof is the VRF signature, verifiable by anyone
+  (oracle.go:297 Validate).
+
+VRF message shapes (domain-separated through the VRF alpha):
+  proposal slot:  "PROP" || beacon || epoch u32 || j u32
+  hare round:     "HARE" || beacon || layer u32 || round u8
+"""
+
+from __future__ import annotations
+
+import struct
+
+from ..core.signing import VrfVerifier, vrf_output
+from ..storage.cache import AtxCache
+
+FIXED = 1 << 52  # fixed-point scale for probability compare
+
+
+def _frac_of_output(out: bytes) -> int:
+    """Map a VRF output to a uniform fixed-point fraction in [0, FIXED)."""
+    return int.from_bytes(out[:8], "little") % FIXED
+
+
+def proposal_alpha(beacon: bytes, epoch: int, j: int) -> bytes:
+    return b"PROP" + beacon + struct.pack("<II", epoch, j)
+
+
+def hare_alpha(beacon: bytes, layer: int, round_: int) -> bytes:
+    return b"HARE" + beacon + struct.pack("<IB", layer, round_)
+
+
+class Oracle:
+    def __init__(self, cache: AtxCache, layers_per_epoch: int,
+                 slots_per_layer: int = 50):
+        self.cache = cache
+        self.layers_per_epoch = layers_per_epoch
+        self.slots_per_layer = slots_per_layer
+        self._vrf = VrfVerifier()
+
+    # --- proposal eligibility -----------------------------------------
+
+    def num_slots(self, epoch: int, atx_id: bytes) -> int:
+        """Proposal slots for this ATX in the epoch (weight-proportional,
+        minimum 1 for any active ATX)."""
+        info = self.cache.get(epoch, atx_id)
+        if info is None or info.malicious:
+            return 0
+        total = self.cache.epoch_weight(epoch)
+        if total == 0:
+            return 0
+        slots_per_epoch = self.slots_per_layer * self.layers_per_epoch
+        return max(1, info.weight * slots_per_epoch // total)
+
+    def slot_layer(self, epoch: int, vrf_proof: bytes) -> int:
+        """The layer (within the epoch) where a proposal slot lands."""
+        out = vrf_output(vrf_proof)
+        first = epoch * self.layers_per_epoch
+        return first + int.from_bytes(out[8:16], "little") % self.layers_per_epoch
+
+    def eligible_slots_for_layer(self, vrf_signer, beacon: bytes, epoch: int,
+                                 atx_id: bytes, layer: int) -> list[tuple[int, bytes]]:
+        """All (j, proof) proposal slots of this signer landing in ``layer``."""
+        out = []
+        for j in range(self.num_slots(epoch, atx_id)):
+            proof = vrf_signer.prove(proposal_alpha(beacon, epoch, j))
+            if self.slot_layer(epoch, proof) == layer:
+                out.append((j, proof))
+        return out
+
+    def vrf_key(self, epoch: int, atx_id: bytes) -> bytes | None:
+        info = self.cache.get(epoch, atx_id)
+        return info.vrf_public_key if info else None
+
+    def validate_slot(self, beacon: bytes, epoch: int, atx_id: bytes,
+                      layer: int, j: int, proof: bytes) -> bool:
+        key = self.vrf_key(epoch, atx_id)
+        if key is None or j >= self.num_slots(epoch, atx_id):
+            return False
+        if not self._vrf.verify(key, proposal_alpha(beacon, epoch, j), proof):
+            return False
+        return self.slot_layer(epoch, proof) == layer
+
+    # --- hare committee ------------------------------------------------
+
+    def _expected_slots(self, epoch: int, atx_id: bytes,
+                        committee_size: int) -> tuple[int, int]:
+        """(whole slots, fractional part in FIXED) of this identity's
+        expected committee seats: committee * w_i / W (the reference's
+        binomial sampling by weight, oracle.go:344, in expectation)."""
+        info = self.cache.get(epoch, atx_id)
+        if info is None or info.malicious:
+            return 0, 0
+        total = self.cache.epoch_weight(epoch)
+        if total == 0:
+            return 0, 0
+        whole = committee_size * info.weight // total
+        frac = (committee_size * info.weight * FIXED // total) % FIXED
+        return whole, frac
+
+    def _count_from_proof(self, proof: bytes, whole: int, frac: int) -> int:
+        """Deterministic seat count derived from the VRF output: the
+        fractional expected seat materializes iff the uniform draw falls
+        under it — both prover and validator compute the same count."""
+        extra = 1 if _frac_of_output(vrf_output(proof)) < frac else 0
+        return whole + extra
+
+    def hare_eligibility(self, vrf_signer, beacon: bytes, layer: int,
+                         round_: int, epoch: int, atx_id: bytes,
+                         committee_size: int) -> tuple[bytes, int] | None:
+        """(VRF proof, seat count) if on the committee, else None."""
+        whole, frac = self._expected_slots(epoch, atx_id, committee_size)
+        if whole == 0 and frac == 0:
+            return None
+        proof = vrf_signer.prove(hare_alpha(beacon, layer, round_))
+        count = self._count_from_proof(proof, whole, frac)
+        return (proof, count) if count > 0 else None
+
+    def validate_hare(self, beacon: bytes, layer: int, round_: int,
+                      epoch: int, atx_id: bytes, committee_size: int,
+                      proof: bytes, claimed_count: int) -> bool:
+        """Membership AND the claimed seat count must match the proof —
+        the count is derived, never trusted (a forged count would multiply
+        an attacker's vote weight)."""
+        key = self.vrf_key(epoch, atx_id)
+        if key is None:
+            return False
+        if not self._vrf.verify(key, hare_alpha(beacon, layer, round_), proof):
+            return False
+        whole, frac = self._expected_slots(epoch, atx_id, committee_size)
+        return (claimed_count > 0
+                and claimed_count == self._count_from_proof(proof, whole, frac))

@@ -1,0 +1,242 @@
+"""Hare: per-layer BFT agreement on the proposal set.
+
+Mirrors the reference hare's role and message flow (reference hare4/: a
+per-layer session of VRF-eligible committee members running
+preround -> [propose -> commit -> notify]* and emitting a ConsensusOutput
+of proposal ids consumed by the block generator, hare4/hare.go:708; round
+state machine hare4/protocol.go; equivocation -> malfeasance). The round
+structure here is the classic hare:
+
+  PREROUND  everyone eligible broadcasts its proposal-id set
+  PROPOSE   the leader (lowest VRF output among round-eligible members)
+            proposes the union of preround sets it saw
+  COMMIT    members that accept the proposal commit to it
+  NOTIFY    threshold weight of commits -> notify; threshold of notifies
+            (or a valid commit certificate) -> output
+
+Weights are eligibility counts; the threshold is > half the committee
+size. Rounds are wall-clock slots within the layer (round_duration), so
+all honest nodes move in lockstep like the reference's 700 ms rounds.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+from typing import Awaitable, Callable, Optional
+
+from ..core import codec
+from ..core.codec import fixed, u8, u16, u32, vec
+from ..core.signing import Domain, EdSigner, EdVerifier
+from ..core.types import EMPTY32
+from ..p2p.pubsub import TOPIC_HARE, PubSub
+from .eligibility import Oracle
+
+PREROUND, PROPOSE, COMMIT, NOTIFY = 0, 1, 2, 3
+
+
+@codec.register
+class HareMessage:
+    layer: int
+    iteration: int
+    round: int
+    values: list[bytes]          # proposal ids (sorted)
+    eligibility_proof: bytes     # VRF
+    eligibility_count: int
+    atx_id: bytes
+    node_id: bytes
+    signature: bytes
+
+    FIELDS = [("layer", u32), ("iteration", u8), ("round", u8),
+              ("values", vec(fixed(32), 1 << 12)),
+              ("eligibility_proof", fixed(80)), ("eligibility_count", u16),
+              ("atx_id", fixed(32)), ("node_id", fixed(32)),
+              ("signature", fixed(64))]
+
+    def signed_bytes(self) -> bytes:
+        return dataclasses.replace(self, signature=bytes(64)).to_bytes()
+
+
+@dataclasses.dataclass
+class ConsensusOutput:
+    layer: int
+    proposals: list[bytes]       # agreed proposal ids (may be empty)
+
+
+@dataclasses.dataclass
+class Equivocation:
+    node_id: bytes
+    msg1: bytes
+    sig1: bytes
+    msg2: bytes
+    sig2: bytes
+
+
+class HareSession:
+    """One layer's protocol instance."""
+
+    def __init__(self, hare: "Hare", layer: int, proposals: list[bytes]):
+        self.h = hare
+        self.layer = layer
+        self.my_proposals = sorted(proposals)
+        self.preround_sets: dict[bytes, tuple[int, list[bytes]]] = {}
+        self.proposed: Optional[list[bytes]] = None
+        self.commits: dict[bytes, tuple[int, tuple]] = {}
+        self.notifies: dict[bytes, tuple[int, tuple]] = {}
+        self.output: Optional[list[bytes]] = None
+        self.seen: dict[tuple, tuple[bytes, bytes]] = {}  # equivocation watch
+
+    # --- message handling ------------------------------------------
+
+    def on_message(self, msg: HareMessage) -> None:
+        key = (msg.node_id, msg.iteration, msg.round)
+        prev = self.seen.get(key)
+        raw = msg.signed_bytes()
+        if prev is not None and prev[0] != raw:
+            self.h._report_equivocation(msg, prev)
+            return
+        self.seen[key] = (raw, msg.signature)
+        w = msg.eligibility_count
+        if msg.round == PREROUND:
+            self.preround_sets[msg.node_id] = (w, msg.values)
+        elif msg.round == PROPOSE:
+            # first valid proposal wins (leader ties broken by arrival,
+            # matching gossip order; a VRF-lowest rule lands with hare4
+            # compaction in M4)
+            if self.proposed is None:
+                self.proposed = sorted(msg.values)
+        elif msg.round == COMMIT:
+            self.commits[msg.node_id] = (w, tuple(msg.values))
+        elif msg.round == NOTIFY:
+            self.notifies[msg.node_id] = (w, tuple(msg.values))
+
+    # --- round actions ---------------------------------------------
+
+    def candidates(self) -> list[bytes]:
+        union: set[bytes] = set(self.my_proposals)
+        for _, values in self.preround_sets.values():
+            union.update(values)
+        return sorted(union)
+
+    def commit_weight(self, values: tuple) -> int:
+        return sum(w for w, v in self.commits.values() if v == values)
+
+    def notify_weight(self, values: tuple) -> int:
+        return sum(w for w, v in self.notifies.values() if v == values)
+
+
+class Hare:
+    def __init__(self, *, signer: EdSigner, verifier: EdVerifier,
+                 oracle: Oracle, pubsub: PubSub, committee_size: int,
+                 round_duration: float, iteration_limit: int,
+                 layers_per_epoch: int,
+                 beacon_of: Callable[[int], Awaitable[bytes]],
+                 atx_for: Callable[[int], Optional[bytes]],
+                 proposals_for: Callable[[int], list[bytes]],
+                 on_output: Callable[[ConsensusOutput], Awaitable[None]],
+                 on_equivocation=None):
+        self.signer = signer
+        self.verifier = verifier
+        self.oracle = oracle
+        self.pubsub = pubsub
+        self.committee = committee_size
+        self.round_duration = round_duration
+        self.iteration_limit = iteration_limit
+        self.layers_per_epoch = layers_per_epoch
+        self.beacon_of = beacon_of
+        self.atx_for = atx_for
+        self.proposals_for = proposals_for
+        self.on_output = on_output
+        self.on_equivocation = on_equivocation
+        self.sessions: dict[int, HareSession] = {}
+        pubsub.register(TOPIC_HARE, self._gossip)
+
+    # --- gossip ingestion ------------------------------------------
+
+    async def _gossip(self, peer: bytes, data: bytes) -> bool:
+        try:
+            msg = HareMessage.from_bytes(data)
+        except (codec.DecodeError, ValueError):
+            return False
+        if not self.verifier.verify(Domain.HARE, msg.node_id,
+                                    msg.signed_bytes(), msg.signature):
+            return False
+        epoch = msg.layer // self.layers_per_epoch
+        beacon = await self.beacon_of(epoch)
+        round_tag = msg.iteration * 4 + msg.round
+        if not self.oracle.validate_hare(
+                beacon, msg.layer, round_tag, epoch, msg.atx_id,
+                self.committee, msg.eligibility_proof,
+                msg.eligibility_count):
+            return False
+        session = self.sessions.get(msg.layer)
+        if session is not None:
+            session.on_message(msg)
+        return True
+
+    def _report_equivocation(self, msg: HareMessage, prev) -> None:
+        if self.on_equivocation:
+            self.on_equivocation(Equivocation(
+                node_id=msg.node_id, msg1=prev[0], sig1=prev[1],
+                msg2=msg.signed_bytes(), sig2=msg.signature))
+
+    # --- session driving -------------------------------------------
+
+    async def run_layer(self, layer: int) -> ConsensusOutput:
+        """Run the full session for a layer (call at layer start)."""
+        epoch = layer // self.layers_per_epoch
+        beacon = await self.beacon_of(epoch)
+        atx = self.atx_for(epoch)
+        session = HareSession(self, layer, self.proposals_for(layer))
+        self.sessions[layer] = session
+        vrf = self.signer.vrf_signer()
+
+        async def maybe_send(iteration: int, round_: int, values: list[bytes]):
+            if atx is None:
+                return
+            round_tag = iteration * 4 + round_
+            el = self.oracle.hare_eligibility(
+                vrf, beacon, layer, round_tag, epoch, atx, self.committee)
+            if el is None:
+                return
+            proof, count = el
+            msg = HareMessage(
+                layer=layer, iteration=iteration, round=round_,
+                values=sorted(values), eligibility_proof=proof,
+                eligibility_count=count, atx_id=atx,
+                node_id=self.signer.node_id, signature=bytes(64))
+            msg.signature = self.signer.sign(Domain.HARE, msg.signed_bytes())
+            await self.pubsub.publish(TOPIC_HARE, msg.to_bytes())
+
+        # > half the committee seats. Seat counts are weight-derived (the
+        # committee's total seats sum to ~committee_size network-wide), so
+        # the same constant is safe for any network size — a lone smesher
+        # with all the weight holds ~all committee seats itself.
+        threshold = self.committee // 2 + 1
+
+        await maybe_send(0, PREROUND, session.my_proposals)
+        await asyncio.sleep(self.round_duration)
+
+        for it in range(self.iteration_limit):
+            # PROPOSE (leader: anyone eligible; first arrival wins)
+            await maybe_send(it, PROPOSE, session.candidates())
+            await asyncio.sleep(self.round_duration)
+            proposal = session.proposed or session.candidates()
+            # COMMIT
+            await maybe_send(it, COMMIT, proposal)
+            await asyncio.sleep(self.round_duration)
+            committed = tuple(sorted(proposal))
+            have = session.commit_weight(committed)
+            # NOTIFY happens if enough commit weight was observed
+            if have >= threshold:
+                await maybe_send(it, NOTIFY, list(committed))
+            await asyncio.sleep(self.round_duration)
+            if session.notify_weight(committed) >= threshold:
+                session.output = list(committed)
+                break
+
+        out = ConsensusOutput(layer=layer,
+                              proposals=session.output or [])
+        await self.on_output(out)
+        del self.sessions[layer]
+        return out

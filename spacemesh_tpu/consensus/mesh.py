@@ -1,0 +1,153 @@
+"""Mesh: the per-layer DAG bookkeeping + state application.
+
+Mirrors reference mesh/ (mesh.go:302 ProcessLayer applies tortoise
+updates and reverts on reorg; :497 per-hare-output fast path; executor.go
+runs the VM optimistically on hare output) and proposals/store (in-RAM
+current-epoch proposal store with eviction).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Optional
+
+from ..core.types import Block, Proposal, Reward, Transaction
+from ..storage import blocks as blockstore
+from ..storage import layers as layerstore
+from ..storage import transactions as txstore
+from ..storage.cache import AtxCache
+from ..storage.db import Database
+from ..txs import ConservativeState
+from ..vm import VM
+from .hare import ConsensusOutput
+from .tortoise import EMPTY, Tortoise
+
+
+class ProposalStore:
+    """In-RAM proposals for recent layers (reference proposals/store)."""
+
+    def __init__(self) -> None:
+        self._by_layer: dict[int, dict[bytes, Proposal]] = {}
+        self._lock = threading.RLock()
+
+    def add(self, p: Proposal) -> None:
+        with self._lock:
+            self._by_layer.setdefault(p.ballot.layer, {})[p.id] = p
+
+    def get(self, pid: bytes) -> Optional[Proposal]:
+        with self._lock:
+            for layer in self._by_layer.values():
+                if pid in layer:
+                    return layer[pid]
+        return None
+
+    def in_layer(self, layer: int) -> list[Proposal]:
+        with self._lock:
+            return list(self._by_layer.get(layer, {}).values())
+
+    def ids_in_layer(self, layer: int) -> list[bytes]:
+        with self._lock:
+            return sorted(self._by_layer.get(layer, {}))
+
+    def evict(self, before_layer: int) -> None:
+        with self._lock:
+            for lyr in [x for x in self._by_layer if x < before_layer]:
+                del self._by_layer[lyr]
+
+
+class Executor:
+    """Optimistic block execution (reference mesh/executor.go)."""
+
+    def __init__(self, db: Database, vm: VM, cstate: ConservativeState):
+        self.db = db
+        self.vm = vm
+        self.cstate = cstate
+
+    def execute(self, block: Block) -> bytes:
+        txs = []
+        for tx_id in block.tx_ids:
+            tx = self.cstate.get(tx_id)
+            if tx is not None:
+                txs.append(tx)
+        _, root = self.vm.apply(block.layer, block.id, txs,
+                                list(block.rewards))
+        layerstore.set_applied(self.db, block.layer, block.id, root)
+        self.cstate.on_applied()
+        return root
+
+    def execute_empty(self, layer: int) -> bytes:
+        prev = layerstore.state_hash(self.db, layer - 1) or bytes(32)
+        layerstore.set_applied(self.db, layer, EMPTY, prev)
+        return prev
+
+    def revert(self, to_layer: int) -> None:
+        self.vm.revert(to_layer)
+        self.db.exec("DELETE FROM layers WHERE id>?", (to_layer,))
+
+
+class Mesh:
+    def __init__(self, *, db: Database, tortoise: Tortoise,
+                 executor: Executor, proposals: ProposalStore,
+                 cache: AtxCache):
+        self.db = db
+        self.tortoise = tortoise
+        self.executor = executor
+        self.proposals = proposals
+        self.cache = cache
+        self.latest_applied = 0
+
+    def add_block(self, block: Block) -> None:
+        with self.db.tx():
+            blockstore.add(self.db, block)
+        self.tortoise.on_block(block.layer, block.id)
+
+    def process_hare_output(self, block: Optional[Block], layer: int) -> None:
+        """Fast path: hare agreed -> apply immediately (reference
+        mesh.go:497 ProcessLayerPerHareOutput)."""
+        if block is None:
+            self.tortoise.on_hare_output(layer, EMPTY)
+            if self.latest_applied == layer - 1:
+                self.executor.execute_empty(layer)
+                self.latest_applied = layer
+        else:
+            self.add_block(block)
+            self.tortoise.on_hare_output(layer, block.id)
+            if self.latest_applied == layer - 1:
+                self.executor.execute(block)
+                self.latest_applied = layer
+        layerstore.set_processed(self.db, layer)
+
+    def process_layer(self, layer: int) -> None:
+        """Tortoise-driven path: tally votes, apply validity updates,
+        revert + reapply on opinion change (reference mesh.go:302)."""
+        self.tortoise.tally_votes(layer)
+        min_changed = None
+        for upd in self.tortoise.updates():
+            with self.db.tx():
+                if upd.valid:
+                    blockstore.set_valid(self.db, upd.block_id)
+                else:
+                    blockstore.set_invalid(self.db, upd.block_id)
+            applied = layerstore.applied_block(self.db, upd.layer)
+            should = self._block_to_apply(upd.layer)
+            if applied is not None and applied != should:
+                if min_changed is None or upd.layer < min_changed:
+                    min_changed = upd.layer
+        if min_changed is not None:
+            self._reapply_from(min_changed)
+
+    def _block_to_apply(self, layer: int) -> bytes:
+        valid = self.tortoise.valid_blocks(layer)
+        return valid[0] if valid else EMPTY
+
+    def _reapply_from(self, layer: int) -> None:
+        self.executor.revert(layer - 1)
+        for lyr in range(layer, self.latest_applied + 1):
+            bid = self._block_to_apply(lyr)
+            if bid == EMPTY:
+                self.executor.execute_empty(lyr)
+            else:
+                block = blockstore.get(self.db, bid)
+                if block is not None:
+                    self.executor.execute(block)

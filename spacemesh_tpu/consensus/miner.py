@@ -1,0 +1,172 @@
+"""Miner: the proposal builder.
+
+Mirrors reference miner/proposal_builder.go: on each layer tick, for each
+registered signer, compute the VRF eligibility slots landing in this layer
+(:482 initSignerData), select txs from the conservative state, encode
+tortoise votes, assemble + sign + publish the Proposal (:549 build). The
+first ballot of an epoch carries EpochData (beacon + active-set root);
+later ballots reference it.
+
+Also the proposal gossip handler (reference proposals/handler.go):
+validates incoming ballots (signature, slot eligibility via the oracle),
+stores the proposal, and feeds the ballot to the tortoise with its
+eligibility weight.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from ..core import codec
+from ..core.hashing import sum256
+from ..core.signing import Domain, EdSigner, EdVerifier
+from ..core.types import (
+    EMPTY32,
+    Ballot,
+    EpochData,
+    Proposal,
+    VotingEligibility,
+)
+from ..p2p.pubsub import TOPIC_PROPOSAL, PubSub
+from ..storage import atxs as atxstore
+from ..storage import ballots as ballotstore
+from ..storage.cache import AtxCache
+from ..storage.db import Database
+from ..txs import ConservativeState
+from .eligibility import Oracle
+from .mesh import ProposalStore
+from .tortoise import Tortoise
+
+MAX_TXS_PER_PROPOSAL = 700
+
+
+def active_set_root(atx_ids: list[bytes]) -> bytes:
+    return sum256(*sorted(atx_ids)) if atx_ids else bytes(32)
+
+
+class ProposalBuilder:
+    def __init__(self, *, signer: EdSigner, db: Database, cache: AtxCache,
+                 oracle: Oracle, tortoise: Tortoise,
+                 cstate: ConservativeState, pubsub: PubSub,
+                 layers_per_epoch: int, beacon_getter):
+        self.signer = signer
+        self.db = db
+        self.cache = cache
+        self.oracle = oracle
+        self.tortoise = tortoise
+        self.cstate = cstate
+        self.pubsub = pubsub
+        self.layers_per_epoch = layers_per_epoch
+        self.beacon_getter = beacon_getter
+
+    def own_atx(self, epoch: int) -> Optional[bytes]:
+        for atx_id, info in self.cache.iter_epoch(epoch):
+            if info.node_id == self.signer.node_id:
+                return atx_id
+        return None
+
+    async def build(self, layer: int) -> Optional[Proposal]:
+        epoch = layer // self.layers_per_epoch
+        atx_id = self.own_atx(epoch)
+        if atx_id is None:
+            return None
+        beacon = await self.beacon_getter(epoch)
+        vrf = self.signer.vrf_signer()
+        slots = self.oracle.eligible_slots_for_layer(
+            vrf, beacon, epoch, atx_id, layer)
+        if not slots:
+            return None
+
+        epoch_start = epoch * self.layers_per_epoch
+        ref = ballotstore.refballot(self.db, self.signer.node_id,
+                                    epoch_start, epoch_start + self.layers_per_epoch)
+        epoch_data = None
+        ref_id = EMPTY32
+        if ref is None:
+            active = [a for a, _ in self.cache.iter_epoch(epoch)]
+            epoch_data = EpochData(
+                beacon=beacon, active_set_root=active_set_root(active),
+                eligibility_count=self.oracle.num_slots(epoch, atx_id))
+        else:
+            ref_id = ref.id
+
+        ballot = Ballot(
+            layer=layer, atx_id=atx_id, epoch_data=epoch_data,
+            ref_ballot=ref_id,
+            eligibilities=[VotingEligibility(j=j, sig=proof)
+                           for j, proof in slots],
+            opinion=self.tortoise.encode_votes(layer),
+            node_id=self.signer.node_id, signature=bytes(64))
+        ballot = dataclasses.replace(
+            ballot,
+            signature=self.signer.sign(Domain.BALLOT, ballot.signed_bytes()))
+        proposal = Proposal(
+            ballot=ballot,
+            tx_ids=self.cstate.select_proposal_txs(MAX_TXS_PER_PROPOSAL),
+            mesh_hash=bytes(32), signature=bytes(64))
+        proposal = dataclasses.replace(
+            proposal, signature=self.signer.sign(Domain.BALLOT,
+                                                 proposal.signed_bytes()))
+        await self.pubsub.publish(TOPIC_PROPOSAL, proposal.to_bytes())
+        return proposal
+
+
+class ProposalHandler:
+    def __init__(self, *, db: Database, cache: AtxCache, oracle: Oracle,
+                 tortoise: Tortoise, store: ProposalStore,
+                 verifier: EdVerifier, pubsub: PubSub,
+                 layers_per_epoch: int, beacon_getter,
+                 on_malfeasance=None):
+        self.db = db
+        self.cache = cache
+        self.oracle = oracle
+        self.tortoise = tortoise
+        self.store = store
+        self.verifier = verifier
+        self.layers_per_epoch = layers_per_epoch
+        self.beacon_getter = beacon_getter
+        self.on_malfeasance = on_malfeasance
+        pubsub.register(TOPIC_PROPOSAL, self._gossip)
+
+    async def _gossip(self, peer: bytes, data: bytes) -> bool:
+        try:
+            proposal = Proposal.from_bytes(data)
+        except (codec.DecodeError, ValueError):
+            return False
+        return await self.process(proposal)
+
+    async def process(self, proposal: Proposal) -> bool:
+        ballot = proposal.ballot
+        if not self.verifier.verify(Domain.BALLOT, ballot.node_id,
+                                    ballot.signed_bytes(), ballot.signature):
+            return False
+        if not self.verifier.verify(Domain.BALLOT, ballot.node_id,
+                                    proposal.signed_bytes(),
+                                    proposal.signature):
+            return False
+        epoch = ballot.layer // self.layers_per_epoch
+        info = self.cache.get(epoch, ballot.atx_id)
+        if info is None or info.node_id != ballot.node_id:
+            return False
+        beacon = await self.beacon_getter(epoch)
+        for el in ballot.eligibilities:
+            if not self.oracle.validate_slot(beacon, epoch, ballot.atx_id,
+                                             ballot.layer, el.j, el.sig):
+                return False
+        # double ballot in one (layer, signer) slot set -> malfeasance
+        existing = ballotstore.by_node_in_layer(self.db, ballot.node_id,
+                                                ballot.layer)
+        for other in existing:
+            if other.id != ballot.id:
+                self.cache.set_malicious(ballot.node_id)
+                if self.on_malfeasance:
+                    self.on_malfeasance(ballot.node_id, other, ballot)
+                return False
+        with self.db.tx():
+            ballotstore.add(self.db, ballot)
+        self.store.add(proposal)
+        num_slots = self.oracle.num_slots(epoch, ballot.atx_id)
+        unit = info.weight // max(num_slots, 1)
+        self.tortoise.on_ballot(ballot, unit * len(ballot.eligibilities))
+        return True

@@ -1,0 +1,249 @@
+"""Tortoise: self-healing vote-counting finality.
+
+Mirrors the reference tortoise's contract (reference tortoise/algorithm.go
+public facade: OnAtx/OnBallot/OnBlock/OnBeacon/OnHareOutput/TallyVotes/
+EncodeVotes/Updates/Results; verifying mode counts ballot opinions toward a
+weight threshold, tortoise/verifying.go; opinions are encoded relative to a
+base ballot with exception lists, tortoise/opinion; a JSON tracer records
+every input for offline replay, tortoise/tracer.go).
+
+This implementation materializes each ballot's full opinion (base chain
+resolved at ingestion), keeps a sliding window of layers, and advances the
+verified frontier when every block decision in a layer clears the margin
+threshold — a faithful verifying tortoise. Full-mode recount (healing after
+partitions) re-tallies from the materialized opinions, since they are kept
+for the whole window.
+
+Local opinion: within hdist of the tip, hare outputs are trusted
+(reference tortoise counts them as the node's own opinion); beyond, only
+accumulated ballot weight decides.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Callable, Optional
+
+from ..core.types import Ballot, Opinion
+from ..storage.cache import AtxCache
+
+EMPTY = bytes(32)  # "layer is empty" sentinel in hare outputs
+
+SUPPORT, AGAINST, ABSTAIN = 1, -1, 0
+
+
+@dataclasses.dataclass
+class BallotInfo:
+    id: bytes
+    layer: int
+    weight: int
+    # layer -> set of supported block ids (full, base-resolved)
+    supports: dict[int, set[bytes]]
+    abstains: set[int]
+    malicious: bool = False
+
+
+@dataclasses.dataclass
+class Update:
+    layer: int
+    block_id: bytes       # EMPTY for "layer verified empty"
+    valid: bool
+
+
+class Tortoise:
+    def __init__(self, cache: AtxCache, layers_per_epoch: int, hdist: int = 10,
+                 window: int = 1000,
+                 tracer: Optional[Callable[[str], None]] = None):
+        self.cache = cache
+        self.layers_per_epoch = layers_per_epoch
+        self.hdist = hdist
+        self.window = window
+        self._trace = tracer
+        self.verified = 0           # highest fully-decided layer
+        self.processed = 0
+        self._ballots: dict[bytes, BallotInfo] = {}
+        self._ballots_by_layer: dict[int, list[bytes]] = {}
+        self._blocks: dict[int, set[bytes]] = {}
+        self._hare: dict[int, bytes] = {}
+        self._validity: dict[bytes, bool] = {}
+        self._updates: list[Update] = []
+        self._epoch_weight: dict[int, int] = {}
+
+    # --- tracing -------------------------------------------------------
+
+    def _t(self, kind: str, **kw) -> None:
+        if self._trace:
+            enc = {k: (v.hex() if isinstance(v, bytes) else v)
+                   for k, v in kw.items()}
+            self._trace(json.dumps({"ev": kind, **enc}, sort_keys=True))
+
+    # --- inputs --------------------------------------------------------
+
+    def on_block(self, layer: int, block_id: bytes) -> None:
+        self._t("block", layer=layer, id=block_id)
+        self._blocks.setdefault(layer, set()).add(block_id)
+
+    def on_hare_output(self, layer: int, block_id: bytes) -> None:
+        self._t("hare", layer=layer, id=block_id)
+        self._hare[layer] = block_id
+
+    def on_malfeasance(self, node_id: bytes) -> None:
+        self._t("malfeasance", id=node_id)
+        self.cache.set_malicious(node_id)
+
+    def on_ballot(self, ballot: Ballot, weight: int) -> None:
+        """Resolve the ballot's opinion against its base and store it."""
+        bid = ballot.id
+        if bid in self._ballots:
+            return
+        self._t("ballot", layer=ballot.layer, id=bid, weight=weight,
+                base=ballot.opinion.base)
+        base = self._ballots.get(ballot.opinion.base)
+        supports: dict[int, set[bytes]] = {}
+        abstains: set[int] = set()
+        if base is not None:
+            supports = {lyr: set(s) for lyr, s in base.supports.items()}
+            abstains = set(base.abstains)
+        block_layers = {b: lyr for lyr, blocks in self._blocks.items()
+                        for b in blocks}
+        for b in ballot.opinion.support:
+            lyr = block_layers.get(b)
+            if lyr is not None:
+                supports.setdefault(lyr, set()).add(b)
+                abstains.discard(lyr)
+        for b in ballot.opinion.against:
+            lyr = block_layers.get(b)
+            if lyr is not None and lyr in supports:
+                supports[lyr].discard(b)
+        for lyr in ballot.opinion.abstain:
+            abstains.add(lyr)
+            supports.pop(lyr, None)
+        info = BallotInfo(
+            id=bid, layer=ballot.layer, weight=weight, supports=supports,
+            abstains=abstains,
+            malicious=self.cache.is_malicious(ballot.node_id))
+        self._ballots[bid] = info
+        self._ballots_by_layer.setdefault(ballot.layer, []).append(bid)
+
+    # --- counting ------------------------------------------------------
+
+    def _threshold(self, target_layer: int, last: int) -> int:
+        """Margin needed: a fraction of the ballot weight expected between
+        the target and the tip (reference tortoise/threshold.go)."""
+        epoch = target_layer // self.layers_per_epoch
+        w = self.cache.epoch_weight(epoch)
+        if w == 0:
+            return 1
+        span = max(last - target_layer, 1)
+        per_layer = w // self.layers_per_epoch or 1
+        return max(per_layer * min(span, self.window) // 3, 1)
+
+    def _margin(self, target_layer: int, block_id: bytes, last: int) -> int:
+        m = 0
+        for lyr in range(target_layer + 1, last + 1):
+            for bid in self._ballots_by_layer.get(lyr, ()):
+                info = self._ballots[bid]
+                if info.malicious:
+                    continue
+                if target_layer in info.abstains:
+                    continue
+                sup = info.supports.get(target_layer, set())
+                m += info.weight if block_id in sup else -info.weight
+        return m
+
+    def tally_votes(self, last: int) -> None:
+        """Advance the verified frontier up to ``last`` - 1."""
+        self.processed = max(self.processed, last)
+        self._t("tally", last=last)
+        frontier = self.verified
+        for layer in range(self.verified + 1, last):
+            decided_all = True
+            blocks = self._blocks.get(layer, set())
+            t = self._threshold(layer, last)
+            for b in sorted(blocks):
+                margin = self._margin(layer, b, last)
+                if margin > t:
+                    decided = True
+                elif margin < -t:
+                    decided = False
+                elif last - layer < self.hdist and layer in self._hare:
+                    decided = self._hare[layer] == b
+                else:
+                    decided_all = False
+                    continue
+                if self._validity.get(b) != decided:
+                    self._validity[b] = decided
+                    self._updates.append(Update(layer, b, decided))
+            if not blocks:
+                # empty layer: decided by hare's "empty" or by abstain decay
+                if layer in self._hare and self._hare[layer] == EMPTY:
+                    pass
+                elif last - layer < self.hdist:
+                    decided_all = False
+            if decided_all:
+                frontier = layer
+            else:
+                break
+        if frontier != self.verified:
+            self.verified = frontier
+            self._t("verified", layer=frontier)
+        self._evict()
+
+    def _evict(self) -> None:
+        low = self.verified - self.window
+        for store in (self._ballots_by_layer, self._blocks):
+            for lyr in [x for x in store if x < low]:
+                if store is self._ballots_by_layer:
+                    for bid in store[lyr]:
+                        self._ballots.pop(bid, None)
+                del store[lyr]
+
+    def updates(self) -> list[Update]:
+        out, self._updates = self._updates, []
+        return out
+
+    def valid_blocks(self, layer: int) -> list[bytes]:
+        return sorted(b for b in self._blocks.get(layer, set())
+                      if self._validity.get(b))
+
+    def is_valid(self, block_id: bytes) -> bool:
+        return bool(self._validity.get(block_id))
+
+    # --- vote encoding -------------------------------------------------
+
+    def encode_votes(self, for_layer: int) -> Opinion:
+        """Build the opinion for a new ballot in ``for_layer``: pick the
+        newest known ballot as base, express the local opinion (hare within
+        hdist, validity beyond) as exceptions (reference
+        tortoise/algorithm.go:EncodeVotes)."""
+        base_id = EMPTY
+        base_info = None
+        for lyr in sorted(self._ballots_by_layer, reverse=True):
+            if lyr >= for_layer:
+                continue
+            cands = [self._ballots[b] for b in self._ballots_by_layer[lyr]
+                     if not self._ballots[b].malicious]
+            if cands:
+                base_info = max(cands, key=lambda i: (i.weight, i.id))
+                base_id = base_info.id
+                break
+        support, against, abstain = [], [], []
+        start = max(1, for_layer - self.window)
+        for lyr in range(start, for_layer):
+            local: set[bytes] = set()
+            if lyr in self._hare and self.processed - lyr < self.hdist:
+                if self._hare[lyr] != EMPTY:
+                    local = {self._hare[lyr]}
+            else:
+                local = {b for b in self._blocks.get(lyr, set())
+                         if self._validity.get(b)}
+                if not local and lyr > self.verified and lyr not in self._hare:
+                    abstain.append(lyr)
+                    continue
+            base_sup = (base_info.supports.get(lyr, set())
+                        if base_info else set())
+            support += sorted(local - base_sup)
+            against += sorted(base_sup - local)
+        return Opinion(base=base_id, support=support, against=against,
+                       abstain=abstain)

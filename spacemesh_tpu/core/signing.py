@@ -43,6 +43,8 @@ class Domain(enum.IntEnum):
     POET = 5
     BEACON_PROPOSAL = 6
     MALFEASANCE = 7
+    TX = 8               # this framework's tx envelope (vm/vm.py)
+    CERTIFY = 9
 
 
 # --- ed25519 identity signatures -----------------------------------------

@@ -230,6 +230,7 @@ class ActivationTx:
     nipost: NIPost
     num_units: int
     vrf_nonce: int
+    vrf_public_key: bytes        # ECVRF key for eligibility proofs
     coinbase: bytes              # Address.raw
     node_id: bytes               # smesher public key
     signature: bytes
@@ -243,6 +244,7 @@ class ActivationTx:
         ("nipost", codec.struct(NIPost)),
         ("num_units", u32),
         ("vrf_nonce", u64),
+        ("vrf_public_key", HASH32),
         ("coinbase", ADDRESS),
         ("node_id", HASH32),
         ("signature", SIG),
@@ -334,15 +336,20 @@ class Ballot:
 @codec.register
 class Proposal:
     """Per-layer proposal: a ballot plus the proposed tx ids
-    (reference common/types/block.go Proposal = Ballot + TxIDs + mesh hash).
-    """
+    (reference common/types/block.go Proposal = Ballot + TxIDs + mesh hash,
+    carrying its own signature over the whole thing so a relayer cannot
+    re-attach different tx_ids to an honest ballot)."""
 
     ballot: Ballot
     tx_ids: list[bytes]
     mesh_hash: bytes
+    signature: bytes
 
     FIELDS = [("ballot", codec.struct(Ballot)), ("tx_ids", vec(HASH32)),
-              ("mesh_hash", HASH32)]
+              ("mesh_hash", HASH32), ("signature", SIG)]
+
+    def signed_bytes(self) -> bytes:
+        return dataclasses.replace(self, signature=bytes(64)).to_bytes()
 
     @property
     def id(self) -> bytes:
@@ -381,12 +388,13 @@ class CertifyMessage:
     block_id: bytes
     eligibility_count: int
     proof: bytes                 # VRF proof of certifier eligibility
+    atx_id: bytes                # the ATX backing the eligibility claim
     node_id: bytes
     signature: bytes
 
     FIELDS = [("layer", u32), ("block_id", HASH32),
               ("eligibility_count", u16), ("proof", VRF_SIG),
-              ("node_id", HASH32), ("signature", SIG)]
+              ("atx_id", HASH32), ("node_id", HASH32), ("signature", SIG)]
 
     def signed_bytes(self) -> bytes:
         return dataclasses.replace(self, signature=bytes(64)).to_bytes()

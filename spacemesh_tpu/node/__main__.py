@@ -1,0 +1,75 @@
+"""Node CLI (reference cmd/node + node.go:142 GetCommand).
+
+  python -m spacemesh_tpu.node --preset standalone [--data-dir D]
+      [--config FILE.json] [--until-layer N] [--genesis-now]
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import sys
+import time
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="spacemesh_tpu.node")
+    p.add_argument("--preset", default="standalone",
+                   choices=["mainnet", "fastnet", "standalone"])
+    p.add_argument("--config", help="JSON config file merged over the preset")
+    p.add_argument("--data-dir")
+    p.add_argument("--until-layer", type=int,
+                   help="stop after this layer (default: run forever)")
+    p.add_argument("--genesis-now", action="store_true",
+                   help="set genesis time to now + one layer")
+    a = p.parse_args(argv)
+
+    from .app import App
+    from .config import load
+    from . import events as events_mod
+
+    overrides = {}
+    if a.data_dir:
+        overrides["data_dir"] = a.data_dir
+    cfg = load(a.preset, file=a.config, overrides=overrides)
+    app = App(cfg)
+
+    async def go():
+        sub = app.events.subscribe(events_mod.LayerUpdate,
+                                   events_mod.AtxPublished,
+                                   events_mod.PostEvent)
+
+        async def report():
+            while True:
+                ev = await sub.next()
+                print(json.dumps({"event": type(ev).__name__,
+                                  **{k: (v.hex() if isinstance(v, bytes) else v)
+                                     for k, v in ev.__dict__.items()}}),
+                      flush=True)
+
+        reporter = asyncio.ensure_future(report())
+        try:
+            await app.prepare()
+            if a.genesis_now:
+                # rebase the CLOCK only, after the slow prepare (POST init,
+                # jit warmup) — the network id stays the configured one
+                from . import clock as clock_mod
+
+                app.clock = clock_mod.LayerClock(
+                    time.time() + cfg.layer_duration, cfg.layer_duration)
+            await app.run(until_layer=a.until_layer)
+        finally:
+            reporter.cancel()
+            app.close()
+
+    try:
+        asyncio.run(go())
+    except KeyboardInterrupt:
+        print("interrupted", file=sys.stderr)
+        return 130
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

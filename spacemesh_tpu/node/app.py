@@ -1,0 +1,245 @@
+"""App: the composition root wiring every service (reference
+node/node.go:583 initServices — the ONLY place cross-component wiring
+happens — and :2091 startSynchronous for the lifecycle; --standalone runs
+an in-proc poet + post worker, node.go:1293 launchStandalone).
+
+Layer cadence (one asyncio task):
+  layer tick
+    ├─ epoch start?  -> beacon.run_epoch, atx builder for the next epoch
+    ├─ miner.build(layer)          (proposal gossip)
+    ├─ hare.run_layer(layer)       (rounds; output -> block -> certify)
+    └─ mesh.process_layer(layer)   (tortoise tally + state application)
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from pathlib import Path
+
+from ..consensus import activation, beacon as beacon_mod, blocks, eligibility
+from ..consensus import hare as hare_mod
+from ..consensus import mesh as mesh_mod
+from ..consensus import miner as miner_mod
+from ..consensus import poet as poet_mod
+from ..consensus import tortoise as tortoise_mod
+from ..core.hashing import sum256
+from ..core.signing import EdSigner, EdVerifier
+from ..core.types import Address
+from ..p2p.pubsub import PubSub
+from ..post import initializer as post_init
+from ..post.prover import ProofParams
+from ..post.service import PostClient, PostService
+from ..storage import db as dbmod
+from ..storage.cache import AtxCache
+from ..txs import ConservativeState
+from ..vm import VM
+from ..vm import sdk as vm_sdk
+from . import clock as clock_mod
+from . import events as events_mod
+from .config import Config
+
+
+class App:
+    def __init__(self, cfg: Config, *, signer: EdSigner | None = None,
+                 pubsub: PubSub | None = None,
+                 time_source=time.time):
+        self.cfg = cfg
+        self.data = Path(cfg.data_dir)
+        self.data.mkdir(parents=True, exist_ok=True)
+        prefix = cfg.genesis.genesis_id
+        self.signer = signer or self._load_or_create_identity(prefix)
+        self.verifier = EdVerifier(prefix=prefix)
+        self.events = events_mod.EventBus()
+        self.clock = clock_mod.LayerClock(cfg.genesis.time, cfg.layer_duration,
+                                          time_source=time_source)
+        self.pubsub = pubsub or PubSub(node_name=self.signer.node_id)
+        self.state = dbmod.open_state(self.data / "state.db")
+        self.local = dbmod.open_local(self.data / "local.db")
+        self.cache = AtxCache()
+        self.golden_atx = sum256(b"golden", prefix)
+        self._wire()
+        self._tasks: list[asyncio.Task] = []
+        self.stopped = asyncio.Event()
+
+    def _load_or_create_identity(self, prefix: bytes) -> EdSigner:
+        """Persisted node identity (reference node/node_identities.go:
+        ed25519 keys live in the data dir and survive restarts)."""
+        key_dir = self.data / "identities"
+        key_dir.mkdir(parents=True, exist_ok=True)
+        key_file = key_dir / "local.key"
+        if key_file.exists():
+            return EdSigner(seed=bytes.fromhex(key_file.read_text().strip()),
+                            prefix=prefix)
+        signer = EdSigner(prefix=prefix)
+        key_file.write_text(signer.private_bytes().hex())
+        key_file.chmod(0o600)
+        return signer
+
+    def _wire(self) -> None:
+        cfg = self.cfg
+        self.oracle = eligibility.Oracle(self.cache, cfg.layers_per_epoch,
+                                         slots_per_layer=cfg.slots_per_layer)
+        self.vm = VM(self.state, self.verifier)
+        self.cstate = ConservativeState(self.state, self.vm)
+        self.tortoise = tortoise_mod.Tortoise(
+            self.cache, cfg.layers_per_epoch, hdist=cfg.tortoise.hdist,
+            window=cfg.tortoise.window_size)
+        self.proposal_store = mesh_mod.ProposalStore()
+        self.executor = mesh_mod.Executor(self.state, self.vm, self.cstate)
+        self.mesh = mesh_mod.Mesh(
+            db=self.state, tortoise=self.tortoise, executor=self.executor,
+            proposals=self.proposal_store, cache=self.cache)
+        self.beacon = beacon_mod.ProtocolDriver(
+            db=self.state, oracle=self.oracle, pubsub=self.pubsub,
+            genesis_id=cfg.genesis.genesis_id,
+            proposal_duration=cfg.beacon.proposal_duration)
+        self.post_params = ProofParams(
+            k1=cfg.post.k1, k2=cfg.post.k2, k3=cfg.post.k3,
+            pow_difficulty=cfg.post.pow_difficulty_bytes)
+        self.atx_handler = activation.Handler(
+            db=self.state, cache=self.cache, verifier=self.verifier,
+            golden_atx=self.golden_atx, post_params=self.post_params,
+            labels_per_unit=cfg.post.labels_per_unit,
+            scrypt_n=cfg.post.scrypt_n, pubsub=self.pubsub,
+            on_atx=self._on_atx)
+        self.generator = blocks.Generator(
+            mesh=self.mesh, proposals=self.proposal_store, cache=self.cache,
+            layers_per_epoch=cfg.layers_per_epoch)
+        self.certifier = blocks.Certifier(
+            db=self.state, signer=self.signer, verifier=self.verifier,
+            pubsub=self.pubsub, oracle=self.oracle,
+            committee_size=cfg.hare.committee_size,
+            threshold=cfg.hare.committee_size // 2 + 1,
+            layers_per_epoch=cfg.layers_per_epoch,
+            beacon_getter=self.beacon.get)
+        self.miner = miner_mod.ProposalBuilder(
+            signer=self.signer, db=self.state, cache=self.cache,
+            oracle=self.oracle, tortoise=self.tortoise, cstate=self.cstate,
+            pubsub=self.pubsub, layers_per_epoch=cfg.layers_per_epoch,
+            beacon_getter=self.beacon.get)
+        self.proposal_handler = miner_mod.ProposalHandler(
+            db=self.state, cache=self.cache, oracle=self.oracle,
+            tortoise=self.tortoise, store=self.proposal_store,
+            verifier=self.verifier, pubsub=self.pubsub,
+            layers_per_epoch=cfg.layers_per_epoch,
+            beacon_getter=self.beacon.get)
+        self.hare = hare_mod.Hare(
+            signer=self.signer, verifier=self.verifier, oracle=self.oracle,
+            pubsub=self.pubsub, committee_size=cfg.hare.committee_size,
+            round_duration=cfg.hare.round_duration,
+            iteration_limit=cfg.hare.iteration_limit,
+            layers_per_epoch=cfg.layers_per_epoch,
+            beacon_of=self.beacon.get, atx_for=self.miner.own_atx,
+            proposals_for=self.proposal_store.ids_in_layer,
+            on_output=self._on_hare_output)
+        self.poet = poet_mod.PoetService(
+            poet_id=sum256(b"poet", cfg.genesis.genesis_id), ticks=64)
+        self.post_service = PostService()
+        self.atx_builder: activation.Builder | None = None
+        from ..p2p.pubsub import TOPIC_TX
+
+        self.pubsub.register(TOPIC_TX, self._on_tx)
+
+    # --- handlers ------------------------------------------------------
+
+    def _on_atx(self, atx) -> None:
+        self.events.emit(events_mod.AtxEvent(
+            atx_id=atx.id, node_id=atx.node_id, epoch=atx.publish_epoch))
+
+    async def _on_tx(self, peer: bytes, data: bytes) -> bool:
+        from ..core.types import Transaction
+        from ..vm.vm import TxValidity
+
+        validity = self.cstate.add(Transaction(raw=data))
+        self.events.emit(events_mod.TxEvent(
+            tx_id=Transaction(raw=data).id,
+            valid=validity == TxValidity.VALID))
+        return validity == TxValidity.VALID
+
+    async def _on_hare_output(self, out: hare_mod.ConsensusOutput) -> None:
+        block = self.generator.process_hare_output(out)
+        self.events.emit(events_mod.LayerUpdate(layer=out.layer,
+                                                status="hare_done"))
+        if block is not None:
+            epoch = out.layer // self.cfg.layers_per_epoch
+            await self.certifier.certify_if_eligible(
+                out.layer, block.id, self.miner.own_atx(epoch))
+
+    # --- smeshing ------------------------------------------------------
+
+    async def start_smeshing(self) -> None:
+        cfg = self.cfg
+        post_dir = self.data / "post" / self.signer.node_id.hex()[:16]
+        commitment = activation.commitment_of(self.signer.node_id,
+                                              self.golden_atx)
+        self.events.emit(events_mod.PostEvent(node_id=self.signer.node_id,
+                                              kind="init_start"))
+        await asyncio.to_thread(
+            post_init.initialize, post_dir,
+            node_id=self.signer.node_id, commitment=commitment,
+            num_units=cfg.smeshing.num_units,
+            labels_per_unit=cfg.post.labels_per_unit,
+            scrypt_n=cfg.post.scrypt_n,
+            batch_size=cfg.smeshing.init_batch)
+        self.events.emit(events_mod.PostEvent(node_id=self.signer.node_id,
+                                              kind="init_complete"))
+        client = PostClient(post_dir, self.post_params)
+        self.post_service.register(self.signer.node_id, client)
+        coinbase = (Address.decode(cfg.smeshing.coinbase).raw
+                    if cfg.smeshing.coinbase
+                    else vm_sdk.wallet_address(self.signer.public_key).raw)
+        self.atx_builder = activation.Builder(
+            signer=self.signer, db=self.state, pubsub=self.pubsub,
+            poet=self.poet, post_client=client, golden_atx=self.golden_atx,
+            coinbase=coinbase, handler=self.atx_handler,
+            num_units=cfg.smeshing.num_units)
+
+    async def publish_atx(self, publish_epoch: int) -> None:
+        if self.atx_builder is None:
+            return
+        atx = await self.atx_builder.build_and_publish(
+            publish_epoch, execute_round=self.cfg.standalone)
+        self.events.emit(events_mod.AtxPublished(
+            atx_id=atx.id, node_id=atx.node_id, epoch=publish_epoch))
+
+    # --- lifecycle -----------------------------------------------------
+
+    async def prepare(self) -> None:
+        """Smeshing setup + first ATX (targets epoch 1). Idempotent; may be
+        called before run() so slow POST init/compiles don't eat layers."""
+        if self.cfg.smeshing.start and self.atx_builder is None:
+            await self.start_smeshing()
+            await self.publish_atx(0)
+
+    async def run(self, until_layer: int | None = None) -> None:
+        """The main layer loop (standalone-complete; networked sync lands
+        with M3)."""
+        cfg = self.cfg
+        if cfg.smeshing.start and self.atx_builder is None:
+            await self.prepare()
+        seen_epochs = {0}
+        async for layer in self.clock.ticks():
+            epoch = cfg.epoch_of(layer)
+            if epoch not in seen_epochs:
+                seen_epochs.add(epoch)
+                asyncio.ensure_future(self._epoch_start(epoch))
+            await self.miner.build(layer)
+            await self.hare.run_layer(layer)
+            self.mesh.process_layer(layer)
+            self.events.emit(events_mod.LayerUpdate(layer=layer,
+                                                    status="applied"))
+            if until_layer is not None and layer >= until_layer:
+                break
+        self.stopped.set()
+
+    async def _epoch_start(self, epoch: int) -> None:
+        vrf = self.signer.vrf_signer()
+        atx = self.miner.own_atx(epoch)
+        await self.beacon.run_epoch(epoch, self.signer, vrf, atx)
+        if self.cfg.smeshing.start:
+            await self.publish_atx(epoch)  # targets epoch+1
+
+    def close(self) -> None:
+        self.state.close()
+        self.local.close()

@@ -108,6 +108,7 @@ class Config:
     data_dir: str = "data"
     layer_duration: float = 300.0          # mainnet: 5 min layers
     layers_per_epoch: int = 4032           # 2 weeks
+    slots_per_layer: int = 50              # proposal slots (epoch total / lpe)
     genesis: GenesisConfig = dataclasses.field(default_factory=GenesisConfig)
     post: PostConfig = dataclasses.field(default_factory=PostConfig)
     smeshing: SmeshingConfig = dataclasses.field(default_factory=SmeshingConfig)

@@ -1,0 +1,6 @@
+"""Networking: pubsub abstraction, loopback hub, QUIC-style host (M3),
+fetch, and sync. The consensus layers speak only the PublishSubscriber
+interface (reference p2p/pubsub/pubsub.go:137), so in-proc loopback,
+multi-node test hubs, and the real network are interchangeable."""
+
+from .pubsub import LoopbackHub, PubSub  # noqa: F401

@@ -1,0 +1,81 @@
+"""Publish/subscribe seam + in-proc loopback hub.
+
+Mirrors the reference's gossip topic registration (reference
+p2p/pubsub/pubsub.go: topics `ax1 pp1 tx1 b1 bo1 mp1 bc1 ...` with
+validator handlers; handlers return accept/reject and rejection can drop
+the peer). Topic names are kept. The LoopbackHub wires N in-proc nodes
+fully connected — the TestNetwork equivalent (reference
+node/test_network.go) — delivering to every OTHER node's handlers and,
+like gossipsub, not echoing to the publisher (publishers handle their own
+messages locally, as the reference does via pubsub self-delivery... which
+IS echoed there; here `deliver_self` controls it, default True to match).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Awaitable, Callable
+
+# reference topic names (p2p/pubsub/pubsub.go:54-81)
+TOPIC_ATX = "ax1"
+TOPIC_PROPOSAL = "pp1"
+TOPIC_TX = "tx1"
+TOPIC_BEACON_PROPOSAL = "bp1"
+TOPIC_BEACON_FIRST = "bf1"
+TOPIC_BEACON_FOLLOW = "bo1"
+TOPIC_BEACON_WEAK_COIN = "bw1"
+TOPIC_HARE = "b1"
+TOPIC_MALFEASANCE = "mp1"
+TOPIC_CERTIFY = "bc1"
+
+Handler = Callable[[bytes, bytes], Awaitable[bool]]  # (peer, data) -> accept
+
+
+class PubSub:
+    """One node's view: register validators, publish bytes."""
+
+    def __init__(self, node_name: bytes = b"local",
+                 deliver_self: bool = True):
+        self.name = node_name
+        self.deliver_self = deliver_self
+        self._handlers: dict[str, list[Handler]] = {}
+        self._hub: "LoopbackHub | None" = None
+
+    def register(self, topic: str, handler: Handler) -> None:
+        self._handlers.setdefault(topic, []).append(handler)
+
+    async def publish(self, topic: str, data: bytes) -> None:
+        if self.deliver_self:
+            await self.deliver(topic, self.name, data)
+        if self._hub is not None:
+            await self._hub.broadcast(self, topic, data)
+
+    async def deliver(self, topic: str, peer: bytes, data: bytes) -> bool:
+        ok = True
+        for h in self._handlers.get(topic, ()):
+            try:
+                ok = await h(peer, data) and ok
+            except Exception:  # noqa: BLE001 — a bad message must not kill the bus
+                ok = False
+        return ok
+
+
+class LoopbackHub:
+    """Fully-connected in-proc network of PubSub endpoints."""
+
+    def __init__(self) -> None:
+        self._nodes: list[PubSub] = []
+
+    def join(self, ps: PubSub) -> None:
+        ps._hub = self
+        self._nodes.append(ps)
+
+    def leave(self, ps: PubSub) -> None:
+        ps._hub = None
+        self._nodes.remove(ps)
+
+    async def broadcast(self, sender: PubSub, topic: str, data: bytes) -> None:
+        tasks = [n.deliver(topic, sender.name, data)
+                 for n in self._nodes if n is not sender]
+        if tasks:
+            await asyncio.gather(*tasks)

@@ -17,6 +17,7 @@ class AtxInfo:
     height: int
     num_units: int
     vrf_nonce: int
+    vrf_public_key: bytes = b""
     malicious: bool = False
 
 

@@ -1,0 +1,3 @@
+"""Conservative state / mempool (reference txs/)."""
+
+from .conservative_state import ConservativeState  # noqa: F401

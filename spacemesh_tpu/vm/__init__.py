@@ -1,0 +1,6 @@
+"""Deterministic account-template VM (reference genvm/: no user bytecode,
+a fixed registry of account templates — wallet, multisig, vesting, vault —
+with spawn/spend transaction lifecycle, nonces, gas, and a running state
+root over account updates)."""
+
+from .vm import VM, TxValidity  # noqa: F401

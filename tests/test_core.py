@@ -147,7 +147,7 @@ def test_atx_roundtrip_and_id():
     atx = types.ActivationTx(
         publish_epoch=7, prev_atx=bytes(32), pos_atx=bytes([1]) * 32,
         commitment_atx=bytes([2]) * 32, initial_post=_post(),
-        nipost=_nipost(), num_units=4, vrf_nonce=99,
+        nipost=_nipost(), num_units=4, vrf_nonce=99, vrf_public_key=bytes(32),
         coinbase=bytes(24), node_id=bytes([3]) * 32, signature=bytes(64))
     data = atx.to_bytes()
     back = types.ActivationTx.from_bytes(data)
@@ -173,7 +173,7 @@ def test_ballot_proposal_block_roundtrip():
     assert types.Ballot.from_bytes(ballot.to_bytes()) == ballot
 
     prop = types.Proposal(ballot=ballot, tx_ids=[bytes([5]) * 32],
-                          mesh_hash=bytes(32))
+                          mesh_hash=bytes(32), signature=bytes(64))
     assert types.Proposal.from_bytes(prop.to_bytes()) == prop
 
     blk = types.Block(layer=12, tick_height=1000,
@@ -184,7 +184,8 @@ def test_ballot_proposal_block_roundtrip():
         block_id=blk.id,
         signatures=[types.CertifyMessage(
             layer=12, block_id=blk.id, eligibility_count=1,
-            proof=bytes(80), node_id=bytes(32), signature=bytes(64))])
+            proof=bytes(80), atx_id=bytes(32), node_id=bytes(32),
+            signature=bytes(64))])
     assert types.Certificate.from_bytes(cert.to_bytes()) == cert
 
 

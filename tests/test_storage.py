@@ -20,7 +20,8 @@ def _atx(epoch=1, node=b"\x01" * 32, units=4):
             post=types.Post(nonce=0, indices=[1], pow_nonce=0),
             post_metadata=types.PostMetadataWire(challenge=bytes(32),
                                                  labels_per_unit=64)),
-        num_units=units, vrf_nonce=7, coinbase=bytes(24), node_id=node,
+        num_units=units, vrf_nonce=7, vrf_public_key=bytes(32),
+        coinbase=bytes(24), node_id=node,
         signature=bytes(64))
 
 

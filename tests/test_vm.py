@@ -1,0 +1,178 @@
+"""VM: spawn/spend/vault lifecycle, gas, determinism, revert.
+
+The TPU-build analogue of reference genvm/vm_test.go.
+"""
+
+import pytest
+
+from spacemesh_tpu.core import signing, types
+from spacemesh_tpu.storage import db as dbmod
+from spacemesh_tpu.storage import layers as layerstore
+from spacemesh_tpu.storage import transactions as txstore
+from spacemesh_tpu.vm import VM, TxValidity, sdk
+from spacemesh_tpu.vm import templates as T
+from spacemesh_tpu.vm.vm import BASE_REWARD
+
+
+@pytest.fixture
+def env():
+    state = dbmod.open_state()
+    verifier = signing.EdVerifier(prefix=b"testnet")
+    vm = VM(state, verifier)
+    alice = signing.EdSigner(prefix=b"testnet")
+    bob = signing.EdSigner(prefix=b"testnet")
+    a_addr = sdk.wallet_address(alice.public_key)
+    b_addr = sdk.wallet_address(bob.public_key)
+    vm.apply_genesis({a_addr.raw: 10**12, b_addr.raw: 10**9})
+    return state, vm, alice, bob, a_addr, b_addr
+
+
+def test_spawn_and_spend(env):
+    state, vm, alice, bob, a_addr, b_addr = env
+    blk = b"\x01" * 32
+    txs = [sdk.spawn_wallet(alice),
+           sdk.spend(a_addr, [alice], b_addr, 1000, nonce=1)]
+    results, root = vm.apply(1, blk, txs, rewards=[])
+    assert [r.status for r in results] == [0, 0]
+    assert root != bytes(32)
+    a = txstore.account(state, a_addr.raw)
+    b = txstore.account(state, b_addr.raw)
+    assert b["balance"] == 10**9 + 1000
+    fees = sum(r.fee for r in results)
+    assert a["balance"] == 10**12 - 1000 - fees
+    assert a["next_nonce"] == 2
+
+
+def test_unspawned_account_cannot_spend(env):
+    state, vm, alice, bob, a_addr, b_addr = env
+    results, _ = vm.apply(1, bytes(32),
+                          [sdk.spend(a_addr, [alice], b_addr, 5, nonce=0)], [])
+    assert results[0].status == int(TxValidity.NOT_SPAWNED)
+
+
+def test_wrong_nonce_and_replay(env):
+    state, vm, alice, bob, a_addr, b_addr = env
+    spawn = sdk.spawn_wallet(alice)
+    vm.apply(1, bytes(32), [spawn], [])
+    # replaying the same spawn: nonce 0 already consumed
+    results, _ = vm.apply(2, bytes(32), [spawn], [])
+    assert results[0].status in (int(TxValidity.INVALID_NONCE),
+                                 int(TxValidity.MALFORMED))
+    tx = sdk.spend(a_addr, [alice], b_addr, 5, nonce=5)
+    results, _ = vm.apply(3, bytes(32), [tx], [])
+    assert results[0].status == int(TxValidity.INVALID_NONCE)
+
+
+def test_bad_signature(env):
+    state, vm, alice, bob, a_addr, b_addr = env
+    vm.apply(1, bytes(32), [sdk.spawn_wallet(alice)], [])
+    forged = sdk.spend(a_addr, [bob], b_addr, 5, nonce=1)  # bob signs alice's acct
+    results, _ = vm.apply(2, bytes(32), [forged], [])
+    assert results[0].status == int(TxValidity.BAD_SIGNATURE)
+
+
+def test_overspend(env):
+    state, vm, alice, bob, a_addr, b_addr = env
+    vm.apply(1, bytes(32), [sdk.spawn_wallet(alice)], [])
+    results, _ = vm.apply(2, bytes(32),
+                          [sdk.spend(a_addr, [alice], b_addr, 10**15, nonce=1)], [])
+    assert results[0].status == int(TxValidity.INSUFFICIENT_FUNDS)
+    # fee was still charged, nonce still advanced (failed txs burn gas)
+    a = txstore.account(state, a_addr.raw)
+    assert a["next_nonce"] == 2
+    assert a["balance"] < 10**12
+
+
+def test_rewards_distribution(env):
+    state, vm, alice, bob, a_addr, b_addr = env
+    rewards = [types.Reward(coinbase=a_addr.raw, weight=3),
+               types.Reward(coinbase=b_addr.raw, weight=1)]
+    vm.apply(1, bytes(32), [], rewards)
+    a = txstore.account(state, a_addr.raw)
+    b = txstore.account(state, b_addr.raw)
+    assert a["balance"] == 10**12 + BASE_REWARD * 3 // 4
+    assert b["balance"] == 10**9 + BASE_REWARD // 4
+
+
+def test_multisig_flow(env):
+    state, vm, alice, bob, a_addr, b_addr = env
+    carol = signing.EdSigner(prefix=b"testnet")
+    keys = [alice, bob, carol]
+    m_addr = sdk.multisig_address(2, [s.public_key for s in keys])
+    vm.apply_genesis({m_addr.raw: 10**10})
+    ok = sdk.spawn_multisig(2, keys)
+    results, _ = vm.apply(1, bytes(32), [ok], [])
+    assert results[0].status == 0
+    # 1 signature is not enough for 2-of-3
+    under = sdk.spend(m_addr, [alice], b_addr, 10, nonce=1)
+    results, _ = vm.apply(2, bytes(32), [under], [])
+    assert results[0].status == int(TxValidity.BAD_SIGNATURE)
+    good = sdk.spend(m_addr, [alice, carol], b_addr, 10, nonce=1)
+    results, _ = vm.apply(3, bytes(32), [good], [])
+    assert results[0].status == 0
+
+
+def test_vault_vesting_schedule(env):
+    state, vm, alice, bob, a_addr, b_addr = env
+    vm.apply(1, bytes(32), [sdk.spawn_wallet(alice)], [])
+    args = T.VaultSpawnArgs(owner=a_addr.raw, total_amount=1000,
+                            initial_unlock=100, vesting_start=10,
+                            vesting_end=20)
+    v_addr = sdk.vault_address(args)
+    vm.apply_genesis({v_addr.raw: 1000})
+    results, _ = vm.apply(2, bytes(32), [sdk.spawn_vault(args)], [])
+    assert results[0].status == 0
+
+    # before vesting start: nothing available
+    r, _ = vm.apply(5, bytes(32), [sdk.drain_vault(
+        a_addr, [alice], v_addr, b_addr, 1, nonce=1)], [])
+    assert r[0].status == int(TxValidity.INSUFFICIENT_FUNDS)
+    # mid-schedule: initial_unlock + half of the linear part
+    r, _ = vm.apply(15, bytes(32), [sdk.drain_vault(
+        a_addr, [alice], v_addr, b_addr, 550, nonce=2)], [])
+    assert r[0].status == 0
+    # but not more than vested
+    r, _ = vm.apply(16, bytes(32), [sdk.drain_vault(
+        a_addr, [alice], v_addr, b_addr, 300, nonce=3)], [])
+    assert r[0].status == int(TxValidity.INSUFFICIENT_FUNDS)
+    # non-owner cannot drain
+    vm.apply(17, bytes(32), [sdk.spawn_wallet(bob, nonce=0)], [])
+    r, _ = vm.apply(18, bytes(32), [sdk.drain_vault(
+        b_addr, [bob], v_addr, b_addr, 10, nonce=1)], [])
+    assert r[0].status == int(TxValidity.BAD_SIGNATURE)
+    # after vesting end: the remainder drains
+    r, _ = vm.apply(25, bytes(32), [sdk.drain_vault(
+        a_addr, [alice], v_addr, b_addr, 450, nonce=4)], [])
+    assert r[0].status == 0
+
+
+def test_determinism_across_instances():
+    def run():
+        state = dbmod.open_state()
+        verifier = signing.EdVerifier(prefix=b"d")
+        vm = VM(state, verifier)
+        alice = signing.EdSigner(seed=bytes(32), prefix=b"d")
+        bob = signing.EdSigner(seed=bytes([1]) + bytes(31), prefix=b"d")
+        a = sdk.wallet_address(alice.public_key)
+        b = sdk.wallet_address(bob.public_key)
+        vm.apply_genesis({a.raw: 10**9})
+        _, root1 = vm.apply(1, bytes(32), [sdk.spawn_wallet(alice)], [])
+        _, root2 = vm.apply(2, bytes(32),
+                            [sdk.spend(a, [alice], b, 42, nonce=1)],
+                            [types.Reward(coinbase=b.raw, weight=1)])
+        return root1, root2
+    assert run() == run()
+
+
+def test_revert(env):
+    state, vm, alice, bob, a_addr, b_addr = env
+    vm.apply(1, bytes(32), [sdk.spawn_wallet(alice)], [])
+    layerstore.set_applied(state, 1, bytes(32), b"\x01" * 32)
+    vm.apply(2, bytes(32), [sdk.spend(a_addr, [alice], b_addr, 7, nonce=1)], [])
+    before = txstore.account(state, b_addr.raw)["balance"]
+    vm.revert(1)
+    after = txstore.account(state, b_addr.raw)["balance"]
+    assert before == 10**9 + 7 and after == 10**9
+    # nonce rolled back too: the spend can re-apply
+    r, _ = vm.apply(2, bytes(32), [sdk.spend(a_addr, [alice], b_addr, 7, nonce=1)], [])
+    assert r[0].status == 0
