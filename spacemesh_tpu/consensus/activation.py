@@ -49,6 +49,27 @@ def commitment_of(node_id: bytes, golden_atx: bytes) -> bytes:
     return sum256(node_id, golden_atx)
 
 
+def store_poet_blob(db: Database, blob) -> None:
+    """Persist a poet proof + its member count (single writer for the two
+    rows the validator reads: proof by ref, count by derived key)."""
+    proof = blob.proof
+    with db.tx():
+        # first write wins: member_count is not covered by proof.id, so a
+        # re-gossiped blob with a forged count must not overwrite the
+        # count recorded when the proof first arrived
+        db.exec(
+            "INSERT OR IGNORE INTO poet_proofs (ref, poet_id, round_id,"
+            " ticks, data) VALUES (?,?,?,?,?)",
+            (proof.id, proof.poet_id, proof.round_id, proof.ticks,
+             proof.to_bytes()))
+        db.exec(
+            "INSERT OR IGNORE INTO active_sets (id, epoch, data)"
+            " VALUES (?,?,?)",
+            (b"poetcnt!" + proof.id[:24],
+             int(proof.round_id) if proof.round_id.isdigit() else 0,
+             blob.member_count.to_bytes(8, "little")))
+
+
 def nipost_challenge(prev_atx: bytes, epoch: int) -> bytes:
     return sum256(prev_atx, struct.pack("<I", epoch))
 
@@ -184,19 +205,15 @@ class Builder:
         membership = result.membership(challenge)
         if membership is None:
             raise RuntimeError("challenge missing from poet round")
-        # persist the poet proof under the challenge ref the wire carries
+        # persist + gossip the poet proof so every node can validate the
+        # ATXs that reference this round (reference gossips poet proofs)
         proof = result.proof
-        with self.db.tx():
-            self.db.exec(
-                "INSERT OR REPLACE INTO poet_proofs (ref, poet_id, round_id,"
-                " ticks, data) VALUES (?,?,?,?,?)",
-                (proof.id, proof.poet_id, proof.round_id, proof.ticks,
-                 proof.to_bytes()))
-            self.db.exec(
-                "INSERT OR REPLACE INTO active_sets (id, epoch, data)"
-                " VALUES (?,?,?)",
-                (b"poetcnt!" + proof.id[:24], publish_epoch,
-                 len(result.members).to_bytes(8, "little")))
+        from ..p2p.pubsub import TOPIC_POET
+        from .poet import PoetBlob
+
+        blob = PoetBlob(proof=proof, member_count=len(result.members))
+        store_poet_blob(self.db, blob)
+        await self.pubsub.publish(TOPIC_POET, blob.to_bytes())
 
         # phase 2: POST proof over the poet statement
         ch = post_challenge(proof.root, challenge)

@@ -134,7 +134,10 @@ class Hare:
                  atx_for: Callable[[int], Optional[bytes]],
                  proposals_for: Callable[[int], list[bytes]],
                  on_output: Callable[[ConsensusOutput], Awaitable[None]],
-                 on_equivocation=None):
+                 on_equivocation=None, preround_delay: float = 0.0,
+                 wall=None):
+        import time as _time
+
         self.signer = signer
         self.verifier = verifier
         self.oracle = oracle
@@ -142,6 +145,8 @@ class Hare:
         self.committee = committee_size
         self.round_duration = round_duration
         self.iteration_limit = iteration_limit
+        self.preround_delay = preround_delay
+        self.wall = wall or _time.time
         self.layers_per_epoch = layers_per_epoch
         self.beacon_of = beacon_of
         self.atx_for = atx_for
@@ -149,6 +154,11 @@ class Hare:
         self.on_output = on_output
         self.on_equivocation = on_equivocation
         self.sessions: dict[int, HareSession] = {}
+        # messages for layers whose session hasn't started here yet — peers'
+        # clocks are never perfectly aligned (reference buffers early
+        # messages the same way)
+        self._pending: dict[int, list[HareMessage]] = {}
+        self._pending_cap = 1 << 10
         pubsub.register(TOPIC_HARE, self._gossip)
 
     # --- gossip ingestion ------------------------------------------
@@ -172,6 +182,10 @@ class Hare:
         session = self.sessions.get(msg.layer)
         if session is not None:
             session.on_message(msg)
+        else:
+            buf = self._pending.setdefault(msg.layer, [])
+            if len(buf) < self._pending_cap:
+                buf.append(msg)
         return True
 
     def _report_equivocation(self, msg: HareMessage, prev) -> None:
@@ -182,14 +196,44 @@ class Hare:
 
     # --- session driving -------------------------------------------
 
-    async def run_layer(self, layer: int) -> ConsensusOutput:
-        """Run the full session for a layer (call at layer start)."""
+    async def run_layer(self, layer: int,
+                        layer_start: float | None = None) -> ConsensusOutput:
+        """Run the full session for a layer.
+
+        Rounds are ABSOLUTE wall-clock slots measured from ``layer_start``
+        (reference hare rounds are fixed slots within the layer): slot k
+        ends at layer_start + preround_delay + (k+1) * round_duration, so
+        nodes stay in lockstep however late their session code entered —
+        a node whose proposal build ran long still reads each round's
+        messages at the same instant as its peers.
+        """
+        if layer_start is None:
+            layer_start = self.wall()
+
+        async def until_slot(k: int) -> None:
+            target = (layer_start + self.preround_delay
+                      + (k + 1) * self.round_duration)
+            delay = target - self.wall()
+            if delay > 0:
+                await asyncio.sleep(delay)
+
         epoch = layer // self.layers_per_epoch
         beacon = await self.beacon_of(epoch)
         atx = self.atx_for(epoch)
-        session = HareSession(self, layer, self.proposals_for(layer))
+        session = HareSession(self, layer, [])
         self.sessions[layer] = session
+        for msg in self._pending.pop(layer, ()):  # replay early arrivals
+            session.on_message(msg)
+        for stale in [x for x in self._pending if x < layer]:
+            del self._pending[stale]
         vrf = self.signer.vrf_signer()
+
+        # preround_delay gives proposals time to build + propagate
+        # (reference PreroundDelay); the proposal snapshot happens at the
+        # preround SEND, not at session entry. slot -1 ends exactly at
+        # layer_start + preround_delay.
+        await until_slot(-1)
+        session.my_proposals = sorted(self.proposals_for(layer))
 
         async def maybe_send(iteration: int, round_: int, values: list[bytes]):
             if atx is None:
@@ -215,22 +259,22 @@ class Hare:
         threshold = self.committee // 2 + 1
 
         await maybe_send(0, PREROUND, session.my_proposals)
-        await asyncio.sleep(self.round_duration)
+        await until_slot(0)
 
         for it in range(self.iteration_limit):
             # PROPOSE (leader: anyone eligible; first arrival wins)
             await maybe_send(it, PROPOSE, session.candidates())
-            await asyncio.sleep(self.round_duration)
+            await until_slot(1 + 3 * it)
             proposal = session.proposed or session.candidates()
             # COMMIT
             await maybe_send(it, COMMIT, proposal)
-            await asyncio.sleep(self.round_duration)
+            await until_slot(2 + 3 * it)
             committed = tuple(sorted(proposal))
             have = session.commit_weight(committed)
             # NOTIFY happens if enough commit weight was observed
             if have >= threshold:
                 await maybe_send(it, NOTIFY, list(committed))
-            await asyncio.sleep(self.round_duration)
+            await until_slot(3 + 3 * it)
             if session.notify_weight(committed) >= threshold:
                 session.output = list(committed)
                 break

@@ -95,7 +95,9 @@ class Mesh:
         self.executor = executor
         self.proposals = proposals
         self.cache = cache
-        self.latest_applied = 0
+        # recover the applied frontier from storage on restart (reference
+        # mesh.go:123 recoverFromDB)
+        self.latest_applied = max(layerstore.last_applied(db), 0)
 
     def add_block(self, block: Block) -> None:
         with self.db.tx():

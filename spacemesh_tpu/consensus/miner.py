@@ -136,14 +136,12 @@ class ProposalHandler:
             return False
         return await self.process(proposal)
 
-    async def process(self, proposal: Proposal) -> bool:
-        ballot = proposal.ballot
+    async def ingest_ballot(self, ballot) -> bool:
+        """Full ballot validation + store + tortoise feed. ONE path for
+        gossip proposals and synced ballots — sync must not be a weaker
+        copy of the gossip checks."""
         if not self.verifier.verify(Domain.BALLOT, ballot.node_id,
                                     ballot.signed_bytes(), ballot.signature):
-            return False
-        if not self.verifier.verify(Domain.BALLOT, ballot.node_id,
-                                    proposal.signed_bytes(),
-                                    proposal.signature):
             return False
         epoch = ballot.layer // self.layers_per_epoch
         info = self.cache.get(epoch, ballot.atx_id)
@@ -165,8 +163,18 @@ class ProposalHandler:
                 return False
         with self.db.tx():
             ballotstore.add(self.db, ballot)
-        self.store.add(proposal)
         num_slots = self.oracle.num_slots(epoch, ballot.atx_id)
         unit = info.weight // max(num_slots, 1)
         self.tortoise.on_ballot(ballot, unit * len(ballot.eligibilities))
+        return True
+
+    async def process(self, proposal: Proposal) -> bool:
+        ballot = proposal.ballot
+        if not self.verifier.verify(Domain.BALLOT, ballot.node_id,
+                                    proposal.signed_bytes(),
+                                    proposal.signature):
+            return False
+        if not await self.ingest_ballot(ballot):
+            return False
+        self.store.add(proposal)
         return True

@@ -83,6 +83,22 @@ def sequential_work(seed: bytes, ticks: int) -> bytes:
     return h
 
 
+from ..core import codec as _codec
+from ..core.codec import u64
+
+
+@_codec.register
+class PoetBlob:
+    """Poet proof + the member count its membership proofs verify against
+    (gossiped on pt1 and served through fetch so every node can validate
+    ATXs referencing the round)."""
+
+    proof: PoetProof
+    member_count: int
+
+    FIELDS = [("proof", _codec.struct(PoetProof)), ("member_count", u64)]
+
+
 @dataclasses.dataclass
 class RoundResult:
     proof: PoetProof

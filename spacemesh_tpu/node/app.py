@@ -24,7 +24,7 @@ from ..consensus import miner as miner_mod
 from ..consensus import poet as poet_mod
 from ..consensus import tortoise as tortoise_mod
 from ..core.hashing import sum256
-from ..core.signing import EdSigner, EdVerifier
+from ..core.signing import Domain, EdSigner, EdVerifier
 from ..core.types import Address
 from ..p2p.pubsub import PubSub
 from ..post import initializer as post_init
@@ -129,6 +129,7 @@ class App:
             pubsub=self.pubsub, committee_size=cfg.hare.committee_size,
             round_duration=cfg.hare.round_duration,
             iteration_limit=cfg.hare.iteration_limit,
+            preround_delay=cfg.hare.preround_delay,
             layers_per_epoch=cfg.layers_per_epoch,
             beacon_of=self.beacon.get, atx_for=self.miner.own_atx,
             proposals_for=self.proposal_store.ids_in_layer,
@@ -137,11 +138,170 @@ class App:
             poet_id=sum256(b"poet", cfg.genesis.genesis_id), ticks=64)
         self.post_service = PostService()
         self.atx_builder: activation.Builder | None = None
-        from ..p2p.pubsub import TOPIC_TX
+        from ..p2p.pubsub import TOPIC_POET, TOPIC_TX
 
         self.pubsub.register(TOPIC_TX, self._on_tx)
+        self.pubsub.register(TOPIC_POET, self._on_poet)
+        self.server = None
+        self.fetch = None
+        self.syncer = None
+
+    # --- networking (request/response + fetch + sync) -------------------
+
+    def connect_network(self, net) -> None:
+        """Join a transport (LoopbackNet in tests; QUIC later): exposes the
+        local databases to peers and gains fetch/sync (reference
+        node.go:1166-1211 wires fetch validators the same way)."""
+        import struct as _struct
+
+        from ..consensus.poet import PoetBlob
+        from ..core.types import ActivationTx, Ballot, Block
+        from ..p2p import fetch as fetch_mod
+        from ..p2p.server import Server
+        from ..p2p.sync import Syncer
+        from ..storage import atxs as atxstore
+        from ..storage import ballots as ballotstore
+        from ..storage import blocks as blockstore
+        from ..storage import layers as layerstore
+        from ..storage import misc as miscstore
+
+        self.server = Server(self.signer.node_id)
+        net.join(self.server)
+        self.fetch = fetch_mod.Fetch(self.server)
+
+        # blob readers (serve our stores to peers)
+        def _r(getter, encode=lambda v: v.to_bytes()):
+            return lambda h: (lambda v: encode(v) if v is not None else None)(
+                getter(self.state, h))
+
+        self.fetch.set_reader(fetch_mod.HINT_ATX, _r(atxstore.get))
+        self.fetch.set_reader(fetch_mod.HINT_BALLOT, _r(ballotstore.get))
+        self.fetch.set_reader(fetch_mod.HINT_BLOCK, _r(blockstore.get))
+
+        def read_poet(ref: bytes):
+            proof = miscstore.poet_proof(self.state, ref)
+            if proof is None:
+                return None
+            row = self.state.one("SELECT data FROM active_sets WHERE id=?",
+                                 (b"poetcnt!" + ref[:24],))
+            count = int.from_bytes(row["data"], "little") if row else 0
+            return PoetBlob(proof=proof, member_count=count).to_bytes()
+
+        self.fetch.set_reader(fetch_mod.HINT_POET, read_poet)
+
+        # validators (ingest fetched blobs through the SAME gossip paths).
+        # Every validator first checks the blob's content hash equals the
+        # requested id — else one malicious peer could satisfy a fetch with
+        # a different (valid-looking) object and the real one is never
+        # retried from honest peers.
+        async def v_atx(h: bytes, blob: bytes) -> bool:
+            try:
+                if ActivationTx.from_bytes(blob).id != h:
+                    return False
+            except Exception:  # noqa: BLE001
+                return False
+            return await self.atx_handler._gossip(b"sync", blob)
+
+        async def v_ballot(h: bytes, blob: bytes) -> bool:
+            try:
+                ballot = Ballot.from_bytes(blob)
+            except Exception:  # noqa: BLE001
+                return False
+            if ballot.id != h:
+                return False
+            return await self.proposal_handler.ingest_ballot(ballot)
+
+        async def v_block(h: bytes, blob: bytes) -> bool:
+            try:
+                block = Block.from_bytes(blob)
+            except Exception:  # noqa: BLE001
+                return False
+            if block.id != h:
+                return False
+            self.mesh.add_block(block)
+            return True
+
+        async def v_poet(h: bytes, blob: bytes) -> bool:
+            from ..consensus.poet import PoetBlob
+
+            try:
+                if PoetBlob.from_bytes(blob).proof.id != h:
+                    return False
+            except Exception:  # noqa: BLE001
+                return False
+            return await self._on_poet(b"sync", blob)
+
+        self.fetch.set_validator(fetch_mod.HINT_ATX, v_atx)
+        self.fetch.set_validator(fetch_mod.HINT_BALLOT, v_ballot)
+        self.fetch.set_validator(fetch_mod.HINT_BLOCK, v_block)
+        self.fetch.set_validator(fetch_mod.HINT_POET, v_poet)
+
+        # index endpoints
+        async def serve_epoch(peer: bytes, data: bytes) -> bytes:
+            epoch = _struct.unpack("<I", data)[0]
+            return b"".join(atxstore.ids_in_epoch(self.state, epoch))
+
+        async def serve_layer(peer: bytes, data: bytes) -> bytes:
+            layer = _struct.unpack("<I", data)[0]
+            cert = miscstore.certified_block(self.state, layer)
+            applied = layerstore.applied_block(self.state, layer)
+            return fetch_mod.LayerData(
+                ballots=ballotstore.ids_in_layer(self.state, layer),
+                blocks=blockstore.ids_in_layer(self.state, layer),
+                certified=cert or applied or bytes(32)).to_bytes()
+
+        async def serve_poet_refs(peer: bytes, data: bytes) -> bytes:
+            epoch = _struct.unpack("<I", data)[0]
+            rows = self.state.all(
+                "SELECT ref FROM poet_proofs WHERE round_id=?", (str(epoch),))
+            return b"".join(r["ref"] for r in rows)
+
+        async def serve_beacon(peer: bytes, data: bytes) -> bytes:
+            epoch = _struct.unpack("<I", data)[0]
+            if epoch <= 1:
+                return self.beacon.get_now(epoch)  # protocol-defined bootstrap
+            stored = miscstore.get_beacon(self.state, epoch)
+            return stored or b""  # never serve a fabricated fallback
+
+        self.server.register(fetch_mod.P_EPOCH, serve_epoch)
+        self.server.register(fetch_mod.P_LAYER, serve_layer)
+        self.server.register("pt/1", serve_poet_refs)
+        self.server.register("bk/1", serve_beacon)
+
+        async def process_synced_layer(layer: int, data) -> None:
+            from ..storage import blocks as bs
+
+            if data is not None and data.certified != bytes(32):
+                block = bs.get(self.state, data.certified)
+                if block is not None:
+                    self.mesh.process_hare_output(block, layer)
+                    return
+            self.mesh.process_hare_output(None, layer)
+
+        def resume_point() -> int:
+            # a crash can leave processed ahead of applied; resync from the
+            # lower of the two so the state gap backfills
+            return min(layerstore.processed(self.state),
+                       layerstore.last_applied(self.state))
+
+        self.syncer = Syncer(
+            fetch=self.fetch, current_layer=lambda: int(self.clock.current_layer()),
+            processed_layer=resume_point,
+            process_layer=process_synced_layer,
+            layers_per_epoch=self.cfg.layers_per_epoch,
+            store_beacon=self.beacon.on_fallback)
 
     # --- handlers ------------------------------------------------------
+
+    async def _on_poet(self, peer: bytes, data: bytes) -> bool:
+        from ..consensus.poet import PoetBlob
+
+        try:
+            blob = PoetBlob.from_bytes(data)
+        except Exception:  # noqa: BLE001
+            return False
+        activation.store_poet_blob(self.state, blob)
+        return True
 
     def _on_atx(self, atx) -> None:
         self.events.emit(events_mod.AtxEvent(
@@ -224,8 +384,12 @@ class App:
             if epoch not in seen_epochs:
                 seen_epochs.add(epoch)
                 asyncio.ensure_future(self._epoch_start(epoch))
-            await self.miner.build(layer)
-            await self.hare.run_layer(layer)
+            # proposal building runs concurrently with the session: hare's
+            # preround snapshot waits preround_delay, which covers the
+            # build (VRF slot proofs) + gossip propagation
+            await asyncio.gather(
+                self.miner.build(layer),
+                self.hare.run_layer(layer, self.clock.time_of(layer)))
             self.mesh.process_layer(layer)
             self.events.emit(events_mod.LayerUpdate(layer=layer,
                                                     status="applied"))

@@ -1,0 +1,168 @@
+"""Fetch: hash -> data resolution across peers, with batching.
+
+Mirrors the reference fetch layer (reference fetch/fetch.go: requests are
+coalesced per peer into hash batches, responses stream back blobs which are
+dispatched to per-kind validator callbacks wired at node startup
+node/node.go:1166-1211; server-side handlers expose the local database by
+hint; epoch/layer index endpoints live beside them, fetch/mesh_data.go).
+
+Hints name the store a hash lives in (reference datastore.BlobStore):
+  atx ballot block tx poet active_set malfeasance
+Protocols:
+  hs/1  hashes -> blobs        (reference fetch.go hashProtocol)
+  ep/1  epoch  -> atx id list  (reference "ax/1"-family epoch info)
+  ld/1  layer  -> ballot/block/cert ids (reference layer data)
+"""
+
+from __future__ import annotations
+
+import asyncio
+import struct
+from typing import Awaitable, Callable
+
+from ..core import codec
+from ..core.codec import fixed, u8, u32, var_bytes, vec
+from .server import RequestError, Server
+
+P_HASH = "hs/1"
+P_EPOCH = "ep/1"
+P_LAYER = "ld/1"
+
+HINT_ATX = 0
+HINT_BALLOT = 1
+HINT_BLOCK = 2
+HINT_TX = 3
+HINT_POET = 4
+HINT_ACTIVESET = 5
+HINT_MALFEASANCE = 6
+
+
+@codec.register
+class HashRequest:
+    hint: int
+    hashes: list[bytes]
+    FIELDS = [("hint", u8), ("hashes", vec(fixed(32), 1 << 12))]
+
+
+@codec.register
+class HashResponse:
+    blobs: list[bytes]           # parallel to request; empty = missing
+    FIELDS = [("blobs", vec(var_bytes, 1 << 12))]
+
+
+@codec.register
+class LayerData:
+    ballots: list[bytes]
+    blocks: list[bytes]
+    certified: bytes             # EMPTY32 if none
+    FIELDS = [("ballots", vec(fixed(32))), ("blocks", vec(fixed(32))),
+              ("certified", fixed(32))]
+
+
+# blob readers: hint -> (db, id) -> bytes|None; writers: validator callbacks
+Reader = Callable[[bytes], bytes | None]
+Validator = Callable[[bytes, bytes], Awaitable[bool]]  # (id, blob) -> ok
+
+
+class Fetch:
+    def __init__(self, server: Server, batch_size: int = 128):
+        self.server = server
+        self.batch = batch_size
+        self._readers: dict[int, Reader] = {}
+        self._validators: dict[int, Validator] = {}
+        server.register(P_HASH, self._serve_hashes)
+
+    # --- wiring -----------------------------------------------------
+
+    def set_reader(self, hint: int, reader: Reader) -> None:
+        self._readers[hint] = reader
+
+    def set_validator(self, hint: int, validator: Validator) -> None:
+        """Per-kind ingestion callback (reference fetch.SetValidators)."""
+        self._validators[hint] = validator
+
+    # --- server side ------------------------------------------------
+
+    async def _serve_hashes(self, peer: bytes, data: bytes) -> bytes:
+        req = HashRequest.from_bytes(data)
+        reader = self._readers.get(req.hint)
+        blobs = []
+        for h in req.hashes:
+            blob = reader(h) if reader else None
+            blobs.append(blob if blob is not None else b"")
+        return HashResponse(blobs=blobs).to_bytes()
+
+    # --- client side ------------------------------------------------
+
+    async def get_hashes(self, hint: int, ids: list[bytes]) -> dict[bytes, bool]:
+        """Resolve ids across peers in batches; each retrieved blob goes
+        through the hint's validator. Ids already present locally (the
+        hint's reader answers) are skipped. Returns id -> success."""
+        result = {i: False for i in ids}
+        reader = self._readers.get(hint)
+        missing = []
+        for i in dict.fromkeys(ids):
+            if reader is not None and reader(i) is not None:
+                result[i] = True  # already stored locally
+            else:
+                missing.append(i)
+        peers = self.server.peers()
+        if not peers:
+            return result
+        validator = self._validators.get(hint)
+        for pi, peer in enumerate(peers):
+            if not missing:
+                break
+            still = []
+            for k in range(0, len(missing), self.batch):
+                chunk = missing[k:k + self.batch]
+                try:
+                    resp = HashResponse.from_bytes(await self.server.request(
+                        peer, P_HASH,
+                        HashRequest(hint=hint, hashes=chunk).to_bytes()))
+                except (RequestError, asyncio.TimeoutError, codec.DecodeError):
+                    still.extend(chunk)
+                    continue
+                if len(resp.blobs) != len(chunk):
+                    # short answer: nothing in it is trustworthy-complete;
+                    # retry the whole chunk elsewhere
+                    still.extend(chunk)
+                    continue
+                for h, blob in zip(chunk, resp.blobs):
+                    if not blob:
+                        still.append(h)
+                        continue
+                    ok = await validator(h, blob) if validator else True
+                    result[h] = bool(ok)
+                    if not ok:
+                        still.append(h)
+            missing = still
+        return result
+
+    async def get_epoch_atxs(self, epoch: int) -> list[bytes]:
+        """Union of peers' ATX id lists for the epoch, fetched + validated."""
+        ids: list[bytes] = []
+        seen: set[bytes] = set()
+        for peer in self.server.peers():
+            try:
+                resp = await self.server.request(
+                    peer, P_EPOCH, struct.pack("<I", epoch))
+            except (RequestError, asyncio.TimeoutError):
+                continue
+            for k in range(0, len(resp), 32):
+                i = resp[k:k + 32]
+                if i not in seen:
+                    seen.add(i)
+                    ids.append(i)
+        await self.get_hashes(HINT_ATX, ids)
+        return ids
+
+    async def get_layer_data(self, layer: int) -> LayerData | None:
+        for peer in self.server.peers():
+            try:
+                resp = await self.server.request(
+                    peer, P_LAYER, struct.pack("<I", layer))
+                return LayerData.from_bytes(resp)
+            except (RequestError, asyncio.TimeoutError, codec.DecodeError):
+                continue
+        return None

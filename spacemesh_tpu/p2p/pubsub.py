@@ -27,6 +27,7 @@ TOPIC_BEACON_WEAK_COIN = "bw1"
 TOPIC_HARE = "b1"
 TOPIC_MALFEASANCE = "mp1"
 TOPIC_CERTIFY = "bc1"
+TOPIC_POET = "pt1"
 
 Handler = Callable[[bytes, bytes], Awaitable[bool]]  # (peer, data) -> accept
 
@@ -61,10 +62,19 @@ class PubSub:
 
 
 class LoopbackHub:
-    """Fully-connected in-proc network of PubSub endpoints."""
+    """Fully-connected in-proc network of PubSub endpoints.
+
+    Delivery is fire-and-forget with a per-receiver ordered inbox, like
+    real gossipsub: a publisher never waits on other nodes' validators
+    (a slow or stuck receiver must not be able to stall the sender's
+    consensus rounds), while each receiver still processes messages in
+    arrival order.
+    """
 
     def __init__(self) -> None:
         self._nodes: list[PubSub] = []
+        self._inboxes: dict[int, asyncio.Queue] = {}
+        self._consumers: dict[int, asyncio.Task] = {}
 
     def join(self, ps: PubSub) -> None:
         ps._hub = self
@@ -73,9 +83,35 @@ class LoopbackHub:
     def leave(self, ps: PubSub) -> None:
         ps._hub = None
         self._nodes.remove(ps)
+        task = self._consumers.pop(id(ps), None)
+        if task is not None:
+            task.cancel()
+        self._inboxes.pop(id(ps), None)
+
+    def _inbox(self, ps: PubSub) -> asyncio.Queue:
+        key = id(ps)
+        if key not in self._inboxes:
+            self._inboxes[key] = asyncio.Queue()
+
+            async def consume(node=ps, q=self._inboxes[key]):
+                while True:
+                    topic, peer, data = await q.get()
+                    try:
+                        await node.deliver(topic, peer, data)
+                    except Exception:  # noqa: BLE001
+                        pass
+                    finally:
+                        q.task_done()
+
+            self._consumers[key] = asyncio.ensure_future(consume())
+        return self._inboxes[key]
 
     async def broadcast(self, sender: PubSub, topic: str, data: bytes) -> None:
-        tasks = [n.deliver(topic, sender.name, data)
-                 for n in self._nodes if n is not sender]
-        if tasks:
-            await asyncio.gather(*tasks)
+        for n in self._nodes:
+            if n is not sender:
+                self._inbox(n).put_nowait((topic, sender.name, data))
+
+    async def drain(self) -> None:
+        """Wait until every queued message is fully DELIVERED (join(), not
+        emptiness: the last message may still be mid-handler)."""
+        await asyncio.gather(*(q.join() for q in self._inboxes.values()))

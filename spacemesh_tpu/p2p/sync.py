@@ -1,0 +1,140 @@
+"""Syncer: the catch-up state machine.
+
+Mirrors the reference syncer (reference syncer/syncer.go:60-80 states
+notSynced -> gossipSync -> synced; :474 per-epoch ATX sync via
+atxsync.Download; :372 per-layer data sync; state_syncer.go:34
+processLayers applies certificates/tortoise opinions). A late-joining node
+pulls: poet proofs + epoch ATXs for every epoch up to now, then per-layer
+ballots/blocks/certificates, feeding everything through the SAME gossip
+validators the live path uses — sync and gossip share ingestion, as in the
+reference.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import enum
+from typing import Awaitable, Callable
+
+from .fetch import (
+    Fetch,
+    HINT_ATX,
+    HINT_BALLOT,
+    HINT_BLOCK,
+    HINT_POET,
+    HINT_TX,
+    LayerData,
+)
+
+
+class SyncState(enum.Enum):
+    NOT_SYNCED = "notSynced"
+    GOSSIP = "gossipSync"
+    SYNCED = "synced"
+
+
+class Syncer:
+    def __init__(self, *, fetch: Fetch, current_layer: Callable[[], int],
+                 processed_layer: Callable[[], int],
+                 process_layer: Callable[[int, "LayerData | None"],
+                                         Awaitable[None]],
+                 layers_per_epoch: int,
+                 store_beacon: Callable[[int, bytes], None] | None = None):
+        self.store_beacon = store_beacon
+        self.fetch = fetch
+        self.current_layer = current_layer
+        self.processed_layer = processed_layer
+        self.process_layer = process_layer
+        self.layers_per_epoch = layers_per_epoch
+        self.state = SyncState.NOT_SYNCED
+        self._stop = False
+
+    def is_synced(self) -> bool:
+        return self.state == SyncState.SYNCED
+
+    async def synchronize(self) -> bool:
+        """One sync pass; returns True when caught up to the tip."""
+        tip = self.current_layer()
+        cur_epoch = tip // self.layers_per_epoch
+        # 1) per epoch: beacon, poet proofs, then ATXs (validation order)
+        for epoch in range(0, cur_epoch + 2):
+            await self._sync_beacon(epoch)
+            refs = await self._peer_poet_refs(epoch)
+            if refs:
+                await self.fetch.get_hashes(HINT_POET, refs)
+            await self.fetch.get_epoch_atxs(epoch)
+        # 2) per-layer data up to the tip
+        start = self.processed_layer() + 1
+        for layer in range(start, tip + 1):
+            if self._stop:
+                return False
+            data = await self.fetch.get_layer_data(layer)
+            # recent layers may still be under hare on the peers: without a
+            # certificate, defer them to the next pass instead of wrongly
+            # settling on "empty" (the reference's layerpatrol keeps
+            # hare-owned layers away from the syncer, layerpatrol/patrol.go)
+            recent = layer > tip - 2
+            if recent and (data is None or data.certified == bytes(32)):
+                break
+            if data is not None:
+                await self.fetch.get_hashes(HINT_BALLOT, data.ballots)
+                await self.fetch.get_hashes(HINT_BLOCK, data.blocks)
+            await self.process_layer(layer, data)
+        behind = self.current_layer() - self.processed_layer()
+        if behind <= 1:
+            self.state = SyncState.SYNCED
+        elif behind <= 2:
+            self.state = SyncState.GOSSIP
+        else:
+            self.state = SyncState.NOT_SYNCED
+        return self.state == SyncState.SYNCED
+
+    async def _sync_beacon(self, epoch: int) -> None:
+        """Adopt peers' beacon for the epoch (late joiners never ran the
+        beacon protocol; gossip validation needs the value)."""
+        import struct
+
+        from .server import RequestError
+
+        if self.store_beacon is None:
+            return
+        for peer in self.fetch.server.peers():
+            try:
+                resp = await self.fetch.server.request(
+                    peer, "bk/1", struct.pack("<I", epoch))
+            except (RequestError, asyncio.TimeoutError):
+                continue
+            if len(resp) == 4:
+                self.store_beacon(epoch, resp)
+                return
+
+    async def _peer_poet_refs(self, epoch: int) -> list[bytes]:
+        """Poet proof refs peers hold for the epoch's round."""
+        import struct
+
+        from .server import RequestError
+
+        refs: list[bytes] = []
+        for peer in self.fetch.server.peers():
+            try:
+                resp = await self.fetch.server.request(
+                    peer, "pt/1", struct.pack("<I", epoch))
+            except (RequestError, asyncio.TimeoutError):
+                continue
+            for k in range(0, len(resp), 32):
+                r = resp[k:k + 32]
+                if r not in refs:
+                    refs.append(r)
+        return refs
+
+    async def run(self, interval: float = 1.0) -> None:
+        """Background loop (reference syncer.Start)."""
+        while not self._stop:
+            try:
+                await self.synchronize()
+            except Exception:  # noqa: BLE001 — sync must survive bad peers
+                self.state = SyncState.NOT_SYNCED
+            await asyncio.sleep(interval)
+
+    def stop(self) -> None:
+        self._stop = True

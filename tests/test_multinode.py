@@ -1,0 +1,134 @@
+"""In-proc multi-node network: convergence + late-join sync.
+
+The TestNetwork tier of the reference's test strategy (reference
+node/test_network.go boots N full nodes fully connected in one process):
+node A smeshes; observers B (live from genesis) and C (joins late, syncs)
+must converge on A's ATXs, blocks, and applied state.
+"""
+
+import asyncio
+import time
+
+import pytest
+
+from spacemesh_tpu.core.signing import EdSigner
+from spacemesh_tpu.node import clock as clock_mod
+from spacemesh_tpu.node.app import App
+from spacemesh_tpu.node.config import load
+from spacemesh_tpu.p2p.pubsub import LoopbackHub, PubSub
+from spacemesh_tpu.p2p.server import LoopbackNet
+from spacemesh_tpu.storage import atxs as atxstore
+from spacemesh_tpu.storage import blocks as blockstore
+from spacemesh_tpu.storage import layers as layerstore
+
+LPE = 3
+LAYER_SEC = 0.8
+
+
+# ONE genesis timestamp for the whole network: genesis_id (the signature
+# prefix and golden ATX) derives from it, so per-node values would put the
+# nodes on different networks entirely.
+GENESIS_PLACEHOLDER = float(int(time.time()) + 3600)
+
+
+def _config(tmp_path, name, smesh):
+    return load("standalone", overrides={
+        "data_dir": str(tmp_path / name),
+        "layer_duration": LAYER_SEC,
+        "layers_per_epoch": LPE,
+        "slots_per_layer": 2,
+        "genesis": {"time": GENESIS_PLACEHOLDER},
+        "post": {"labels_per_unit": 256, "scrypt_n": 2, "k1": 64, "k2": 8,
+                 "k3": 4, "min_num_units": 1,
+                 "pow_difficulty": "20" + "ff" * 31},
+        "smeshing": {"start": smesh, "num_units": 1, "init_batch": 128},
+        "hare": {"committee_size": 20, "round_duration": 0.1,
+                 "preround_delay": 0.35, "iteration_limit": 2},
+        "beacon": {"proposal_duration": 0.1},
+        "tortoise": {"hdist": 4, "window_size": 50},
+    })
+
+
+@pytest.fixture(scope="module")
+def network(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("multinode")
+    hub = LoopbackHub()
+    net = LoopbackNet()
+
+    def make(name, smesh):
+        cfg = _config(tmp, name, smesh)
+        signer = EdSigner(prefix=cfg.genesis.genesis_id)
+        ps = PubSub(node_name=signer.node_id)
+        hub.join(ps)
+        app = App(cfg, signer=signer, pubsub=ps)
+        app.connect_network(net)
+        return app
+
+    a = make("a", smesh=True)
+    b = make("b", smesh=False)
+    c_holder = {}
+
+    async def go():
+        await a.prepare()
+        genesis = time.time() + 0.3
+        for app in (a, b):
+            app.clock = clock_mod.LayerClock(genesis, LAYER_SEC)
+        until = 2 * LPE + 1
+        task_a = asyncio.create_task(a.run(until_layer=until))
+        task_b = asyncio.create_task(b.run(until_layer=until))
+        # C joins after one full epoch has passed
+        await asyncio.sleep(LAYER_SEC * (LPE + 1))
+        c = make("c", smesh=False)
+        c.clock = clock_mod.LayerClock(genesis, LAYER_SEC)
+        c_holder["app"] = c
+        synced = await c.syncer.synchronize()
+        await asyncio.gather(task_a, task_b)
+        # final catch-up pass after A/B stopped
+        await c.syncer.synchronize()
+        return synced
+
+    asyncio.run(asyncio.wait_for(go(), timeout=180))
+    return a, b, c_holder["app"]
+
+
+def test_atx_propagates_to_observers(network):
+    a, b, c = network
+    for epoch in (0, 1):
+        mine = atxstore.by_node_in_epoch(a.state, a.signer.node_id, epoch)
+        assert mine is not None
+        assert atxstore.get(b.state, mine.id) is not None, f"B missing epoch-{epoch} ATX"
+        assert atxstore.get(c.state, mine.id) is not None, f"C missing epoch-{epoch} ATX"
+
+
+def test_blocks_converge_on_live_observer(network):
+    a, b, c = network
+    layers_with_blocks = [lyr for lyr in range(LPE, 2 * LPE + 2)
+                          if blockstore.in_layer(a.state, lyr)]
+    assert layers_with_blocks, "A generated no blocks"
+    for lyr in layers_with_blocks:
+        ids_a = blockstore.ids_in_layer(a.state, lyr)
+        ids_b = blockstore.ids_in_layer(b.state, lyr)
+        assert ids_a == ids_b, f"layer {lyr}: A and B disagree on blocks"
+
+
+def test_late_joiner_catches_up(network):
+    a, b, c = network
+    # C fetched A's blocks and applied layers up to (near) the tip
+    applied_a = layerstore.last_applied(a.state)
+    applied_c = layerstore.last_applied(c.state)
+    assert applied_c >= applied_a - 1, (applied_c, applied_a)
+    for lyr in range(LPE, applied_c + 1):
+        ids_a = blockstore.ids_in_layer(a.state, lyr)
+        ids_c = blockstore.ids_in_layer(c.state, lyr)
+        assert ids_a == ids_c, f"layer {lyr}: A and C disagree on blocks"
+
+
+def test_state_roots_converge(network):
+    a, b, c = network
+    lyr = min(layerstore.last_applied(a.state), layerstore.last_applied(b.state),
+              layerstore.last_applied(c.state))
+    assert lyr >= LPE
+    ra = layerstore.state_hash(a.state, lyr)
+    rb = layerstore.state_hash(b.state, lyr)
+    rc = layerstore.state_hash(c.state, lyr)
+    assert ra == rb == rc, f"state divergence at layer {lyr}"
