@@ -33,6 +33,21 @@ def cpu_labels_per_sec(commitment: bytes, n: int, count: int) -> float:
     return count / dt
 
 
+def _probe_device(timeout_s: int = 120) -> bool:
+    """Check the accelerator answers at all, in a SUBPROCESS with a hard
+    timeout: a wedged TPU tunnel hangs jax.devices() forever, and the
+    driver must still get a JSON line (CPU fallback) rather than nothing."""
+    import subprocess
+
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=timeout_s, capture_output=True)
+        return r.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
 def main() -> None:
     n = int(os.environ.get("BENCH_N", 8192))
     reps = int(os.environ.get("BENCH_REPS", 3))
@@ -42,12 +57,26 @@ def main() -> None:
 
     commitment = hashlib.sha256(b"bench-commitment").digest()
 
+    fallback = ""
+    if not _probe_device():
+        log("accelerator unreachable; falling back to CPU platform")
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+        fallback = "_cpufallback"
+        batches = [b for b in batches if b <= 2048] or [1024]
+
     import jax
     import jax.numpy as jnp
     import numpy as np
 
     from spacemesh_tpu.ops import scrypt
 
+    if fallback:
+        # the env var alone is too late: the container's sitecustomize
+        # imported jax (and latched its config) before main() ran — the
+        # config.update is the one that actually takes effect; the env var
+        # covers any subprocesses
+        jax.config.update("jax_platforms", "cpu")
     dev = jax.devices()[0]
     log(f"device: {dev} platform={dev.platform}")
 
@@ -83,7 +112,7 @@ def main() -> None:
     log(f"cpu: {cpu_rate:,.1f} labels/s (single core, OpenSSL)")
 
     print(json.dumps({
-        "metric": f"post_init_labels_per_sec_n{n}_b{best_batch}",
+        "metric": f"post_init_labels_per_sec_n{n}_b{best_batch}{fallback}",
         "value": round(best_rate, 1),
         "unit": "labels/s",
         "vs_baseline": round(best_rate / cpu_rate, 2),

@@ -1,0 +1,11 @@
+"""Node API: the operator/client surface.
+
+Mirrors the reference api/grpcserver service set (reference
+api/grpcserver/config.go: Node, Mesh, GlobalState, Transaction, Smesher,
+Debug, Admin, Activation services + the grpc-gateway JSON endpoint
+http_server.go). Served as JSON-over-HTTP (aiohttp) with the same
+public/private listener split; an event stream endpoint replaces the gRPC
+streaming services.
+"""
+
+from .http import ApiServer  # noqa: F401

@@ -1,0 +1,308 @@
+"""JSON-over-HTTP API server.
+
+Service -> route map (reference api/grpcserver; JSON gateway semantics):
+
+  NodeService        GET  /v1/node/status, /v1/node/version
+  MeshService        GET  /v1/mesh/genesis, /v1/mesh/layer/{n},
+                          /v1/mesh/epoch/{e}/atxs
+  GlobalState        GET  /v1/account/{bech32}, /v1/account/{bech32}/rewards,
+                          /v1/globalstate/root
+  TransactionService POST /v1/tx/submit {"raw": hex}; GET /v1/tx/{id}
+  ActivationService  GET  /v1/atx/{id}
+  SmesherService     GET  /v1/smesher/status
+  DebugService       GET  /v1/debug/state
+  AdminService       POST /v1/admin/checkpoint {"path": ...},
+                     POST /v1/admin/recover {"path": ...}
+  EventsService      GET  /v1/events?timeout=s  (long-poll drain)
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+
+from aiohttp import web
+
+from ..core.types import Address, Transaction
+from ..node import checkpoint as checkpoint_mod
+from ..node import events as events_mod
+from ..storage import atxs as atxstore
+from ..storage import ballots as ballotstore
+from ..storage import blocks as blockstore
+from ..storage import layers as layerstore
+from ..storage import misc as miscstore
+from ..storage import transactions as txstore
+from ..vm.vm import TxValidity
+
+API_VERSION = "v0.1.0"
+
+
+def _hex(b: bytes | None) -> str | None:
+    return b.hex() if b is not None else None
+
+
+class ApiServer:
+    def __init__(self, app, listen: str = "127.0.0.1:0"):
+        self.node = app
+        host, _, port = listen.rpartition(":")
+        self.host = host or "127.0.0.1"
+        self.port = int(port or 0)
+        self.web_app = web.Application()
+        self._routes()
+        self.runner: web.AppRunner | None = None
+        self.actual_port: int | None = None
+
+    def _routes(self) -> None:
+        r = self.web_app.router
+        r.add_get("/v1/node/status", self.node_status)
+        r.add_get("/v1/node/version", self.node_version)
+        r.add_get("/v1/mesh/genesis", self.mesh_genesis)
+        r.add_get("/v1/mesh/layer/{layer}", self.mesh_layer)
+        r.add_get("/v1/mesh/epoch/{epoch}/atxs", self.epoch_atxs)
+        r.add_get("/v1/account/{address}", self.account)
+        r.add_get("/v1/account/{address}/rewards", self.account_rewards)
+        r.add_get("/v1/globalstate/root", self.state_root)
+        r.add_post("/v1/tx/submit", self.tx_submit)
+        r.add_get("/v1/tx/{tx_id}", self.tx_get)
+        r.add_get("/v1/atx/{atx_id}", self.atx_get)
+        r.add_get("/v1/smesher/status", self.smesher_status)
+        r.add_get("/v1/debug/state", self.debug_state)
+        r.add_post("/v1/admin/checkpoint", self.admin_checkpoint)
+        r.add_post("/v1/admin/recover", self.admin_recover)
+        r.add_get("/v1/events", self.events)
+
+    # --- lifecycle ---------------------------------------------------
+
+    async def start(self) -> int:
+        self.runner = web.AppRunner(self.web_app)
+        await self.runner.setup()
+        site = web.TCPSite(self.runner, self.host, self.port)
+        await site.start()
+        self.actual_port = site._server.sockets[0].getsockname()[1]
+        return self.actual_port
+
+    async def stop(self) -> None:
+        if self.runner is not None:
+            await self.runner.cleanup()
+
+    # --- NodeService -------------------------------------------------
+
+    async def node_status(self, req) -> web.Response:
+        n = self.node
+        synced = n.syncer.is_synced() if n.syncer else True
+        return web.json_response({
+            "status": {
+                "connected_peers": len(n.server.peers()) if n.server else 0,
+                "is_synced": synced,
+                "synced_layer": layerstore.processed(n.state),
+                "top_layer": int(n.clock.current_layer()),
+                "verified_layer": n.tortoise.verified,
+            }})
+
+    async def node_version(self, req) -> web.Response:
+        return web.json_response({"version": API_VERSION})
+
+    # --- MeshService -------------------------------------------------
+
+    async def mesh_genesis(self, req) -> web.Response:
+        g = self.node.cfg.genesis
+        return web.json_response({
+            "genesis_time": g.time,
+            "genesis_id": g.genesis_id.hex(),
+            "layer_duration": self.node.cfg.layer_duration,
+            "layers_per_epoch": self.node.cfg.layers_per_epoch,
+        })
+
+    async def mesh_layer(self, req) -> web.Response:
+        try:
+            layer = int(req.match_info["layer"])
+        except ValueError:
+            raise web.HTTPBadRequest(text="layer must be an integer")
+        blocks = blockstore.in_layer(self.node.state, layer)
+        return web.json_response({
+            "layer": layer,
+            "blocks": [{
+                "id": b.id.hex(),
+                "tx_ids": [t.hex() for t in b.tx_ids],
+                "rewards": [{"coinbase": Address(r.coinbase).encode(),
+                             "weight": r.weight} for r in b.rewards],
+            } for b in blocks],
+            "ballots": [b.hex() for b in
+                        ballotstore.ids_in_layer(self.node.state, layer)],
+            "applied_block": _hex(layerstore.applied_block(self.node.state,
+                                                           layer)),
+            "state_hash": _hex(layerstore.state_hash(self.node.state, layer)),
+            "certified": _hex(miscstore.certified_block(self.node.state,
+                                                        layer)),
+        })
+
+    async def epoch_atxs(self, req) -> web.Response:
+        try:
+            epoch = int(req.match_info["epoch"])
+        except ValueError:
+            raise web.HTTPBadRequest(text="epoch must be an integer")
+        ids = atxstore.ids_in_epoch(self.node.state, epoch)
+        return web.json_response({"epoch": epoch,
+                                  "atxs": [i.hex() for i in ids]})
+
+    # --- GlobalState -------------------------------------------------
+
+    def _addr(self, req) -> bytes:
+        raw = req.match_info["address"]
+        try:
+            if raw.startswith("0x"):
+                return bytes.fromhex(raw[2:])
+            return Address.decode(raw).raw
+        except ValueError as e:
+            raise web.HTTPBadRequest(text=f"bad address: {e}")
+
+    async def account(self, req) -> web.Response:
+        addr = self._addr(req)
+        row = txstore.account(self.node.state, addr)
+        return web.json_response({
+            "address": Address(addr).encode(),
+            "balance": row["balance"] if row else 0,
+            "next_nonce": row["next_nonce"] if row else 0,
+            "template": _hex(row["template"]) if row else None,
+        })
+
+    async def account_rewards(self, req) -> web.Response:
+        addr = self._addr(req)
+        rewards = miscstore.rewards_for(self.node.state, addr)
+        return web.json_response({
+            "rewards": [{"layer": lyr, "total": total}
+                        for lyr, total in rewards]})
+
+    async def state_root(self, req) -> web.Response:
+        layer = layerstore.last_applied(self.node.state)
+        return web.json_response({
+            "layer": layer,
+            "root": _hex(layerstore.state_hash(self.node.state, layer))})
+
+    # --- Transactions ------------------------------------------------
+
+    async def tx_submit(self, req) -> web.Response:
+        try:
+            body = await req.json()
+            raw = bytes.fromhex(body["raw"])
+        except (json.JSONDecodeError, KeyError, ValueError):
+            raise web.HTTPBadRequest(text='expected {"raw": "<hex>"}')
+        tx = Transaction(raw=raw)
+        validity = self.node.cstate.add(tx)
+        if validity == TxValidity.VALID:
+            from ..p2p.pubsub import TOPIC_TX
+
+            await self.node.pubsub.publish(TOPIC_TX, raw)
+        return web.json_response({
+            "tx_id": tx.id.hex(),
+            "status": validity.name,
+            "accepted": validity == TxValidity.VALID,
+        }, status=200 if validity == TxValidity.VALID else 422)
+
+    async def tx_get(self, req) -> web.Response:
+        try:
+            tx_id = bytes.fromhex(req.match_info["tx_id"])
+        except ValueError:
+            raise web.HTTPBadRequest(text="tx id must be hex")
+        tx = txstore.get_tx(self.node.state, tx_id)
+        if tx is None:
+            raise web.HTTPNotFound(text="unknown transaction")
+        res = txstore.result(self.node.state, tx_id)
+        return web.json_response({
+            "tx_id": tx_id.hex(),
+            "raw": tx.raw.hex(),
+            "result": None if res is None else {
+                "status": res.status, "message": res.message,
+                "gas_consumed": res.gas_consumed, "fee": res.fee,
+                "layer": res.layer,
+            }})
+
+    # --- Activation / Smesher ----------------------------------------
+
+    async def atx_get(self, req) -> web.Response:
+        try:
+            atx_id = bytes.fromhex(req.match_info["atx_id"])
+        except ValueError:
+            raise web.HTTPBadRequest(text="atx id must be hex")
+        atx = atxstore.get(self.node.state, atx_id)
+        if atx is None:
+            raise web.HTTPNotFound(text="unknown atx")
+        return web.json_response({
+            "id": atx_id.hex(),
+            "node_id": atx.node_id.hex(),
+            "publish_epoch": atx.publish_epoch,
+            "num_units": atx.num_units,
+            "coinbase": Address(atx.coinbase).encode(),
+            "prev_atx": atx.prev_atx.hex(),
+            "tick_height": atxstore.tick_height(self.node.state, atx_id),
+        })
+
+    async def smesher_status(self, req) -> web.Response:
+        n = self.node
+        registered = (n.post_service.registered()
+                      if n.post_service is not None else [])
+        return web.json_response({
+            "smeshing": n.atx_builder is not None,
+            "node_id": n.signer.node_id.hex(),
+            "registered_post_identities": [i.hex() for i in registered],
+        })
+
+    # --- Debug / Admin -----------------------------------------------
+
+    async def debug_state(self, req) -> web.Response:
+        n = self.node
+        return web.json_response({
+            "verified_layer": n.tortoise.verified,
+            "processed_layer": layerstore.processed(n.state),
+            "last_applied": layerstore.last_applied(n.state),
+            "mempool": n.cstate.pending_count(),
+            "malicious_identities":
+                [i.hex() for i in miscstore.all_malicious(n.state)],
+        })
+
+    async def admin_checkpoint(self, req) -> web.Response:
+        try:
+            body = await req.json()
+            path = body["path"]
+        except (json.JSONDecodeError, KeyError):
+            raise web.HTTPBadRequest(text='expected {"path": ...}')
+        snap = checkpoint_mod.write(self.node.state, path)
+        return web.json_response({"layer": snap["layer"],
+                                  "accounts": len(snap["accounts"]),
+                                  "atxs": len(snap["atxs"])})
+
+    async def admin_recover(self, req) -> web.Response:
+        try:
+            body = await req.json()
+            path = body["path"]
+        except (json.JSONDecodeError, KeyError):
+            raise web.HTTPBadRequest(text='expected {"path": ...}')
+        snap = checkpoint_mod.recover_file(
+            self.node.state, path, preserve_node_id=self.node.signer.node_id)
+        return web.json_response({"recovered_layer": snap["layer"]})
+
+    # --- Events ------------------------------------------------------
+
+    async def events(self, req) -> web.Response:
+        timeout = float(req.query.get("timeout", "1.0"))
+        sub = self.node.events.subscribe(
+            events_mod.LayerUpdate, events_mod.AtxEvent, events_mod.TxEvent,
+            events_mod.BeaconEvent, events_mod.PostEvent,
+            events_mod.AtxPublished, events_mod.Malfeasance)
+        out = []
+        try:
+            end = asyncio.get_event_loop().time() + timeout
+            while True:
+                remaining = end - asyncio.get_event_loop().time()
+                if remaining <= 0:
+                    break
+                try:
+                    ev = await asyncio.wait_for(sub.next(), timeout=remaining)
+                except asyncio.TimeoutError:
+                    break
+                out.append({"type": type(ev).__name__,
+                            **{k: (v.hex() if isinstance(v, bytes) else v)
+                               for k, v in ev.__dict__.items()}})
+        finally:
+            sub.close()
+        return web.json_response({"events": out})

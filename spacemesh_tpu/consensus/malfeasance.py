@@ -1,0 +1,117 @@
+"""Malfeasance: proofs of protocol violations + the gossip handler.
+
+Mirrors the reference malfeasance package (reference malfeasance/handler.go:
+proof types MultipleATXs / MultipleBallots / HareEquivocation with
+per-domain validators registered from each package; on a valid proof the
+identity is persisted as malicious and marked everywhere — tortoise, ATX
+cache — and the proof regossiped; self-defense check skips proofs against
+the local node unless real).
+
+A proof here is two distinct signed messages from one identity in the same
+protocol slot (core/types.MalfeasanceProof): domain picks the conflict rule.
+"""
+
+from __future__ import annotations
+
+from typing import Awaitable, Callable, Optional
+
+from ..core import codec
+from ..core.signing import Domain, EdVerifier
+from ..core.types import ActivationTx, Ballot, MalfeasanceProof
+from ..p2p.pubsub import TOPIC_MALFEASANCE, PubSub
+from ..storage import misc as miscstore
+from ..storage.cache import AtxCache
+from ..storage.db import Database
+
+
+def proof_from_ballots(b1: Ballot, b2: Ballot) -> MalfeasanceProof:
+    return MalfeasanceProof(
+        domain=int(Domain.BALLOT), msg1=b1.signed_bytes(), sig1=b1.signature,
+        msg2=b2.signed_bytes(), sig2=b2.signature, node_id=b1.node_id)
+
+
+def proof_from_atxs(a1: ActivationTx, a2: ActivationTx) -> MalfeasanceProof:
+    return MalfeasanceProof(
+        domain=int(Domain.ATX), msg1=a1.signed_bytes(), sig1=a1.signature,
+        msg2=a2.signed_bytes(), sig2=a2.signature, node_id=a1.node_id)
+
+
+def proof_from_hare(node_id: bytes, msg1: bytes, sig1: bytes, msg2: bytes,
+                    sig2: bytes) -> MalfeasanceProof:
+    return MalfeasanceProof(domain=int(Domain.HARE), msg1=msg1, sig1=sig1,
+                            msg2=msg2, sig2=sig2, node_id=node_id)
+
+
+def _conflicting(domain: int, msg1: bytes, msg2: bytes) -> bool:
+    """Domain rule: the two messages occupy the same protocol slot."""
+    try:
+        if domain == int(Domain.BALLOT):
+            b1 = Ballot.from_bytes(msg1)
+            b2 = Ballot.from_bytes(msg2)
+            return b1.layer == b2.layer and b1.node_id == b2.node_id
+        if domain == int(Domain.ATX):
+            a1 = ActivationTx.from_bytes(msg1)
+            a2 = ActivationTx.from_bytes(msg2)
+            return (a1.publish_epoch == a2.publish_epoch
+                    and a1.node_id == a2.node_id)
+        if domain == int(Domain.HARE):
+            from .hare import HareMessage
+
+            h1 = HareMessage.from_bytes(msg1)
+            h2 = HareMessage.from_bytes(msg2)
+            return (h1.layer, h1.iteration, h1.round, h1.node_id) == \
+                   (h2.layer, h2.iteration, h2.round, h2.node_id)
+    except (codec.DecodeError, ValueError, TypeError):
+        return False
+    return False
+
+
+class Handler:
+    def __init__(self, *, db: Database, cache: AtxCache,
+                 verifier: EdVerifier, pubsub: PubSub,
+                 tortoise=None,
+                 on_malicious: Optional[Callable[[bytes], None]] = None):
+        self.db = db
+        self.cache = cache
+        self.verifier = verifier
+        self.pubsub = pubsub
+        self.tortoise = tortoise
+        self.on_malicious = on_malicious
+        pubsub.register(TOPIC_MALFEASANCE, self._gossip)
+
+    def validate(self, proof: MalfeasanceProof) -> bool:
+        if proof.msg1 == proof.msg2:
+            return False
+        dom = Domain(proof.domain) if proof.domain in set(Domain) else None
+        if dom is None:
+            return False
+        if not (self.verifier.verify(dom, proof.node_id, proof.msg1, proof.sig1)
+                and self.verifier.verify(dom, proof.node_id, proof.msg2,
+                                         proof.sig2)):
+            return False
+        return _conflicting(proof.domain, proof.msg1, proof.msg2)
+
+    def process(self, proof: MalfeasanceProof) -> bool:
+        if miscstore.is_malicious(self.db, proof.node_id):
+            return True  # already known; don't regossip storms
+        if not self.validate(proof):
+            return False
+        with self.db.tx():
+            miscstore.set_malicious(self.db, proof.node_id, proof)
+        self.cache.set_malicious(proof.node_id)
+        if self.tortoise is not None:
+            self.tortoise.on_malfeasance(proof.node_id)
+        if self.on_malicious:
+            self.on_malicious(proof.node_id)
+        return True
+
+    async def _gossip(self, peer: bytes, data: bytes) -> bool:
+        try:
+            proof = MalfeasanceProof.from_bytes(data)
+        except (codec.DecodeError, ValueError):
+            return False
+        return self.process(proof)
+
+    async def publish(self, proof: MalfeasanceProof) -> None:
+        if self.process(proof):
+            await self.pubsub.publish(TOPIC_MALFEASANCE, proof.to_bytes())

@@ -23,6 +23,8 @@ def main(argv=None) -> int:
                    help="stop after this layer (default: run forever)")
     p.add_argument("--genesis-now", action="store_true",
                    help="set genesis time to now + one layer")
+    p.add_argument("--api", action="store_true",
+                   help="serve the JSON API on api.private_listener")
     a = p.parse_args(argv)
 
     from .app import App
@@ -49,7 +51,13 @@ def main(argv=None) -> int:
                       flush=True)
 
         reporter = asyncio.ensure_future(report())
+        api_started = False
         try:
+            if a.api:
+                port = await app.start_api()
+                api_started = True
+                print(json.dumps({"event": "ApiStarted", "port": port}),
+                      flush=True)
             await app.prepare()
             if a.genesis_now:
                 # rebase the CLOCK only, after the slow prepare (POST init,
@@ -61,6 +69,8 @@ def main(argv=None) -> int:
             await app.run(until_layer=a.until_layer)
         finally:
             reporter.cancel()
+            if api_started:
+                await app.api.stop()  # stop accepting before the DB closes
             app.close()
 
     try:

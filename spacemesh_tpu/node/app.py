@@ -18,6 +18,7 @@ import time
 from pathlib import Path
 
 from ..consensus import activation, beacon as beacon_mod, blocks, eligibility
+from ..consensus import malfeasance as malfeasance_mod
 from ..consensus import hare as hare_mod
 from ..consensus import mesh as mesh_mod
 from ..consensus import miner as miner_mod
@@ -118,12 +119,28 @@ class App:
             oracle=self.oracle, tortoise=self.tortoise, cstate=self.cstate,
             pubsub=self.pubsub, layers_per_epoch=cfg.layers_per_epoch,
             beacon_getter=self.beacon.get)
+        self.malfeasance = malfeasance_mod.Handler(
+            db=self.state, cache=self.cache, verifier=self.verifier,
+            pubsub=self.pubsub, tortoise=self.tortoise,
+            on_malicious=lambda nid: self.events.emit(
+                events_mod.Malfeasance(node_id=nid)))
+
+        def on_double_ballot(node_id, b1, b2):
+            proof = malfeasance_mod.proof_from_ballots(b1, b2)
+            # track the task: the loop keeps only weak refs, and a dropped
+            # publish would silently swallow the malfeasance proof
+            task = asyncio.ensure_future(self.malfeasance.publish(proof))
+            self._tasks.append(task)
+            task.add_done_callback(
+                lambda t: self._tasks.remove(t) if t in self._tasks else None)
+
         self.proposal_handler = miner_mod.ProposalHandler(
             db=self.state, cache=self.cache, oracle=self.oracle,
             tortoise=self.tortoise, store=self.proposal_store,
             verifier=self.verifier, pubsub=self.pubsub,
             layers_per_epoch=cfg.layers_per_epoch,
-            beacon_getter=self.beacon.get)
+            beacon_getter=self.beacon.get,
+            on_malfeasance=on_double_ballot)
         self.hare = hare_mod.Hare(
             signer=self.signer, verifier=self.verifier, oracle=self.oracle,
             pubsub=self.pubsub, committee_size=cfg.hare.committee_size,
@@ -372,9 +389,16 @@ class App:
             await self.start_smeshing()
             await self.publish_atx(0)
 
+    async def start_api(self) -> int:
+        """Start the JSON API (reference startAPIServices, node.go:1603)."""
+        from ..api import ApiServer
+
+        self.api = ApiServer(self, listen=self.cfg.api.private_listener)
+        return await self.api.start()
+
     async def run(self, until_layer: int | None = None) -> None:
-        """The main layer loop (standalone-complete; networked sync lands
-        with M3)."""
+        """The main layer loop (callers wanting the API call start_api()
+        first, as __main__ --api does)."""
         cfg = self.cfg
         if cfg.smeshing.start and self.atx_builder is None:
             await self.prepare()

@@ -1,0 +1,116 @@
+"""Checkpoint: state snapshot + restore ("quicksync").
+
+Mirrors the reference checkpoint package (reference checkpoint/runner.go:31
+Generate writes a JSON snapshot of accounts + essential ATX chain data at a
+layer; recovery.go:111 Recover wipes the database and bootstraps from the
+snapshot, preserving the node's own ATX lineage :401; triggered by the
+admin API or config at startup).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from pathlib import Path
+
+from ..core.types import ActivationTx
+from ..storage import atxs as atxstore
+from ..storage import layers as layerstore
+from ..storage import misc as miscstore
+from ..storage import transactions as txstore
+from ..storage.db import Database
+
+VERSION = 1
+
+
+def generate(db: Database, layer: int | None = None) -> dict:
+    """Snapshot accounts (latest state) + all ATXs + beacons at ``layer``
+    (default: last applied)."""
+    if layer is None:
+        layer = layerstore.last_applied(db)
+    accounts = []
+    for row in txstore.all_current_accounts(db):
+        accounts.append({
+            "address": row["address"].hex(),
+            "balance": row["balance"],
+            "next_nonce": row["next_nonce"],
+            "template": row["template"].hex() if row["template"] else None,
+            "state": row["state"].hex() if row["state"] else None,
+        })
+    atxs = [r["data"].hex() for r in
+            db.all("SELECT data FROM atxs ORDER BY publish_epoch, id")]
+    ticks = {r["id"].hex(): r["tick_height"] for r in
+             db.all("SELECT id, tick_height FROM atxs")}
+    beacons = {str(r["epoch"]): r["beacon"].hex() for r in
+               db.all("SELECT epoch, beacon FROM beacons")}
+    return {
+        "version": VERSION,
+        "timestamp": int(time.time()),
+        "layer": layer,
+        "state_hash": (layerstore.state_hash(db, layer) or b"").hex(),
+        "accounts": accounts,
+        "atxs": atxs,
+        "atx_ticks": ticks,
+        "beacons": beacons,
+    }
+
+
+def write(db: Database, path: str | Path, layer: int | None = None) -> dict:
+    snapshot = generate(db, layer)
+    p = Path(path)
+    p.parent.mkdir(parents=True, exist_ok=True)
+    tmp = p.with_suffix(".tmp")
+    tmp.write_text(json.dumps(snapshot))
+    tmp.replace(p)
+    return snapshot
+
+
+def recover(db: Database, snapshot: dict, *,
+            preserve_node_id: bytes | None = None) -> None:
+    """Wipe consensus tables and restore from the snapshot. ATXs belonging
+    to ``preserve_node_id`` that are NOT in the snapshot survive (the
+    reference preserves the node's own ATX lineage so it can keep smeshing
+    across a checkpoint recovery)."""
+    if snapshot.get("version") != VERSION:
+        raise ValueError(f"unsupported checkpoint version "
+                         f"{snapshot.get('version')}")
+    own: list[tuple] = []
+    if preserve_node_id is not None:
+        own = [tuple(r) for r in db.all(
+            "SELECT id, node_id, publish_epoch, num_units, tick_height,"
+            " vrf_nonce, coinbase, received, data FROM atxs WHERE node_id=?",
+            (preserve_node_id,))]
+    with db.tx():
+        for table in ("atxs", "ballots", "blocks", "layers", "certificates",
+                      "beacons", "transactions", "accounts", "rewards",
+                      "poet_proofs", "active_sets"):
+            db.exec(f"DELETE FROM {table}")
+        layer = snapshot["layer"]
+        for acct in snapshot["accounts"]:
+            txstore.update_account(
+                db, bytes.fromhex(acct["address"]), layer, acct["balance"],
+                acct["next_nonce"],
+                bytes.fromhex(acct["template"]) if acct["template"] else None,
+                bytes.fromhex(acct["state"]) if acct["state"] else None)
+        ticks = snapshot.get("atx_ticks", {})
+        for blob in snapshot["atxs"]:
+            atx = ActivationTx.from_bytes(bytes.fromhex(blob))
+            atxstore.add(db, atx,
+                         tick_height=ticks.get(atx.id.hex(), 0))
+        for epoch, beacon in snapshot.get("beacons", {}).items():
+            miscstore.set_beacon(db, int(epoch), bytes.fromhex(beacon))
+        for row in own:
+            db.exec(
+                "INSERT OR IGNORE INTO atxs (id, node_id, publish_epoch,"
+                " num_units, tick_height, vrf_nonce, coinbase, received,"
+                " data) VALUES (?,?,?,?,?,?,?,?,?)", row)
+        state_hash = bytes.fromhex(snapshot["state_hash"]) or bytes(32)
+        layerstore.set_applied(db, layer, bytes(32), state_hash)
+        layerstore.set_processed(db, layer)
+
+
+def recover_file(db: Database, path: str | Path,
+                 preserve_node_id: bytes | None = None) -> dict:
+    snapshot = json.loads(Path(path).read_text())
+    recover(db, snapshot, preserve_node_id=preserve_node_id)
+    return snapshot

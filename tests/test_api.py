@@ -1,0 +1,114 @@
+"""API surface over a live standalone node."""
+
+import asyncio
+import time
+
+import pytest
+from aiohttp import ClientSession
+
+from spacemesh_tpu.node import clock as clock_mod
+from spacemesh_tpu.node.app import App
+from spacemesh_tpu.node.config import load
+from spacemesh_tpu.vm import sdk
+
+LPE = 3
+LAYER_SEC = 0.7
+
+
+@pytest.fixture(scope="module")
+def api_env(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("api")
+    cfg = load("standalone", overrides={
+        "data_dir": str(tmp / "node"),
+        "layer_duration": LAYER_SEC,
+        "layers_per_epoch": LPE,
+        "slots_per_layer": 2,
+        "genesis": {"time": time.time() + 3600},
+        "post": {"labels_per_unit": 256, "scrypt_n": 2, "k1": 64, "k2": 8,
+                 "k3": 4, "min_num_units": 1,
+                 "pow_difficulty": "20" + "ff" * 31},
+        "smeshing": {"start": True, "num_units": 1, "init_batch": 128},
+        "hare": {"committee_size": 20, "round_duration": 0.06,
+                 "preround_delay": 0.2, "iteration_limit": 2},
+        "beacon": {"proposal_duration": 0.05},
+        "tortoise": {"hdist": 4, "window_size": 50},
+    })
+    app = App(cfg)
+    results = {}
+
+    async def go():
+        await app.prepare()
+        port = await app.start_api()
+        app.clock = clock_mod.LayerClock(time.time() + 0.3, LAYER_SEC)
+        run = asyncio.create_task(app.run(until_layer=2 * LPE))
+        base = f"http://127.0.0.1:{port}"
+        async with ClientSession() as s:
+            # let a couple of layers pass
+            await asyncio.sleep(LAYER_SEC * (LPE + 1.5))
+            results["status"] = await (await s.get(f"{base}/v1/node/status")).json()
+            results["genesis"] = await (await s.get(f"{base}/v1/mesh/genesis")).json()
+            results["atxs_e1"] = await (await s.get(f"{base}/v1/mesh/epoch/1/atxs")).json()
+            results["smesher"] = await (await s.get(f"{base}/v1/smesher/status")).json()
+            # wait for the first reward so the account can pay the tx fee
+            coinbase = sdk.wallet_address(app.signer.public_key)
+            for _ in range(40):
+                acct = await (await s.get(
+                    f"{base}/v1/account/{coinbase.encode()}")).json()
+                if acct["balance"] > 0:
+                    break
+                await asyncio.sleep(LAYER_SEC / 4)
+            results["acct_pre"] = acct
+            spawn = sdk.spawn_wallet(app.signer)
+            r = await s.post(f"{base}/v1/tx/submit",
+                             json={"raw": spawn.raw.hex()})
+            results["submit"] = (r.status, await r.json())
+            results["bad_submit"] = (await s.post(
+                f"{base}/v1/tx/submit", json={"raw": "zz"})).status
+            results["tx_lookup_404"] = (await s.get(
+                f"{base}/v1/tx/{'00'*32}")).status
+            await asyncio.sleep(LAYER_SEC * 2.2)
+            results["tx_after"] = await (await s.get(
+                f"{base}/v1/tx/{results['submit'][1]['tx_id']}")).json()
+            results["layer3"] = await (await s.get(f"{base}/v1/mesh/layer/3")).json()
+            results["root"] = await (await s.get(f"{base}/v1/globalstate/root")).json()
+            results["debug"] = await (await s.get(f"{base}/v1/debug/state")).json()
+            results["events"] = await (await s.get(
+                f"{base}/v1/events?timeout=0.3")).json()
+        await run
+        await app.api.stop()
+
+    asyncio.run(asyncio.wait_for(go(), timeout=120))
+    return app, results
+
+
+def test_node_and_genesis(api_env):
+    app, r = api_env
+    assert r["status"]["status"]["top_layer"] >= 3
+    assert r["genesis"]["layers_per_epoch"] == LPE
+    assert r["genesis"]["genesis_id"] == app.cfg.genesis.genesis_id.hex()
+
+
+def test_epoch_atxs_and_smesher(api_env):
+    app, r = api_env
+    assert len(r["atxs_e1"]["atxs"]) == 1
+    assert r["smesher"]["smeshing"] is True
+    assert r["smesher"]["node_id"] == app.signer.node_id.hex()
+
+
+def test_tx_submit_and_result(api_env):
+    app, r = api_env
+    status, body = r["submit"]
+    assert status == 200 and body["accepted"]
+    assert r["bad_submit"] == 400
+    assert r["tx_lookup_404"] == 404
+    # the spawn applied in a later layer
+    assert r["tx_after"]["result"] is not None
+    assert r["tx_after"]["result"]["status"] == 0
+
+
+def test_layer_and_state(api_env):
+    app, r = api_env
+    assert r["root"]["root"] is not None
+    assert r["debug"]["last_applied"] >= 3
+    assert isinstance(r["events"]["events"], list)
+    assert r["acct_pre"]["balance"] > 0  # rewards had landed

@@ -1,0 +1,136 @@
+"""Malfeasance proofs + checkpoint generate/recover."""
+
+import asyncio
+import dataclasses
+
+import pytest
+
+from spacemesh_tpu.consensus import malfeasance
+from spacemesh_tpu.core import types
+from spacemesh_tpu.core.signing import Domain, EdSigner, EdVerifier
+from spacemesh_tpu.node import checkpoint
+from spacemesh_tpu.p2p.pubsub import PubSub
+from spacemesh_tpu.storage import atxs as atxstore
+from spacemesh_tpu.storage import db as dbmod
+from spacemesh_tpu.storage import layers as layerstore
+from spacemesh_tpu.storage import misc as miscstore
+from spacemesh_tpu.storage import transactions as txstore
+from spacemesh_tpu.storage.cache import AtxCache
+
+PREFIX = b"mal-test"
+
+
+def _signed_ballot(signer, layer, salt=0):
+    b = types.Ballot(
+        layer=layer, atx_id=bytes([salt]) * 32, epoch_data=None,
+        ref_ballot=bytes(32), eligibilities=[],
+        opinion=types.Opinion(base=bytes(32), support=[], against=[],
+                              abstain=[]),
+        node_id=signer.node_id, signature=bytes(64))
+    return dataclasses.replace(
+        b, signature=signer.sign(Domain.BALLOT, b.signed_bytes()))
+
+
+@pytest.fixture
+def env():
+    db = dbmod.open_state()
+    cache = AtxCache()
+    verifier = EdVerifier(prefix=PREFIX)
+    pubsub = PubSub()
+    handler = malfeasance.Handler(db=db, cache=cache, verifier=verifier,
+                                  pubsub=pubsub)
+    return db, cache, handler
+
+
+def test_double_ballot_proof(env):
+    db, cache, handler = env
+    s = EdSigner(prefix=PREFIX)
+    b1 = _signed_ballot(s, 5, salt=1)
+    b2 = _signed_ballot(s, 5, salt=2)
+    proof = malfeasance.proof_from_ballots(b1, b2)
+    assert handler.validate(proof)
+    assert handler.process(proof)
+    assert miscstore.is_malicious(db, s.node_id)
+    assert cache.is_malicious(s.node_id)
+    # idempotent
+    assert handler.process(proof)
+
+
+def test_invalid_proofs_rejected(env):
+    db, cache, handler = env
+    s = EdSigner(prefix=PREFIX)
+    other = EdSigner(prefix=PREFIX)
+    b1 = _signed_ballot(s, 5, salt=1)
+    b2 = _signed_ballot(s, 6, salt=2)      # different layer: no conflict
+    assert not handler.validate(malfeasance.proof_from_ballots(b1, b2))
+    # same message twice
+    p = malfeasance.proof_from_ballots(b1, b1)
+    assert not handler.validate(p)
+    # forged signature
+    b3 = _signed_ballot(other, 5, salt=3)
+    forged = malfeasance.MalfeasanceProof(
+        domain=int(Domain.BALLOT), msg1=b1.signed_bytes(), sig1=b1.signature,
+        msg2=b3.signed_bytes(), sig2=b3.signature, node_id=s.node_id)
+    assert not handler.validate(forged)
+    assert not miscstore.is_malicious(db, s.node_id)
+
+
+def test_gossip_roundtrip(env):
+    db, cache, handler = env
+    s = EdSigner(prefix=PREFIX)
+    proof = malfeasance.proof_from_ballots(
+        _signed_ballot(s, 9, salt=1), _signed_ballot(s, 9, salt=2))
+
+    async def run():
+        assert await handler._gossip(b"peer", proof.to_bytes())
+        assert not await handler._gossip(b"peer", b"garbage")
+    asyncio.run(run())
+    assert miscstore.is_malicious(db, s.node_id)
+
+
+def _atx(node, epoch):
+    return types.ActivationTx(
+        publish_epoch=epoch, prev_atx=bytes(32), pos_atx=bytes(32),
+        commitment_atx=None, initial_post=None,
+        nipost=types.NIPost(
+            membership=types.MerkleProof(leaf_index=0, nodes=[]),
+            post=types.Post(nonce=0, indices=[1], pow_nonce=0),
+            post_metadata=types.PostMetadataWire(challenge=bytes(32),
+                                                 labels_per_unit=64)),
+        num_units=2, vrf_nonce=1, vrf_public_key=bytes(32),
+        coinbase=bytes(24), node_id=node, signature=bytes(64))
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    db = dbmod.open_state()
+    txstore.update_account(db, b"\x01" * 24, 5, 1000, 2, None, None)
+    txstore.update_account(db, b"\x02" * 24, 7, 500, 0, None, None)
+    a1 = _atx(b"\x0a" * 32, 1)
+    atxstore.add(db, a1, tick_height=64)
+    miscstore.set_beacon(db, 2, b"\xaa\xbb\xcc\xdd")
+    layerstore.set_applied(db, 7, bytes(32), b"\x07" * 32)
+
+    path = tmp_path / "checkpoint.json"
+    snap = checkpoint.write(db, path)
+    assert snap["layer"] == 7 and len(snap["accounts"]) == 2
+
+    # restore into a fresh DB
+    db2 = dbmod.open_state()
+    # own ATX in db2 that must survive recovery
+    own = _atx(b"\x0b" * 32, 2)
+    atxstore.add(db2, own, tick_height=10)
+    checkpoint.recover_file(db2, path, preserve_node_id=b"\x0b" * 32)
+
+    assert txstore.account(db2, b"\x01" * 24)["balance"] == 1000
+    assert atxstore.get(db2, a1.id) == a1
+    assert atxstore.tick_height(db2, a1.id) == 64
+    assert atxstore.get(db2, own.id) == own, "own ATX lineage lost"
+    assert miscstore.get_beacon(db2, 2) == b"\xaa\xbb\xcc\xdd"
+    assert layerstore.last_applied(db2) == 7
+    assert layerstore.state_hash(db2, 7) == b"\x07" * 32
+
+
+def test_checkpoint_version_check():
+    db = dbmod.open_state()
+    with pytest.raises(ValueError, match="version"):
+        checkpoint.recover(db, {"version": 99})
