@@ -70,6 +70,7 @@ class ApiServer:
         r.add_post("/v1/admin/checkpoint", self.admin_checkpoint)
         r.add_post("/v1/admin/recover", self.admin_recover)
         r.add_get("/v1/events", self.events)
+        r.add_get("/metrics", self.metrics)
 
     # --- lifecycle ---------------------------------------------------
 
@@ -82,6 +83,7 @@ class ApiServer:
         return self.actual_port
 
     async def stop(self) -> None:
+        await self.stop_event_pump()
         if self.runner is not None:
             await self.runner.cleanup()
 
@@ -151,7 +153,7 @@ class ApiServer:
         raw = req.match_info["address"]
         try:
             if raw.startswith("0x"):
-                return bytes.fromhex(raw[2:])
+                return Address(bytes.fromhex(raw[2:])).raw  # length-checked
             return Address.decode(raw).raw
         except ValueError as e:
             raise web.HTTPBadRequest(text=f"bad address: {e}")
@@ -185,7 +187,7 @@ class ApiServer:
         try:
             body = await req.json()
             raw = bytes.fromhex(body["raw"])
-        except (json.JSONDecodeError, KeyError, ValueError):
+        except (json.JSONDecodeError, KeyError, ValueError, TypeError):
             raise web.HTTPBadRequest(text='expected {"raw": "<hex>"}')
         tx = Transaction(raw=raw)
         validity = self.node.cstate.add(tx)
@@ -264,9 +266,11 @@ class ApiServer:
         try:
             body = await req.json()
             path = body["path"]
-        except (json.JSONDecodeError, KeyError):
+        except (json.JSONDecodeError, KeyError, TypeError):
             raise web.HTTPBadRequest(text='expected {"path": ...}')
-        snap = checkpoint_mod.write(self.node.state, path)
+        # off the event loop: a large snapshot must not stall consensus
+        snap = await asyncio.to_thread(checkpoint_mod.write,
+                                       self.node.state, path)
         return web.json_response({"layer": snap["layer"],
                                   "accounts": len(snap["accounts"]),
                                   "atxs": len(snap["atxs"])})
@@ -275,34 +279,83 @@ class ApiServer:
         try:
             body = await req.json()
             path = body["path"]
-        except (json.JSONDecodeError, KeyError):
+        except (json.JSONDecodeError, KeyError, TypeError):
             raise web.HTTPBadRequest(text='expected {"path": ...}')
-        snap = checkpoint_mod.recover_file(
-            self.node.state, path, preserve_node_id=self.node.signer.node_id)
+        snap = await asyncio.to_thread(
+            checkpoint_mod.recover_file, self.node.state, path,
+            self.node.signer.node_id)
         return web.json_response({"recovered_layer": snap["layer"]})
+
+    async def metrics(self, req) -> web.Response:
+        from ..utils.metrics import REGISTRY, layer_gauge, verified_gauge
+
+        layer_gauge.set(int(self.node.clock.current_layer()))
+        verified_gauge.set(self.node.tortoise.verified)
+        return web.Response(text=REGISTRY.expose(),
+                            content_type="text/plain")
 
     # --- Events ------------------------------------------------------
 
-    async def events(self, req) -> web.Response:
-        timeout = float(req.query.get("timeout", "1.0"))
-        sub = self.node.events.subscribe(
-            events_mod.LayerUpdate, events_mod.AtxEvent, events_mod.TxEvent,
-            events_mod.BeaconEvent, events_mod.PostEvent,
-            events_mod.AtxPublished, events_mod.Malfeasance)
-        out = []
-        try:
-            end = asyncio.get_event_loop().time() + timeout
+    _EVENT_TYPES = (events_mod.LayerUpdate, events_mod.AtxEvent,
+                    events_mod.TxEvent, events_mod.BeaconEvent,
+                    events_mod.PostEvent, events_mod.AtxPublished,
+                    events_mod.Malfeasance)
+    _RING = 1024
+
+    def _ensure_event_pump(self) -> None:
+        """ONE persistent subscription feeding a seq-numbered ring buffer:
+        long-poll clients resume from ?since=<seq> and never lose events
+        that fired between two polls (the reference's streaming services
+        are persistent for the same reason)."""
+        if getattr(self, "_event_pump", None) is not None:
+            return
+        self._event_ring: list = []
+        self._event_seq = 0
+        self._event_waiters: list[asyncio.Event] = []
+        sub = self.node.events.subscribe(*self._EVENT_TYPES,
+                                         size=self._RING)
+
+        async def pump():
             while True:
-                remaining = end - asyncio.get_event_loop().time()
-                if remaining <= 0:
-                    break
-                try:
-                    ev = await asyncio.wait_for(sub.next(), timeout=remaining)
-                except asyncio.TimeoutError:
-                    break
-                out.append({"type": type(ev).__name__,
-                            **{k: (v.hex() if isinstance(v, bytes) else v)
-                               for k, v in ev.__dict__.items()}})
-        finally:
-            sub.close()
-        return web.json_response({"events": out})
+                ev = await sub.next()
+                self._event_seq += 1
+                self._event_ring.append((self._event_seq, ev))
+                del self._event_ring[:-self._RING]
+                for w in self._event_waiters:
+                    w.set()
+
+        self._event_pump = asyncio.ensure_future(pump())
+
+    async def events(self, req) -> web.Response:
+        self._ensure_event_pump()
+        try:
+            timeout = min(max(float(req.query.get("timeout", "1.0")), 0.0),
+                          60.0)
+            since = int(req.query.get("since", "0"))
+        except ValueError:
+            raise web.HTTPBadRequest(text="timeout/since must be numeric")
+
+        def collect():
+            return [(seq, ev) for seq, ev in self._event_ring if seq > since]
+
+        got = collect()
+        if not got and timeout > 0:
+            waiter = asyncio.Event()
+            self._event_waiters.append(waiter)
+            try:
+                await asyncio.wait_for(waiter.wait(), timeout)
+            except asyncio.TimeoutError:
+                pass
+            finally:
+                self._event_waiters.remove(waiter)
+            got = collect()
+        out = [{"seq": seq, "type": type(ev).__name__,
+                **{k: (v.hex() if isinstance(v, bytes) else v)
+                   for k, v in ev.__dict__.items()}} for seq, ev in got]
+        return web.json_response({"events": out,
+                                  "next_since": got[-1][0] if got else since})
+
+    async def stop_event_pump(self) -> None:
+        pump = getattr(self, "_event_pump", None)
+        if pump is not None:
+            pump.cancel()

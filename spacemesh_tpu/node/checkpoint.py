@@ -37,10 +37,10 @@ def generate(db: Database, layer: int | None = None) -> dict:
             "template": row["template"].hex() if row["template"] else None,
             "state": row["state"].hex() if row["state"] else None,
         })
-    atxs = [r["data"].hex() for r in
-            db.all("SELECT data FROM atxs ORDER BY publish_epoch, id")]
-    ticks = {r["id"].hex(): r["tick_height"] for r in
-             db.all("SELECT id, tick_height FROM atxs")}
+    atx_rows = db.all(
+        "SELECT id, tick_height, data FROM atxs ORDER BY publish_epoch, id")
+    atxs = [r["data"].hex() for r in atx_rows]
+    ticks = {r["id"].hex(): r["tick_height"] for r in atx_rows}
     beacons = {str(r["epoch"]): r["beacon"].hex() for r in
                db.all("SELECT epoch, beacon FROM beacons")}
     return {

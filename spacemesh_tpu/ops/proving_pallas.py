@@ -1,0 +1,143 @@
+"""Pallas TPU kernel for the proving scan — the label-stream hot loop.
+
+Proving sweeps every stored label against a group of nonces
+(ops/proving.py:proving_scan_jit). That op is pure streaming: for each
+(label lane, nonce) pair one Salsa20/8 application and a threshold
+compare — no cross-lane dataflow. This kernel keeps a label tile resident
+in VMEM and unrolls the nonce group over it, so each label crosses
+HBM->VMEM once per group instead of once per nonce (the XLA version
+re-materializes the broadcast state per nonce).
+
+Layout (matching ops/scrypt.py): lane-minor u32 tiles. Inputs:
+  base  (12, B)  rows: challenge words 0..7 (broadcast), idx_lo, idx_hi,
+                 zeros, spare
+  lw    (4, B)   little-endian label words
+  nonce_base, threshold: SMEM scalars
+Output:
+  mask  (n_nonces, B) int8 qualification
+
+Grid: lane tiles of LANE_TILE. Set ``interpret=True`` to run/verify on CPU
+(the test path); on TPU the same call compiles via Mosaic.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+try:  # pltpu only resolves on TPU builds; interpret mode works without it
+    from jax.experimental.pallas import tpu as pltpu
+
+    _SMEM = pltpu.SMEM
+except Exception:  # pragma: no cover - non-TPU jaxlib
+    pltpu = None
+    _SMEM = None
+
+LANE_TILE = 512
+
+
+def _quarter(x, a, b, c, d):
+    def rotl(v, n):
+        return (v << jnp.uint32(n)) | (v >> jnp.uint32(32 - n))
+
+    x[b] = x[b] ^ rotl(x[a] + x[d], 7)
+    x[c] = x[c] ^ rotl(x[b] + x[a], 9)
+    x[d] = x[d] ^ rotl(x[c] + x[b], 13)
+    x[a] = x[a] ^ rotl(x[d] + x[c], 18)
+
+
+def _kernel(nonce_ref, thr_ref, base_ref, lw_ref, out_ref, *, n_nonces: int):
+    base = base_ref[...]          # (12, T) u32
+    lw = lw_ref[...]              # (4, T) u32
+    thr = thr_ref[0]
+    nonce0 = nonce_ref[0]
+    t = base.shape[1]
+    zeros = jnp.zeros((t,), jnp.uint32)
+    for k in range(n_nonces):     # static unroll over the nonce group
+        x = [base[i] for i in range(8)]          # challenge rows
+        x.append(zeros + (nonce0 + jnp.uint32(k)))
+        x.append(base[8])                         # idx_lo
+        x.append(base[9])                         # idx_hi
+        x.append(base[10])                        # zeros row
+        x.extend(lw[i] for i in range(4))
+        in0 = x[0]
+        for _ in range(4):        # Salsa20/8 = 4 double rounds
+            _quarter(x, 0, 4, 8, 12)
+            _quarter(x, 5, 9, 13, 1)
+            _quarter(x, 10, 14, 2, 6)
+            _quarter(x, 15, 3, 7, 11)
+            _quarter(x, 0, 1, 2, 3)
+            _quarter(x, 5, 6, 7, 4)
+            _quarter(x, 10, 11, 8, 9)
+            _quarter(x, 15, 12, 13, 14)
+        word0 = x[0] + in0
+        out_ref[k, :] = (word0 < thr).astype(jnp.int8)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("n_nonces", "interpret", "lane_tile"))
+def proving_scan_pallas(challenge_words, nonce_base, idx_lo, idx_hi,
+                        label_words, threshold, *, n_nonces: int,
+                        interpret: bool = False, lane_tile: int = LANE_TILE):
+    """Drop-in for ops.proving.proving_scan_jit (returns int8 mask).
+
+    Batch size must be a multiple of ``lane_tile``.
+    """
+    b = idx_lo.shape[0]
+    if b % lane_tile:
+        raise ValueError(f"batch {b} not a multiple of lane tile {lane_tile}")
+    ch = jnp.broadcast_to(challenge_words.astype(jnp.uint32)[:, None], (8, b))
+    base = jnp.concatenate([
+        ch, idx_lo[None].astype(jnp.uint32), idx_hi[None].astype(jnp.uint32),
+        jnp.zeros((2, b), jnp.uint32),
+    ])
+    grid = (b // lane_tile,)
+    kernel = functools.partial(_kernel, n_nonces=n_nonces)
+    scalar_spec = (pl.BlockSpec(memory_space=_SMEM) if _SMEM is not None
+                   else pl.BlockSpec(memory_space=pl.ANY))
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((n_nonces, b), jnp.int8),
+        grid=grid,
+        in_specs=[
+            scalar_spec,
+            scalar_spec,
+            pl.BlockSpec((12, lane_tile), lambda i: (0, i)),
+            pl.BlockSpec((4, lane_tile), lambda i: (0, i)),
+        ],
+        out_specs=pl.BlockSpec((n_nonces, lane_tile), lambda i: (0, i)),
+        interpret=interpret,
+    )(jnp.asarray([nonce_base], jnp.uint32),
+      jnp.asarray([threshold], jnp.uint32), base,
+      label_words.astype(jnp.uint32))
+    return out
+
+
+def proving_scan(challenge: bytes, nonce_base: int, indices, labels: np.ndarray,
+                 threshold: int, n_nonces: int,
+                 interpret: bool | None = None) -> np.ndarray:
+    """Host wrapper mirroring ops.proving host entries. Pads the batch to
+    the lane tile. Returns (n_nonces, B) bool."""
+    from .proving import challenge_words
+    from .scrypt import labels_to_words, split_indices
+
+    if interpret is None:
+        interpret = jax.devices()[0].platform == "cpu"
+    idx = np.atleast_1d(np.asarray(indices, dtype=np.uint64)).ravel()
+    b = idx.shape[0]
+    pad = (-b) % LANE_TILE
+    if pad:
+        idx = np.concatenate([idx, np.zeros(pad, np.uint64)])
+        labels = np.concatenate(
+            [labels, np.zeros((pad, labels.shape[1]), labels.dtype)])
+    lo, hi = split_indices(idx)
+    mask = proving_scan_pallas(
+        jnp.asarray(challenge_words(challenge)), jnp.uint32(nonce_base),
+        jnp.asarray(lo), jnp.asarray(hi),
+        jnp.asarray(labels_to_words(labels)), jnp.uint32(threshold),
+        n_nonces=n_nonces, interpret=interpret)
+    return np.asarray(mask)[:, :b].astype(bool)

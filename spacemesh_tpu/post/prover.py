@@ -54,7 +54,8 @@ class ProofParams:
 
 class Prover:
     def __init__(self, data_dir: str | Path, params: ProofParams | None = None,
-                 batch_labels: int = 1 << 14, nonce_group: int = 16):
+                 batch_labels: int = 1 << 14, nonce_group: int = 16,
+                 use_pallas: bool | None = None):
         self.meta = PostMetadata.load(data_dir)
         if self.meta.labels_written < self.meta.total_labels:
             raise ValueError("POST data is not fully initialized")
@@ -62,6 +63,11 @@ class Prover:
         self.params = params or ProofParams()
         self.batch_labels = batch_labels
         self.nonce_group = nonce_group
+        if use_pallas is None:  # the Mosaic kernel path is TPU-only
+            import jax
+
+            use_pallas = jax.devices()[0].platform == "tpu"
+        self.use_pallas = use_pallas
 
     def prove(self, challenge: bytes) -> Proof:
         meta, p = self.meta, self.params
@@ -84,10 +90,20 @@ class Prover:
                 ).reshape(count, scrypt.LABEL_BYTES)
                 lo, hi = scrypt.split_indices(idx)
                 lw = scrypt.labels_to_words(labels)
-                mask = np.asarray(proving.proving_scan_jit(
-                    cw, jnp.uint32(group * self.nonce_group),
-                    jnp.asarray(lo), jnp.asarray(hi), jnp.asarray(lw),
-                    jnp.uint32(t), n_nonces=self.nonce_group))
+                nonce0 = group * self.nonce_group
+                from ..ops import proving_pallas
+
+                if self.use_pallas and count % proving_pallas.LANE_TILE == 0:
+
+                    mask = np.asarray(proving_pallas.proving_scan_pallas(
+                        cw, jnp.uint32(nonce0), jnp.asarray(lo),
+                        jnp.asarray(hi), jnp.asarray(lw), jnp.uint32(t),
+                        n_nonces=self.nonce_group)).astype(bool)
+                else:
+                    mask = np.asarray(proving.proving_scan_jit(
+                        cw, jnp.uint32(nonce0),
+                        jnp.asarray(lo), jnp.asarray(hi), jnp.asarray(lw),
+                        jnp.uint32(t), n_nonces=self.nonce_group))
                 for k in range(self.nonce_group):
                     if len(hits[k]) < p.k2:
                         found = np.nonzero(mask[k])[0]

@@ -1,0 +1,1 @@
+"""Shared utilities: metrics registry, logging setup."""

@@ -1,0 +1,31 @@
+"""Named hierarchical loggers with per-module levels.
+
+Mirrors the reference log package (reference log/: zap wrapper with named
+loggers and per-module level overrides, node/node.go:557 addLogger).
+Thin stdlib wrapper: ``get(name)`` returns a child of the "smtpu" root;
+``configure(levels={"hare": "DEBUG"})`` sets per-module levels.
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+
+ROOT = "smtpu"
+
+
+def get(name: str) -> logging.Logger:
+    return logging.getLogger(f"{ROOT}.{name}")
+
+
+def configure(level: str = "INFO", levels: dict[str, str] | None = None,
+              stream=None) -> None:
+    root = logging.getLogger(ROOT)
+    root.setLevel(level.upper())
+    if not root.handlers:
+        h = logging.StreamHandler(stream or sys.stderr)
+        h.setFormatter(logging.Formatter(
+            "%(asctime)s %(levelname).1s %(name)s: %(message)s"))
+        root.addHandler(h)
+    for module, lvl in (levels or {}).items():
+        get(module).setLevel(lvl.upper())

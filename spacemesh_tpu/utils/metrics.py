@@ -1,0 +1,137 @@
+"""Metrics: counters/gauges/histograms with Prometheus text exposition.
+
+Mirrors the reference's metrics layer (reference metrics/: per-package
+prometheus counters + a scrape server; curated public metrics
+metrics/public/public.go). Subsystems register instruments on the global
+registry; the API serves /metrics in exposition format.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import defaultdict
+
+
+class _Instrument:
+    def __init__(self, name: str, help_: str):
+        self.name = name
+        self.help = help_
+        self._lock = threading.Lock()
+
+
+class Counter(_Instrument):
+    def __init__(self, name, help_=""):
+        super().__init__(name, help_)
+        self._values: dict[tuple, float] = defaultdict(float)
+
+    def inc(self, value: float = 1.0, **labels) -> None:
+        with self._lock:
+            self._values[tuple(sorted(labels.items()))] += value
+
+    def expose(self) -> list[str]:
+        out = [f"# HELP {self.name} {self.help}",
+               f"# TYPE {self.name} counter"]
+        with self._lock:
+            for labels, v in self._values.items():
+                lbl = ",".join(f'{k}="{val}"' for k, val in labels)
+                out.append(f"{self.name}{{{lbl}}} {v}" if lbl
+                           else f"{self.name} {v}")
+        return out
+
+
+class Gauge(_Instrument):
+    def __init__(self, name, help_=""):
+        super().__init__(name, help_)
+        self._values: dict[tuple, float] = {}
+
+    def set(self, value: float, **labels) -> None:
+        with self._lock:
+            self._values[tuple(sorted(labels.items()))] = value
+
+    def expose(self) -> list[str]:
+        out = [f"# HELP {self.name} {self.help}",
+               f"# TYPE {self.name} gauge"]
+        with self._lock:
+            for labels, v in self._values.items():
+                lbl = ",".join(f'{k}="{val}"' for k, val in labels)
+                out.append(f"{self.name}{{{lbl}}} {v}" if lbl
+                           else f"{self.name} {v}")
+        return out
+
+
+class Histogram(_Instrument):
+    DEFAULT_BUCKETS = (0.005, 0.05, 0.5, 5.0, 50.0, float("inf"))
+
+    def __init__(self, name, help_="", buckets=DEFAULT_BUCKETS):
+        super().__init__(name, help_)
+        self.buckets = tuple(buckets)
+        self._counts = [0] * len(self.buckets)
+        self._sum = 0.0
+        self._n = 0
+
+    def observe(self, value: float) -> None:
+        with self._lock:
+            self._sum += value
+            self._n += 1
+            for i, b in enumerate(self.buckets):
+                if value <= b:
+                    self._counts[i] += 1
+
+    def expose(self) -> list[str]:
+        out = [f"# HELP {self.name} {self.help}",
+               f"# TYPE {self.name} histogram"]
+        with self._lock:
+            for b, c in zip(self.buckets, self._counts):
+                le = "+Inf" if b == float("inf") else b
+                out.append(f'{self.name}_bucket{{le="{le}"}} {c}')
+            out.append(f"{self.name}_sum {self._sum}")
+            out.append(f"{self.name}_count {self._n}")
+        return out
+
+
+class Registry:
+    def __init__(self) -> None:
+        self._instruments: dict[str, _Instrument] = {}
+        self._lock = threading.Lock()
+
+    def counter(self, name: str, help_: str = "") -> Counter:
+        return self._get(name, lambda: Counter(name, help_), Counter)
+
+    def gauge(self, name: str, help_: str = "") -> Gauge:
+        return self._get(name, lambda: Gauge(name, help_), Gauge)
+
+    def histogram(self, name: str, help_: str = "", buckets=None) -> Histogram:
+        return self._get(
+            name, lambda: Histogram(name, help_,
+                                    buckets or Histogram.DEFAULT_BUCKETS),
+            Histogram)
+
+    def _get(self, name, factory, cls):
+        with self._lock:
+            inst = self._instruments.get(name)
+            if inst is None:
+                inst = self._instruments[name] = factory()
+            elif not isinstance(inst, cls):
+                raise TypeError(f"{name} already registered as "
+                                f"{type(inst).__name__}")
+            return inst
+
+    def expose(self) -> str:
+        with self._lock:
+            instruments = list(self._instruments.values())
+        lines: list[str] = []
+        for inst in instruments:
+            lines.extend(inst.expose())
+        return "\n".join(lines) + "\n"
+
+
+REGISTRY = Registry()
+
+# curated "public" metrics (reference metrics/public/public.go)
+layer_gauge = REGISTRY.gauge("node_current_layer", "wall-clock layer")
+verified_gauge = REGISTRY.gauge("tortoise_verified_layer", "verified frontier")
+post_init_seconds = REGISTRY.histogram("post_init_seconds",
+                                       "POST init session duration")
+proofs_generated = REGISTRY.counter("post_proofs_generated", "proofs made")
+proofs_verified = REGISTRY.counter("post_proofs_verified",
+                                   "proofs verified (label=result)")

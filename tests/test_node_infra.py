@@ -95,6 +95,36 @@ def test_event_bus():
     asyncio.run(run())
 
 
+def test_metrics_registry():
+    from spacemesh_tpu.utils import metrics as m
+
+    reg = m.Registry()
+    c = reg.counter("reqs", "requests")
+    c.inc(); c.inc(2, route="/v1/x")
+    reg.gauge("depth").set(7)
+    h = reg.histogram("lat")
+    h.observe(0.001); h.observe(42)
+    text = reg.expose()
+    assert "reqs 1.0" in text and 'route="/v1/x"' in text
+    assert "depth 7" in text
+    assert "lat_count 2" in text
+    assert reg.counter("reqs") is c  # idempotent registration
+    import pytest as _pytest
+    with _pytest.raises(TypeError):
+        reg.gauge("reqs")
+
+
+def test_logging_levels(capsys):
+    import logging
+
+    from spacemesh_tpu.utils import logging as slog
+
+    slog.configure(level="INFO", levels={"hare": "DEBUG"})
+    assert slog.get("hare").isEnabledFor(logging.DEBUG)
+    assert not slog.get("mesh").isEnabledFor(logging.DEBUG)
+    assert slog.get("mesh").isEnabledFor(logging.INFO)
+
+
 def test_event_bus_overflow():
     bus = events_mod.EventBus()
     sub = bus.subscribe(events_mod.LayerUpdate, size=2)
