@@ -153,3 +153,6 @@ class Mesh:
                 block = blockstore.get(self.db, bid)
                 if block is not None:
                     self.executor.execute(block)
+            # revert dropped the layer rows; the re-executed layers are
+            # processed again (keeps the processed frontier monotone)
+            layerstore.set_processed(self.db, lyr)

@@ -71,6 +71,12 @@ class ProposalBuilder:
         atx_id = self.own_atx(epoch)
         if atx_id is None:
             return None
+        # never double-mine a layer: a second (different) ballot in the
+        # same layer is self-equivocation (reference proposal builder
+        # skips layers it already built for; guards restarts and clock
+        # anomalies like --genesis-now replays)
+        if ballotstore.by_node_in_layer(self.db, self.signer.node_id, layer):
+            return None
         beacon = await self.beacon_getter(epoch)
         vrf = self.signer.vrf_signer()
         slots = self.oracle.eligible_slots_for_layer(

@@ -62,6 +62,7 @@ class App:
         self._wire()
         self._tasks: list[asyncio.Task] = []
         self.stopped = asyncio.Event()
+        self._recover_state()
 
     def _load_or_create_identity(self, prefix: bytes) -> EdSigner:
         """Persisted node identity (reference node/node_identities.go:
@@ -162,6 +163,66 @@ class App:
         self.server = None
         self.fetch = None
         self.syncer = None
+
+    def _recover_state(self) -> None:
+        """Warm the in-RAM caches from storage after a restart (reference
+        atxsdata warmup node.go:1963 setupDBs + tortoise.Recover
+        tortoise/recover.go:20): the ATX cache, tortoise blocks/validity,
+        certified hare outputs, and stored ballots re-fed in layer order."""
+        from ..core.types import ActivationTx
+        from ..storage import atxs as atxstore
+        from ..storage import ballots as ballotstore
+        from ..storage import blocks as blockstore
+        from ..storage import layers as layerstore
+        from ..storage import misc as miscstore
+        from ..storage.cache import AtxInfo
+
+        ticks_by_id: dict[bytes, int] = {}
+        for row in atxstore.all_rows(self.state):
+            atx = ActivationTx.from_bytes(row["data"])
+            prev_height = ticks_by_id.get(atx.prev_atx, 0)
+            height = row["tick_height"]
+            ticks_by_id[row["id"]] = height
+            self.cache.add(atx.target_epoch(), row["id"], AtxInfo(
+                node_id=atx.node_id,
+                weight=atx.num_units * max(height - prev_height, 0),
+                base_height=prev_height, height=height,
+                num_units=atx.num_units, vrf_nonce=atx.vrf_nonce,
+                vrf_public_key=atx.vrf_public_key))
+        for node_id in miscstore.all_malicious(self.state):
+            self.cache.set_malicious(node_id)
+
+        processed = layerstore.processed(self.state)
+        if processed < 0:
+            return
+        low = max(1, processed - self.cfg.tortoise.window_size)
+        for layer in range(low, processed + 1):
+            for bid in blockstore.ids_in_layer(self.state, layer):
+                self.tortoise.on_block(layer, bid)
+                validity = blockstore.validity(self.state, bid)
+                if validity == blockstore.VALID:
+                    self.tortoise._validity[bid] = True
+                elif validity == blockstore.INVALID:
+                    self.tortoise._validity[bid] = False
+            cert = miscstore.certified_block(self.state, layer)
+            applied = layerstore.applied_block(self.state, layer)
+            if cert is not None:
+                self.tortoise.on_hare_output(layer, cert)
+            elif applied is not None:
+                self.tortoise.on_hare_output(layer, applied)
+        for layer in range(low, processed + 1):
+            for ballot in ballotstore.in_layer(self.state, layer):
+                epoch = layer // self.cfg.layers_per_epoch
+                info = self.cache.get(epoch, ballot.atx_id)
+                if info is None:
+                    continue
+                num = self.oracle.num_slots(epoch, ballot.atx_id)
+                unit = info.weight // max(num, 1)
+                self.tortoise.on_ballot(ballot,
+                                        unit * len(ballot.eligibilities))
+        self.tortoise.processed = processed
+        self.tortoise.verified = max(
+            min(layerstore.last_applied(self.state), processed) - 1, 0)
 
     # --- networking (request/response + fetch + sync) -------------------
 
@@ -375,6 +436,13 @@ class App:
     async def publish_atx(self, publish_epoch: int) -> None:
         if self.atx_builder is None:
             return
+        from ..storage import atxs as atxstore
+
+        # restart safety: publishing a SECOND (different) ATX for an epoch
+        # already covered would be self-equivocation -> malfeasance
+        if atxstore.by_node_in_epoch(self.state, self.signer.node_id,
+                                     publish_epoch) is not None:
+            return
         atx = await self.atx_builder.build_and_publish(
             publish_epoch, execute_round=self.cfg.standalone)
         self.events.emit(events_mod.AtxPublished(
@@ -402,8 +470,15 @@ class App:
         cfg = self.cfg
         if cfg.smeshing.start and self.atx_builder is None:
             await self.prepare()
+        from ..storage import layers as layerstore
+
         seen_epochs = {0}
         async for layer in self.clock.ticks():
+            if layer <= layerstore.processed(self.state):
+                # already processed (restart replay / clock anomalies):
+                # re-running hare would overwrite the recorded opinion with
+                # an empty one and trigger a bogus revert
+                continue
             epoch = cfg.epoch_of(layer)
             if epoch not in seen_epochs:
                 seen_epochs.add(epoch)

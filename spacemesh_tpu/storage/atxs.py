@@ -55,6 +55,12 @@ def all_in_epoch(db: Database, epoch: int) -> list[ActivationTx]:
             db.all("SELECT data FROM atxs WHERE publish_epoch=?", (epoch,))]
 
 
+def all_rows(db: Database):
+    """(id, tick_height, prev tick lookup support) for cache warmup."""
+    return db.all("SELECT id, node_id, publish_epoch, num_units, tick_height,"
+                  " data FROM atxs ORDER BY publish_epoch")
+
+
 def count_in_epoch(db: Database, epoch: int) -> int:
     return db.one("SELECT COUNT(*) c FROM atxs WHERE publish_epoch=?",
                   (epoch,))["c"]

@@ -1,0 +1,88 @@
+"""Restart recovery: caches and tortoise state rebuilt from storage."""
+
+import asyncio
+import time
+
+import pytest
+
+from spacemesh_tpu.node import clock as clock_mod
+from spacemesh_tpu.node.app import App
+from spacemesh_tpu.node.config import load
+from spacemesh_tpu.storage import atxs as atxstore
+from spacemesh_tpu.storage import layers as layerstore
+
+LPE = 3
+LAYER_SEC = 0.7
+
+
+@pytest.fixture(scope="module")
+def restarted(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("recovery")
+    overrides = {
+        "data_dir": str(tmp / "node"),
+        "layer_duration": LAYER_SEC,
+        "layers_per_epoch": LPE,
+        "slots_per_layer": 2,
+        "genesis": {"time": time.time() + 3600},
+        "post": {"labels_per_unit": 256, "scrypt_n": 2, "k1": 64, "k2": 8,
+                 "k3": 4, "min_num_units": 1,
+                 "pow_difficulty": "20" + "ff" * 31},
+        "smeshing": {"start": True, "num_units": 1, "init_batch": 128},
+        "hare": {"committee_size": 20, "round_duration": 0.06,
+                 "preround_delay": 0.2, "iteration_limit": 2},
+        "beacon": {"proposal_duration": 0.05},
+        "tortoise": {"hdist": 4, "window_size": 50},
+    }
+    app = App(load("standalone", overrides=overrides))
+
+    async def first_life():
+        await app.prepare()
+        app.clock = clock_mod.LayerClock(time.time() + 0.3, LAYER_SEC)
+        await app.run(until_layer=2 * LPE)
+
+    asyncio.run(asyncio.wait_for(first_life(), timeout=120))
+    app.close()
+
+    # restart: a fresh App over the same data dir
+    app2 = App(load("standalone", overrides=overrides))
+    return app, app2
+
+
+def test_atx_cache_recovered(restarted):
+    app, app2 = restarted
+    for epoch in (1, 2):
+        ids = atxstore.ids_in_epoch(app2.state, epoch - 1)
+        assert ids, f"no ATXs published in epoch {epoch - 1}"
+        for atx_id in ids:
+            info = app2.cache.get(epoch, atx_id)
+            assert info is not None, "cache not warmed"
+            assert info.weight > 0
+            orig = app.cache.get(epoch, atx_id)
+            assert orig is not None and info.weight == orig.weight
+
+
+def test_tortoise_state_recovered(restarted):
+    app, app2 = restarted
+    assert app2.tortoise.processed == layerstore.processed(app2.state)
+    assert app2.tortoise.verified >= 0
+    # hare outputs (certified/applied blocks) were re-fed
+    applied_layers = [lyr for lyr in range(1, 2 * LPE + 1)
+                      if layerstore.applied_block(app2.state, lyr)]
+    for lyr in applied_layers:
+        assert lyr in app2.tortoise._hare
+    # ballots carry weight again
+    assert any(app2.tortoise._ballots_by_layer.get(lyr)
+               for lyr in range(LPE, 2 * LPE + 1)), "no ballots recovered"
+
+
+def test_recovered_node_keeps_running(restarted):
+    app, app2 = restarted
+
+    async def second_life():
+        # same network genesis; continue for two more layers
+        app2.clock = clock_mod.LayerClock(
+            time.time() - (2 * LPE) * LAYER_SEC + 0.3, LAYER_SEC)
+        await app2.run(until_layer=2 * LPE + 2)
+
+    asyncio.run(asyncio.wait_for(second_life(), timeout=60))
+    assert layerstore.processed(app2.state) >= 2 * LPE + 1
