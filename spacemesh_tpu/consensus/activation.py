@@ -108,6 +108,14 @@ class Handler:
         if not self.verifier.verify(Domain.ATX, atx.node_id,
                                     atx.signed_bytes(), atx.signature):
             return False
+        # VRF key must BE the identity: ed25519 and the ECVRF suite share
+        # the same seed->pubkey derivation, so an honest smesher's VRF key
+        # equals its node id (signing.EdSigner.vrf_signer). Accepting an
+        # arbitrary signed key would let a smesher grind fresh VRF keys
+        # per epoch to bias beacon/eligibility draws (reference keys VRF
+        # verification by the node id itself, signing/vrf.go NewPublicKey).
+        if atx.vrf_public_key != atx.node_id:
+            return False
         # poet proof must be known and the challenge a member of its round
         poet = miscstore.poet_proof(self.db, atx.nipost.post_metadata.challenge)
         if poet is None:

@@ -120,15 +120,25 @@ class ProtocolDriver:
         props = sorted(self._proposals.get(epoch, {}).values())[:K_BEST]
         if props:
             beacon = sum256(*props)[:BEACON_SIZE]
+            source = miscstore.BEACON_PROTOCOL
         else:
+            # saw no proposals: this is a local bootstrap, not a protocol
+            # decision — leave it supersedable by a later synced majority
             beacon = self._bootstrap(epoch)
-        miscstore.set_beacon(self.db, epoch, beacon)
+            source = miscstore.BEACON_FALLBACK
+        miscstore.set_beacon(self.db, epoch, beacon, source=source)
         ev = self._ready.setdefault(epoch, asyncio.Event())
         ev.set()
         return beacon
 
     def on_fallback(self, epoch: int, beacon: bytes) -> None:
-        """Bootstrap-provided beacon (reference beacon.go:239 UpdateBeacon)."""
-        if miscstore.get_beacon(self.db, epoch) is None:
-            miscstore.set_beacon(self.db, epoch, beacon)
-            self._ready.setdefault(epoch, asyncio.Event()).set()
+        """Bootstrap/sync-provided beacon (reference beacon.go:239
+        UpdateBeacon). A fallback value may supersede an earlier fallback
+        (a later peer majority corrects a poisoned/raced first write) but
+        never a protocol-decided beacon."""
+        source = miscstore.beacon_source(self.db, epoch)
+        if source == miscstore.BEACON_PROTOCOL:
+            return
+        miscstore.set_beacon(self.db, epoch, beacon,
+                             source=miscstore.BEACON_FALLBACK)
+        self._ready.setdefault(epoch, asyncio.Event()).set()

@@ -80,7 +80,8 @@ class HareSession:
         self.layer = layer
         self.my_proposals = sorted(proposals)
         self.preround_sets: dict[bytes, tuple[int, list[bytes]]] = {}
-        self.proposed: Optional[list[bytes]] = None
+        # iteration -> (vrf_output, values) of best PROPOSE; lowest VRF wins
+        self._best_propose: dict[int, tuple[bytes, list[bytes]]] = {}
         self.commits: dict[bytes, tuple[int, tuple]] = {}
         self.notifies: dict[bytes, tuple[int, tuple]] = {}
         self.output: Optional[list[bytes]] = None
@@ -100,11 +101,15 @@ class HareSession:
         if msg.round == PREROUND:
             self.preround_sets[msg.node_id] = (w, msg.values)
         elif msg.round == PROPOSE:
-            # first valid proposal wins (leader ties broken by arrival,
-            # matching gossip order; a VRF-lowest rule lands with hare4
-            # compaction in M4)
-            if self.proposed is None:
-                self.proposed = sorted(msg.values)
+            # leader = lowest VRF output among eligible proposers
+            # (reference hare3 leader rule; ADVICE r1 — first-arrival was
+            # adversary-steerable via gossip ordering)
+            from ..core.signing import vrf_output
+
+            out = vrf_output(msg.eligibility_proof)
+            best = self._best_propose.get(msg.iteration)
+            if best is None or out < best[0]:
+                self._best_propose[msg.iteration] = (out, sorted(msg.values))
         elif msg.round == COMMIT:
             self.commits[msg.node_id] = (w, tuple(msg.values))
         elif msg.round == NOTIFY:
@@ -262,10 +267,11 @@ class Hare:
         await until_slot(0)
 
         for it in range(self.iteration_limit):
-            # PROPOSE (leader: anyone eligible; first arrival wins)
+            # PROPOSE (leader: lowest VRF output among eligible proposers)
             await maybe_send(it, PROPOSE, session.candidates())
             await until_slot(1 + 3 * it)
-            proposal = session.proposed or session.candidates()
+            best = session._best_propose.get(it)
+            proposal = best[1] if best else session.candidates()
             # COMMIT
             await maybe_send(it, COMMIT, proposal)
             await until_slot(2 + 3 * it)

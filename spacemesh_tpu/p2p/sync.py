@@ -77,8 +77,12 @@ class Syncer:
             if recent and (data is None or data.certified == bytes(32)):
                 break
             if data is not None:
-                await self.fetch.get_hashes(HINT_BALLOT, data.ballots)
+                # blocks BEFORE ballots: tortoise.on_ballot must be able to
+                # resolve every support vote against a known block, else the
+                # votes count as AGAINST and a fresh node invalidates layers
+                # the network holds valid
                 await self.fetch.get_hashes(HINT_BLOCK, data.blocks)
+                await self.fetch.get_hashes(HINT_BALLOT, data.ballots)
             await self.process_layer(layer, data)
         behind = self.current_layer() - self.processed_layer()
         if behind <= 1:
@@ -98,15 +102,30 @@ class Syncer:
 
         if self.store_beacon is None:
             return
-        for peer in self.fetch.server.peers():
+        # quorum: adopt only a value reported by a strict majority of the
+        # peers that answered — one lying peer must not poison the beacon
+        # (ADVICE r1; reference accepts fallback beacons only from a
+        # verified bootstrap source)
+        async def ask(peer):
             try:
-                resp = await self.fetch.server.request(
+                return await self.fetch.server.request(
                     peer, "bk/1", struct.pack("<I", epoch))
             except (RequestError, asyncio.TimeoutError):
-                continue
-            if len(resp) == 4:
-                self.store_beacon(epoch, resp)
-                return
+                return None
+
+        responses = await asyncio.gather(
+            *(ask(p) for p in self.fetch.server.peers()))
+        votes: dict[bytes, int] = {}
+        answered = 0
+        for resp in responses:
+            if resp is not None and len(resp) == 4:
+                answered += 1
+                votes[resp] = votes.get(resp, 0) + 1
+        if not votes:
+            return
+        best, count = max(votes.items(), key=lambda kv: kv[1])
+        if count * 2 > answered:
+            self.store_beacon(epoch, best)
 
     async def _peer_poet_refs(self, epoch: int) -> list[bytes]:
         """Poet proof refs peers hold for the epoch's round."""

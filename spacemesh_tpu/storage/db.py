@@ -200,6 +200,15 @@ STATE_MIGRATIONS = [
         data BLOB NOT NULL
     );
     """,
+    # 0002: beacon provenance — protocol-decided beacons are final, fallback/
+    # synced ones may be superseded by a later majority (ADVICE r1: a single
+    # peer must not poison a late joiner's beacon permanently). Existing rows
+    # default to FALLBACK(1): pre-migration rows may have been adopted from a
+    # single peer; protocol-decided values are network-identical, so leaving
+    # them supersedable is harmless.
+    """
+    ALTER TABLE beacons ADD COLUMN source INT NOT NULL DEFAULT 1;
+    """,
 ]
 
 # --- local database (node-private progress) -------------------------------

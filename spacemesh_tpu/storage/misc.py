@@ -12,14 +12,25 @@ from .db import Database
 # --- beacons ---------------------------------------------------------------
 
 
-def set_beacon(db: Database, epoch: int, beacon: bytes) -> None:
-    db.exec("INSERT OR REPLACE INTO beacons (epoch, beacon) VALUES (?,?)",
-            (epoch, beacon))
+BEACON_PROTOCOL = 0  # decided by running the beacon protocol (final)
+BEACON_FALLBACK = 1  # adopted from sync/bootstrap (supersedable)
+
+
+def set_beacon(db: Database, epoch: int, beacon: bytes,
+               source: int = BEACON_PROTOCOL) -> None:
+    db.exec(
+        "INSERT OR REPLACE INTO beacons (epoch, beacon, source) VALUES (?,?,?)",
+        (epoch, beacon, source))
 
 
 def get_beacon(db: Database, epoch: int) -> bytes | None:
     row = db.one("SELECT beacon FROM beacons WHERE epoch=?", (epoch,))
     return row["beacon"] if row else None
+
+
+def beacon_source(db: Database, epoch: int) -> int | None:
+    row = db.one("SELECT source FROM beacons WHERE epoch=?", (epoch,))
+    return row["source"] if row else None
 
 
 # --- certificates ----------------------------------------------------------

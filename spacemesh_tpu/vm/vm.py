@@ -300,9 +300,14 @@ class VM:
             txstore.update_account(
                 self.db, addr, layer, acct.balance, acct.next_nonce,
                 acct.template, acct.state)
+            # template + state must be committed too: two states differing
+            # only in spawned template or template args (e.g. vault owner)
+            # must not share a root (ADVICE r1)
             root = sum256(root, addr,
                           acct.balance.to_bytes(8, "little"),
-                          acct.next_nonce.to_bytes(8, "little"))
+                          acct.next_nonce.to_bytes(8, "little"),
+                          acct.template or b"",
+                          acct.state or b"")
         return root
 
     def revert(self, to_layer: int) -> None:
