@@ -1,22 +1,33 @@
-"""Tortoise: self-healing vote-counting finality.
+"""Tortoise: self-healing vote-counting finality, as array ops.
 
 Mirrors the reference tortoise's contract (reference tortoise/algorithm.go
 public facade: OnAtx/OnBallot/OnBlock/OnBeacon/OnHareOutput/TallyVotes/
-EncodeVotes/Updates/Results; verifying mode counts ballot opinions toward a
-weight threshold, tortoise/verifying.go; opinions are encoded relative to a
-base ballot with exception lists, tortoise/opinion; a JSON tracer records
-every input for offline replay, tortoise/tracer.go).
+EncodeVotes/Updates/Results; verifying mode tortoise/verifying.go; full
+mode healing recount tortoise/full.go; mode switching on threshold
+crossing tortoise/tortoise.go:397; recovery from storage
+tortoise/recover.go:20; JSON tracer for offline replay tortoise/tracer.go).
 
-This implementation materializes each ballot's full opinion (base chain
-resolved at ingestion), keeps a sliding window of layers, and advances the
-verified frontier when every block decision in a layer clears the margin
-threshold — a faithful verifying tortoise. Full-mode recount (healing after
-partitions) re-tallies from the materialized opinions, since they are kept
-for the whole window.
+The vote state is a dense int8 matrix V[ballots, blocks] over the active
+window — +1 support, -1 against (the default for any block the ballot's
+chain covers), 0 abstain/not-covered — plus a weight vector. A layer's
+margins are then one masked mat-vec:
 
-Local opinion: within hdist of the tip, hare outputs are trusted
-(reference tortoise counts them as the node's own opinion); beyond, only
-accumulated ballot weight decides.
+    margins = (weights * (ballot_layer > L)) @ V[:, cols(L)]
+
+which is the "turn vote counting into array ops" design SURVEY.md §7
+prescribes (the reference walks ballot graphs in Go; this formulation
+lets numpy/XLA tile the count — BenchmarkTallyVotes territory).
+
+Decision rule per block (reference semantics):
+  margin >  threshold            -> valid      (verifying mode)
+  margin < -threshold            -> invalid
+  within hdist and hare decided  -> hare's opinion   (hare trust)
+  older than hdist+zdist         -> sign of margin   (full/healing mode)
+  otherwise                      -> undecided (frontier stops)
+
+Support votes for blocks not yet known are kept PENDING and resolved when
+the block arrives (round-1 advisor fix: they must not silently count as
+against while sync delivers data out of order).
 """
 
 from __future__ import annotations
@@ -25,12 +36,14 @@ import dataclasses
 import json
 from typing import Callable, Optional
 
+import numpy as np
+
 from ..core.types import Ballot, Opinion
 from ..storage.cache import AtxCache
 
 EMPTY = bytes(32)  # "layer is empty" sentinel in hare outputs
 
-SUPPORT, AGAINST, ABSTAIN = 1, -1, 0
+VERIFYING, FULL = "verifying", "full"
 
 
 @dataclasses.dataclass
@@ -38,6 +51,7 @@ class BallotInfo:
     id: bytes
     layer: int
     weight: int
+    node_id: bytes
     # layer -> set of supported block ids (full, base-resolved)
     supports: dict[int, set[bytes]]
     abstains: set[int]
@@ -53,22 +67,41 @@ class Update:
 
 class Tortoise:
     def __init__(self, cache: AtxCache, layers_per_epoch: int, hdist: int = 10,
-                 window: int = 1000,
+                 window: int = 1000, zdist: int = 8,
                  tracer: Optional[Callable[[str], None]] = None):
         self.cache = cache
         self.layers_per_epoch = layers_per_epoch
         self.hdist = hdist
+        self.zdist = zdist
         self.window = window
         self._trace = tracer
         self.verified = 0           # highest fully-decided layer
         self.processed = 0
+        self.mode = VERIFYING
+        # --- array state (the vote matrix) ---
+        self._V = np.zeros((256, 256), np.int8)
+        self._weights = np.zeros(256, np.int64)
+        self._row_layer = np.zeros(256, np.int32)
+        self._col_layer = np.zeros(256, np.int32)
+        self._rows = 0
+        self._cols = 0
+        self._abstain: dict[int, np.ndarray] = {}      # layer -> bool[rowcap]
+        self._col_of: dict[bytes, int] = {}            # block id -> col
+        self._col_block: list[bytes] = []              # col -> block id
+        self._layer_cols: dict[int, list[int]] = {}    # layer -> cols
+        self._row_ballot: list[bytes] = []             # row -> ballot id
+        self._ballot_row: dict[bytes, int] = {}
+        self._node_rows: dict[bytes, list[int]] = {}
+        self._pending: dict[bytes, set[bytes]] = {}    # block id -> ballots
+        # --- object state ---
         self._ballots: dict[bytes, BallotInfo] = {}
         self._ballots_by_layer: dict[int, list[bytes]] = {}
         self._blocks: dict[int, set[bytes]] = {}
         self._hare: dict[int, bytes] = {}
         self._validity: dict[bytes, bool] = {}
         self._updates: list[Update] = []
-        self._epoch_weight: dict[int, int] = {}
+        self._t("init", lpe=layers_per_epoch, hdist=hdist, zdist=zdist,
+                window=window)
 
     # --- tracing -------------------------------------------------------
 
@@ -78,11 +111,66 @@ class Tortoise:
                    for k, v in kw.items()}
             self._trace(json.dumps({"ev": kind, **enc}, sort_keys=True))
 
+    # --- array plumbing ------------------------------------------------
+
+    def _grow_rows(self) -> None:
+        cap = self._V.shape[0] * 2
+        self._V = np.vstack([self._V, np.zeros_like(self._V)])
+        self._weights = np.resize(self._weights, cap)
+        self._weights[self._rows:] = 0
+        self._row_layer = np.resize(self._row_layer, cap)
+        self._row_layer[self._rows:] = 0
+        for lyr, arr in self._abstain.items():
+            new = np.zeros(cap, bool)
+            new[:len(arr)] = arr
+            self._abstain[lyr] = new
+
+    def _grow_cols(self) -> None:
+        cap = self._V.shape[1] * 2
+        self._V = np.hstack([self._V, np.zeros_like(self._V)])
+        self._col_layer = np.resize(self._col_layer, cap)
+        self._col_layer[self._cols:] = 0
+
+    def _abstain_arr(self, layer: int) -> np.ndarray:
+        arr = self._abstain.get(layer)
+        if arr is None:
+            arr = np.zeros(self._V.shape[0], bool)
+            self._abstain[layer] = arr
+        return arr
+
     # --- inputs --------------------------------------------------------
 
     def on_block(self, layer: int, block_id: bytes) -> None:
+        if block_id in self._col_of:
+            return
         self._t("block", layer=layer, id=block_id)
         self._blocks.setdefault(layer, set()).add(block_id)
+        if self._cols == self._V.shape[1]:
+            self._grow_cols()
+        col = self._cols
+        self._cols += 1
+        self._col_of[block_id] = col
+        self._col_block.append(block_id)
+        self._col_layer[col] = layer
+        self._layer_cols.setdefault(layer, []).append(col)
+        # existing ballots vote against by default where their chain covers
+        # this layer, except where they abstain
+        n = self._rows
+        if n:
+            covered = self._row_layer[:n] > layer
+            ab = self._abstain.get(layer)
+            if ab is not None:
+                covered = covered & ~ab[:n]
+            self._V[:n, col] = np.where(covered, -1, 0).astype(np.int8)
+        # resolve pending support votes now that the block's layer is known
+        for bid in self._pending.pop(block_id, ()):
+            info = self._ballots.get(bid)
+            row = self._ballot_row.get(bid)
+            if info is None or row is None:
+                continue
+            if info.layer > layer and layer not in info.abstains:
+                info.supports.setdefault(layer, set()).add(block_id)
+                self._V[row, col] = 1
 
     def on_hare_output(self, layer: int, block_id: bytes) -> None:
         self._t("hare", layer=layer, id=block_id)
@@ -91,40 +179,92 @@ class Tortoise:
     def on_malfeasance(self, node_id: bytes) -> None:
         self._t("malfeasance", id=node_id)
         self.cache.set_malicious(node_id)
+        for row in self._node_rows.get(node_id, ()):
+            self._weights[row] = 0
+        for info in self._ballots.values():
+            if info.node_id == node_id:
+                info.malicious = True
 
     def on_ballot(self, ballot: Ballot, weight: int) -> None:
         """Resolve the ballot's opinion against its base and store it."""
-        bid = ballot.id
+        self._ingest(ballot.id, ballot.layer, ballot.node_id,
+                     ballot.opinion, weight)
+
+    def _ingest(self, bid: bytes, layer: int, node_id: bytes,
+                opinion: Opinion, weight: int) -> None:
         if bid in self._ballots:
             return
-        self._t("ballot", layer=ballot.layer, id=bid, weight=weight,
-                base=ballot.opinion.base)
-        base = self._ballots.get(ballot.opinion.base)
+        self._t("ballot", id=bid, layer=layer, node=node_id,
+                weight=weight, base=opinion.base,
+                support=[b.hex() for b in opinion.support],
+                against=[b.hex() for b in opinion.against],
+                abstain=list(opinion.abstain))
+        base = self._ballots.get(opinion.base)
         supports: dict[int, set[bytes]] = {}
         abstains: set[int] = set()
         if base is not None:
             supports = {lyr: set(s) for lyr, s in base.supports.items()}
             abstains = set(base.abstains)
-        block_layers = {b: lyr for lyr, blocks in self._blocks.items()
-                        for b in blocks}
-        for b in ballot.opinion.support:
-            lyr = block_layers.get(b)
-            if lyr is not None:
+        pend: list[bytes] = []
+        against = set(opinion.against)
+        # pending votes INHERIT through the base chain: if the base ballot
+        # is still waiting on a block, this ballot's opinion includes that
+        # support too (exception lists are deltas) — unless it explicitly
+        # votes against it
+        if base is not None:
+            for blk, waiters in self._pending.items():
+                if opinion.base in waiters and blk not in against:
+                    pend.append(blk)
+        for b in opinion.support:
+            col = self._col_of.get(b)
+            if col is not None:
+                lyr = int(self._col_layer[col])
                 supports.setdefault(lyr, set()).add(b)
                 abstains.discard(lyr)
-        for b in ballot.opinion.against:
-            lyr = block_layers.get(b)
-            if lyr is not None and lyr in supports:
-                supports[lyr].discard(b)
-        for lyr in ballot.opinion.abstain:
+            else:
+                pend.append(b)
+        for b in against:
+            col = self._col_of.get(b)
+            if col is not None:
+                lyr = int(self._col_layer[col])
+                if lyr in supports:
+                    supports[lyr].discard(b)
+        for lyr in opinion.abstain:
             abstains.add(lyr)
             supports.pop(lyr, None)
-        info = BallotInfo(
-            id=bid, layer=ballot.layer, weight=weight, supports=supports,
-            abstains=abstains,
-            malicious=self.cache.is_malicious(ballot.node_id))
+        malicious = self.cache.is_malicious(node_id)
+        info = BallotInfo(id=bid, layer=layer, weight=weight,
+                          node_id=node_id, supports=supports,
+                          abstains=abstains, malicious=malicious)
         self._ballots[bid] = info
-        self._ballots_by_layer.setdefault(ballot.layer, []).append(bid)
+        self._ballots_by_layer.setdefault(layer, []).append(bid)
+
+        # --- matrix row ---
+        if self._rows == self._V.shape[0]:
+            self._grow_rows()
+        row = self._rows
+        self._rows += 1
+        self._row_ballot.append(bid)
+        self._ballot_row[bid] = row
+        self._node_rows.setdefault(node_id, []).append(row)
+        self._weights[row] = 0 if malicious else weight
+        self._row_layer[row] = layer
+        c = self._cols
+        if c:
+            self._V[row, :c] = np.where(self._col_layer[:c] < layer,
+                                        -1, 0).astype(np.int8)
+        for lyr in abstains:
+            self._abstain_arr(lyr)[row] = True
+            cols = self._layer_cols.get(lyr)
+            if cols:
+                self._V[row, cols] = 0
+        for lyr, blocks in supports.items():
+            for b in blocks:
+                col = self._col_of.get(b)
+                if col is not None:
+                    self._V[row, col] = 1
+        for b in pend:
+            self._pending.setdefault(b, set()).add(bid)
 
     # --- counting ------------------------------------------------------
 
@@ -139,36 +279,41 @@ class Tortoise:
         per_layer = w // self.layers_per_epoch or 1
         return max(per_layer * min(span, self.window) // 3, 1)
 
-    def _margin(self, target_layer: int, block_id: bytes, last: int) -> int:
-        m = 0
-        for lyr in range(target_layer + 1, last + 1):
-            for bid in self._ballots_by_layer.get(lyr, ()):
-                info = self._ballots[bid]
-                if info.malicious:
-                    continue
-                if target_layer in info.abstains:
-                    continue
-                sup = info.supports.get(target_layer, set())
-                m += info.weight if block_id in sup else -info.weight
-        return m
+    def _margins(self, layer: int, last: int) -> tuple[list[bytes], np.ndarray]:
+        """Margins for every block in ``layer``: one masked mat-vec."""
+        cols = self._layer_cols.get(layer, [])
+        if not cols:
+            return [], np.zeros(0, np.int64)
+        n = self._rows
+        active = (self._row_layer[:n] > layer) & (self._row_layer[:n] <= last)
+        w = np.where(active, self._weights[:n], 0)
+        margins = w @ self._V[:n, cols].astype(np.int64)
+        return [self._col_block[c] for c in cols], margins
 
     def tally_votes(self, last: int) -> None:
         """Advance the verified frontier up to ``last`` - 1."""
         self.processed = max(self.processed, last)
         self._t("tally", last=last)
         frontier = self.verified
+        healed = False
         for layer in range(self.verified + 1, last):
             decided_all = True
-            blocks = self._blocks.get(layer, set())
             t = self._threshold(layer, last)
-            for b in sorted(blocks):
-                margin = self._margin(layer, b, last)
+            heal = last - layer > self.hdist + self.zdist
+            blocks, margins = self._margins(layer, last)
+            for b, margin in zip(blocks, margins):
+                margin = int(margin)
                 if margin > t:
                     decided = True
                 elif margin < -t:
                     decided = False
                 elif last - layer < self.hdist and layer in self._hare:
                     decided = self._hare[layer] == b
+                elif heal:
+                    # full-mode healing: past the confidence window, the
+                    # sign of the global count decides (tortoise/full.go)
+                    decided = margin > 0
+                    healed = True
                 else:
                     decided_all = False
                     continue
@@ -176,7 +321,8 @@ class Tortoise:
                     self._validity[b] = decided
                     self._updates.append(Update(layer, b, decided))
             if not blocks:
-                # empty layer: decided by hare's "empty" or by abstain decay
+                # empty layer: decided by hare's "empty", by distance, or
+                # by healing
                 if layer in self._hare and self._hare[layer] == EMPTY:
                     pass
                 elif last - layer < self.hdist:
@@ -185,19 +331,77 @@ class Tortoise:
                 frontier = layer
             else:
                 break
+        if healed and self.mode != FULL:
+            self.mode = FULL
+            self._t("mode", mode=FULL)
+        elif not healed and self.mode != VERIFYING and last - frontier <= self.hdist:
+            self.mode = VERIFYING
+            self._t("mode", mode=VERIFYING)
         if frontier != self.verified:
             self.verified = frontier
             self._t("verified", layer=frontier)
         self._evict()
 
+    # --- eviction / compaction ----------------------------------------
+
     def _evict(self) -> None:
         low = self.verified - self.window
-        for store in (self._ballots_by_layer, self._blocks):
-            for lyr in [x for x in store if x < low]:
-                if store is self._ballots_by_layer:
-                    for bid in store[lyr]:
-                        self._ballots.pop(bid, None)
-                del store[lyr]
+        stale_layers = [x for x in self._ballots_by_layer if x < low]
+        stale_blocks = [x for x in self._blocks if x < low]
+        if not stale_layers and not stale_blocks:
+            return
+        for lyr in stale_layers:
+            for bid in self._ballots_by_layer[lyr]:
+                self._ballots.pop(bid, None)
+                self._ballot_row.pop(bid, None)
+            del self._ballots_by_layer[lyr]  # _compact rebuilds _node_rows
+        for lyr in stale_blocks:
+            del self._blocks[lyr]
+        self._compact(low)
+
+    def _compact(self, low: int) -> None:
+        """Rebuild the matrix keeping only rows/cols inside the window."""
+        keep_rows = [r for r in range(self._rows)
+                     if int(self._row_layer[r]) >= low
+                     and self._row_ballot[r] in self._ballots]
+        keep_cols = [c for c in range(self._cols)
+                     if int(self._col_layer[c]) >= low]
+        V = np.zeros_like(self._V)
+        V[:len(keep_rows), :len(keep_cols)] = \
+            self._V[np.ix_(keep_rows, keep_cols)]
+        self._V = V
+        self._weights[:len(keep_rows)] = self._weights[keep_rows]
+        self._weights[len(keep_rows):] = 0
+        self._row_layer[:len(keep_rows)] = self._row_layer[keep_rows]
+        self._row_layer[len(keep_rows):] = 0
+        self._col_layer[:len(keep_cols)] = self._col_layer[keep_cols]
+        self._col_layer[len(keep_cols):] = 0
+        self._row_ballot = [self._row_ballot[r] for r in keep_rows]
+        self._col_block = [self._col_block[c] for c in keep_cols]
+        self._ballot_row = {b: i for i, b in enumerate(self._row_ballot)}
+        self._col_of = {b: i for i, b in enumerate(self._col_block)}
+        self._rows = len(keep_rows)
+        self._cols = len(keep_cols)
+        self._layer_cols = {}
+        for c, b in enumerate(self._col_block):
+            self._layer_cols.setdefault(int(self._col_layer[c]), []).append(c)
+        self._node_rows = {}
+        for i, bid in enumerate(self._row_ballot):
+            info = self._ballots.get(bid)
+            if info is not None:
+                self._node_rows.setdefault(info.node_id, []).append(i)
+        for lyr in [x for x in self._abstain if x < low]:
+            del self._abstain[lyr]
+        # pending votes whose waiters were all evicted can never resolve
+        self._pending = {blk: live for blk, ws in self._pending.items()
+                         if (live := {b for b in ws if b in self._ballots})}
+        for lyr, arr in list(self._abstain.items()):
+            new = np.zeros(self._V.shape[0], bool)
+            for i, r in enumerate(keep_rows):
+                new[i] = arr[r] if r < len(arr) else False
+            self._abstain[lyr] = new
+
+    # --- outputs -------------------------------------------------------
 
     def updates(self) -> list[Update]:
         out, self._updates = self._updates, []
@@ -247,3 +451,94 @@ class Tortoise:
             against += sorted(base_sup - local)
         return Opinion(base=base_id, support=support, against=against,
                        abstain=abstain)
+
+    # --- recovery (reference tortoise/recover.go:20) -------------------
+
+    @classmethod
+    def recover(cls, db, cache: AtxCache, oracle, *, layers_per_epoch: int,
+                hdist: int, zdist: int, window: int,
+                tracer=None) -> "Tortoise":
+        """Rebuild tortoise state from storage after a restart: blocks and
+        their persisted validity, certified/applied hare outputs, then
+        ballots in layer order (so bases resolve, reference recover.go
+        replays in the same order)."""
+        from ..storage import ballots as ballotstore
+        from ..storage import blocks as blockstore
+        from ..storage import layers as layerstore
+        from ..storage import misc as miscstore
+
+        t = cls(cache, layers_per_epoch, hdist=hdist, zdist=zdist,
+                window=window, tracer=tracer)
+        processed = layerstore.processed(db)
+        if processed < 0:
+            return t
+        low = max(1, processed - window)
+        for layer in range(low, processed + 1):
+            for bid in blockstore.ids_in_layer(db, layer):
+                t.on_block(layer, bid)
+                validity = blockstore.validity(db, bid)
+                if validity == blockstore.VALID:
+                    t._validity[bid] = True
+                elif validity == blockstore.INVALID:
+                    t._validity[bid] = False
+            cert = miscstore.certified_block(db, layer)
+            applied = layerstore.applied_block(db, layer)
+            if cert is not None:
+                t.on_hare_output(layer, cert)
+            elif applied is not None:
+                t.on_hare_output(layer, applied)
+        for layer in range(low, processed + 1):
+            for ballot in ballotstore.in_layer(db, layer):
+                epoch = layer // layers_per_epoch
+                info = cache.get(epoch, ballot.atx_id)
+                if info is None:
+                    continue
+                num = oracle.num_slots(epoch, ballot.atx_id)
+                unit = info.weight // max(num, 1)
+                t.on_ballot(ballot, unit * len(ballot.eligibilities))
+        t.processed = processed
+        t.verified = max(
+            min(layerstore.last_applied(db), processed) - 1, 0)
+        return t
+
+
+# --- trace replay (reference tortoise/tracer.go:79 RunTrace) ---------------
+
+
+def replay_trace(lines, cache: AtxCache | None = None,
+                 tracer=None) -> Tortoise:
+    """Rebuild a Tortoise by replaying a recorded JSON trace. The trace is
+    self-contained: ballot events carry their full opinion and weight."""
+    cache = cache or AtxCache()
+    t: Tortoise | None = None
+    for line in lines:
+        line = line.strip()
+        if not line:
+            continue
+        ev = json.loads(line)
+        kind = ev["ev"]
+        if kind == "init":
+            t = Tortoise(cache, ev["lpe"], hdist=ev["hdist"],
+                         zdist=ev.get("zdist", 8), window=ev["window"],
+                         tracer=tracer)
+        elif t is None:
+            raise ValueError("trace does not start with an init event")
+        elif kind == "block":
+            t.on_block(ev["layer"], bytes.fromhex(ev["id"]))
+        elif kind == "hare":
+            t.on_hare_output(ev["layer"], bytes.fromhex(ev["id"]))
+        elif kind == "malfeasance":
+            t.on_malfeasance(bytes.fromhex(ev["id"]))
+        elif kind == "ballot":
+            op = Opinion(
+                base=bytes.fromhex(ev["base"]),
+                support=[bytes.fromhex(x) for x in ev["support"]],
+                against=[bytes.fromhex(x) for x in ev["against"]],
+                abstain=list(ev["abstain"]))
+            t._ingest(bytes.fromhex(ev["id"]), ev["layer"],
+                      bytes.fromhex(ev["node"]), op, ev["weight"])
+        elif kind == "tally":
+            t.tally_votes(ev["last"])
+    if t is None:
+        raise ValueError("empty trace")
+    return t

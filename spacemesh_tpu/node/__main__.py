@@ -25,6 +25,10 @@ def main(argv=None) -> int:
                    help="set genesis time to now + one layer")
     p.add_argument("--api", action="store_true",
                    help="serve the JSON API on api.private_listener")
+    p.add_argument("--listen", help="p2p listen addr (host:port; enables "
+                   "the TCP transport)")
+    p.add_argument("--bootnode", action="append", default=[],
+                   help="bootstrap peer host:port (repeatable)")
     a = p.parse_args(argv)
 
     from .app import App
@@ -34,6 +38,8 @@ def main(argv=None) -> int:
     overrides = {}
     if a.data_dir:
         overrides["data_dir"] = a.data_dir
+    if a.listen:
+        overrides["p2p"] = {"listen": a.listen, "bootnodes": a.bootnode}
     cfg = load(a.preset, file=a.config, overrides=overrides)
     app = App(cfg)
 
@@ -52,12 +58,18 @@ def main(argv=None) -> int:
 
         reporter = asyncio.ensure_future(report())
         api_started = False
+        net_started = False
         try:
             if a.api:
                 port = await app.start_api()
                 api_started = True
                 print(json.dumps({"event": "ApiStarted", "port": port}),
                       flush=True)
+            if a.listen or cfg.p2p.bootnodes:
+                addr = await app.start_network()
+                net_started = True
+                print(json.dumps({"event": "P2PStarted", "host": addr[0],
+                                  "port": addr[1]}), flush=True)
             await app.prepare()
             if a.genesis_now:
                 # rebase the CLOCK only, after the slow prepare (POST init,
@@ -69,6 +81,8 @@ def main(argv=None) -> int:
             await app.run(until_layer=a.until_layer)
         finally:
             reporter.cancel()
+            if net_started:
+                await app.stop_network()
             if api_started:
                 await app.api.stop()  # stop accepting before the DB closes
             app.close()

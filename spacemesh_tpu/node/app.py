@@ -86,7 +86,8 @@ class App:
         self.cstate = ConservativeState(self.state, self.vm)
         self.tortoise = tortoise_mod.Tortoise(
             self.cache, cfg.layers_per_epoch, hdist=cfg.tortoise.hdist,
-            window=cfg.tortoise.window_size)
+            zdist=cfg.tortoise.zdist, window=cfg.tortoise.window_size,
+            tracer=self._tortoise_tracer())
         self.proposal_store = mesh_mod.ProposalStore()
         self.executor = mesh_mod.Executor(self.state, self.vm, self.cstate)
         self.mesh = mesh_mod.Mesh(
@@ -94,8 +95,15 @@ class App:
             proposals=self.proposal_store, cache=self.cache)
         self.beacon = beacon_mod.ProtocolDriver(
             db=self.state, oracle=self.oracle, pubsub=self.pubsub,
-            genesis_id=cfg.genesis.genesis_id,
-            proposal_duration=cfg.beacon.proposal_duration)
+            genesis_id=cfg.genesis.genesis_id, verifier=self.verifier,
+            proposal_duration=cfg.beacon.proposal_duration,
+            first_voting_round_duration=cfg.beacon.first_voting_round_duration,
+            voting_round_duration=cfg.beacon.voting_round_duration,
+            rounds_number=cfg.beacon.rounds_number,
+            grace_period=cfg.beacon.grace_period,
+            kappa=cfg.beacon.kappa, theta=cfg.beacon.theta,
+            on_fallback_used=lambda epoch, reason: self.events.emit(
+                events_mod.BeaconFallback(epoch=epoch, reason=reason)))
         self.post_params = ProofParams(
             k1=cfg.post.k1, k2=cfg.post.k2, k3=cfg.post.k3,
             pow_difficulty=cfg.post.pow_difficulty_bytes)
@@ -167,13 +175,10 @@ class App:
     def _recover_state(self) -> None:
         """Warm the in-RAM caches from storage after a restart (reference
         atxsdata warmup node.go:1963 setupDBs + tortoise.Recover
-        tortoise/recover.go:20): the ATX cache, tortoise blocks/validity,
-        certified hare outputs, and stored ballots re-fed in layer order."""
+        tortoise/recover.go:20): the ATX cache, then the tortoise rebuilt
+        through Tortoise.recover."""
         from ..core.types import ActivationTx
         from ..storage import atxs as atxstore
-        from ..storage import ballots as ballotstore
-        from ..storage import blocks as blockstore
-        from ..storage import layers as layerstore
         from ..storage import misc as miscstore
         from ..storage.cache import AtxInfo
 
@@ -192,37 +197,38 @@ class App:
         for node_id in miscstore.all_malicious(self.state):
             self.cache.set_malicious(node_id)
 
-        processed = layerstore.processed(self.state)
-        if processed < 0:
-            return
-        low = max(1, processed - self.cfg.tortoise.window_size)
-        for layer in range(low, processed + 1):
-            for bid in blockstore.ids_in_layer(self.state, layer):
-                self.tortoise.on_block(layer, bid)
-                validity = blockstore.validity(self.state, bid)
-                if validity == blockstore.VALID:
-                    self.tortoise._validity[bid] = True
-                elif validity == blockstore.INVALID:
-                    self.tortoise._validity[bid] = False
-            cert = miscstore.certified_block(self.state, layer)
-            applied = layerstore.applied_block(self.state, layer)
-            if cert is not None:
-                self.tortoise.on_hare_output(layer, cert)
-            elif applied is not None:
-                self.tortoise.on_hare_output(layer, applied)
-        for layer in range(low, processed + 1):
-            for ballot in ballotstore.in_layer(self.state, layer):
-                epoch = layer // self.cfg.layers_per_epoch
-                info = self.cache.get(epoch, ballot.atx_id)
-                if info is None:
-                    continue
-                num = self.oracle.num_slots(epoch, ballot.atx_id)
-                unit = info.weight // max(num, 1)
-                self.tortoise.on_ballot(ballot,
-                                        unit * len(ballot.eligibilities))
-        self.tortoise.processed = processed
-        self.tortoise.verified = max(
-            min(layerstore.last_applied(self.state), processed) - 1, 0)
+        self.tortoise = tortoise_mod.Tortoise.recover(
+            self.state, self.cache, self.oracle,
+            layers_per_epoch=self.cfg.layers_per_epoch,
+            hdist=self.cfg.tortoise.hdist, zdist=self.cfg.tortoise.zdist,
+            window=self.cfg.tortoise.window_size,
+            tracer=self._tortoise_tracer())
+        self._rewire_tortoise()
+
+    def _tortoise_tracer(self):
+        """One shared tracer per App: __init__ builds a tortoise in _wire
+        and immediately replaces it in _recover_state — both must share
+        the file handle (and replay treats the LAST init event as the
+        live one, so the discarded instance's init line is harmless)."""
+        if not self.cfg.tortoise.trace:
+            return None
+        if getattr(self, "_tracer_fn", None) is None:
+            fh = open(self.data / "tortoise_trace.jsonl", "a")
+
+            def write(line: str) -> None:
+                fh.write(line + "\n")
+                fh.flush()
+
+            self._tracer_fn = write
+        return self._tracer_fn
+
+    def _rewire_tortoise(self) -> None:
+        """Point every service that captured the tortoise at the recovered
+        instance (recovery replaces the object built in _wire)."""
+        self.mesh.tortoise = self.tortoise
+        self.miner.tortoise = self.tortoise
+        self.proposal_handler.tortoise = self.tortoise
+        self.malfeasance.tortoise = self.tortoise
 
     # --- networking (request/response + fetch + sync) -------------------
 
@@ -368,6 +374,32 @@ class App:
             process_layer=process_synced_layer,
             layers_per_epoch=self.cfg.layers_per_epoch,
             store_beacon=self.beacon.on_fallback)
+
+    async def start_network(self) -> tuple[str, int]:
+        """Open the real TCP transport (p2p/transport.Host) on
+        cfg.p2p.listen, bootstrap-dial cfg.p2p.bootnodes, and run the
+        syncer in the background. Returns the bound (host, port)."""
+        from ..p2p.transport import Host
+
+        cfg = self.cfg.p2p
+        self.host = Host(
+            node_id=self.signer.node_id,
+            genesis_id=self.cfg.genesis.genesis_id,
+            listen=cfg.listen or "127.0.0.1:0",
+            bootstrap=cfg.bootnodes,
+            min_peers=cfg.min_peers, max_peers=cfg.max_peers)
+        addr = await self.host.start()
+        self.host.join_pubsub(self.pubsub)
+        self.connect_network(self.host)
+        self._tasks.append(asyncio.ensure_future(self.syncer.run()))
+        return addr
+
+    async def stop_network(self) -> None:
+        if getattr(self, "host", None) is not None:
+            if self.syncer is not None:
+                self.syncer.stop()
+            await self.host.stop()
+            self.host = None
 
     # --- handlers ------------------------------------------------------
 

@@ -84,6 +84,8 @@ class TortoiseConfig:
     zdist: int = 8
     window_size: int = 1000
     delay_layers: int = 10
+    trace: bool = False          # record a replayable JSON trace
+                                 # (reference node.go:688 EnableTracer)
 
 
 @dataclasses.dataclass

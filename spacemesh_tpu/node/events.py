@@ -35,6 +35,14 @@ class BeaconEvent:
 
 
 @dataclasses.dataclass
+class BeaconFallback:
+    """Beacon protocol could not decide; a fallback value was recorded."""
+
+    epoch: int
+    reason: str
+
+
+@dataclasses.dataclass
 class TxEvent:
     tx_id: bytes
     valid: bool

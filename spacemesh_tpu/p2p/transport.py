@@ -1,0 +1,484 @@
+"""TCP transport: real sockets beneath the PubSub / Server seams.
+
+Fills the round-1 gap ("no sockets anywhere"): one `Host` per node owns a
+TCP listener plus outbound dials and slots in as BOTH the pubsub hub
+(`PubSub._hub`) and the request/response net (`Server._net`), so every
+existing protocol component runs unchanged over a real network.
+
+Reference parity (behavior, not mechanism — the reference rides libp2p):
+- network-cookie handshake: both sides open with a HELLO carrying the
+  20-byte genesis id (+ optional cookie); mismatch closes the connection
+  (reference p2p/handshake/handshake.go — splits testnets from mainnet).
+- gossip: flood-publish with content-id dedup and relay-on-accept; a
+  validation reject penalizes the sending peer and repeated rejects drop
+  it (reference pubsub.go:168 DropPeerOnValidationReject, gossipsub
+  scoring).
+- req/resp: varint-style framed request/response streams with per-request
+  correlation ids (reference p2p/server/server.go).
+- peer exchange + redial: HELLO carries the listen port; peers gossip
+  known addresses and a maintainer task keeps dialing until min_peers
+  (reference p2p discovery/bootstrap, p2p/dhtdiscovery).
+
+Framing: u32 LE length, then u8 frame type, then the payload. One
+connection per peer pair (simultaneous-dial ties broken by node id:
+the dial initiated by the LOWER id survives).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import struct
+import time
+from typing import Optional
+
+from ..core.hashing import sum256
+
+MSG_HELLO = 0
+MSG_GOSSIP = 1
+MSG_REQ = 2
+MSG_RESP = 3
+MSG_PEERS = 4
+
+MAX_FRAME = 64 << 20
+SEEN_CAP = 1 << 14
+
+
+class HandshakeError(Exception):
+    pass
+
+
+SEND_QUEUE_CAP = 4096
+
+
+class _Conn:
+    """One live peer connection (post-handshake).
+
+    Outbound frames go through a bounded per-connection queue drained by a
+    writer task: a stalled peer (full socket buffer, SIGSTOP'd process)
+    must never block the sender's consensus rounds — the queue overflows
+    and the connection drops instead."""
+
+    def __init__(self, reader: asyncio.StreamReader,
+                 writer: asyncio.StreamWriter, node_id: bytes,
+                 listen_addr: Optional[tuple[str, int]], outbound: bool):
+        self.reader = reader
+        self.writer = writer
+        self.node_id = node_id
+        self.listen_addr = listen_addr
+        self.outbound = outbound
+        self.score = 0
+        self.send_queue: asyncio.Queue = asyncio.Queue()
+        # ordered gossip delivery per peer (frames arrive in order; handler
+        # execution must preserve it, like LoopbackHub's per-receiver inbox)
+        self.gossip_queue: asyncio.Queue = asyncio.Queue()
+        self.closed = asyncio.Event()
+        self.tasks: list[asyncio.Task] = []
+
+    async def send(self, frame_type: int, payload: bytes) -> None:
+        if self.closed.is_set():
+            raise ConnectionError("connection closed")
+        if self.send_queue.qsize() >= SEND_QUEUE_CAP:
+            self.close()  # peer is not draining; don't buffer unboundedly
+            raise ConnectionError("send queue overflow")
+        self.send_queue.put_nowait(
+            struct.pack("<IB", len(payload) + 1, frame_type) + payload)
+
+    async def write_loop(self) -> None:
+        try:
+            while not self.closed.is_set():
+                frame = await self.send_queue.get()
+                if frame is None:
+                    return
+                self.writer.write(frame)
+                await self.writer.drain()
+        except (OSError, ConnectionError):
+            self.close()
+
+    def close(self) -> None:
+        self.closed.set()
+        # wake blocked queue consumers so their tasks can exit
+        self.send_queue.put_nowait(None)
+        self.gossip_queue.put_nowait(None)
+        try:
+            self.writer.close()
+        except Exception:  # noqa: BLE001
+            pass
+
+
+async def _read_frame(reader: asyncio.StreamReader) -> tuple[int, bytes]:
+    head = await reader.readexactly(4)
+    (length,) = struct.unpack("<I", head)
+    if not 1 <= length <= MAX_FRAME:
+        raise HandshakeError(f"bad frame length {length}")
+    body = await reader.readexactly(length)
+    return body[0], body[1:]
+
+
+class Host:
+    """One node's transport endpoint: listener + dials + gossip + req/resp.
+
+    Usage:
+        host = Host(node_id=..., genesis_id=..., listen="127.0.0.1:0",
+                    bootstrap=["127.0.0.1:7513"])
+        await host.start()
+        host.join_pubsub(pubsub)   # pubsub hub seam
+        host.join(server)          # req/resp net seam (Server._net)
+    """
+
+    def __init__(self, *, node_id: bytes, genesis_id: bytes,
+                 listen: str = "127.0.0.1:0", bootstrap: list[str] = (),
+                 min_peers: int = 3, max_peers: int = 32,
+                 reject_limit: int = 16, ban_seconds: float = 60.0,
+                 request_timeout: float = 10.0):
+        self.node_id = node_id
+        self.genesis_id = genesis_id
+        self.listen = listen
+        self.bootstrap = list(bootstrap)
+        self.min_peers = min_peers
+        self.max_peers = max_peers
+        self.reject_limit = reject_limit
+        self.ban_seconds = ban_seconds
+        self.request_timeout = request_timeout
+
+        self.address: tuple[str, int] | None = None  # bound listen addr
+        self._conns: dict[bytes, _Conn] = {}
+        self._known: dict[tuple[str, int], float] = {}  # addr -> last dial
+        self._banned: dict[bytes, float] = {}           # node_id -> until
+        self._seen: dict[bytes, None] = {}              # gossip msg-id LRU
+        self._req_id = 0
+        self._pending: dict[int, asyncio.Future] = {}
+        self._tasks: list[asyncio.Task] = []
+        self._listener: asyncio.AbstractServer | None = None
+        self._pubsub = None
+        self._server = None
+        self._stopping = False
+
+    # ------------------------------------------------------------------
+    # seam plumbing
+
+    def join_pubsub(self, pubsub) -> None:
+        pubsub._hub = self
+        self._pubsub = pubsub
+
+    def join(self, server) -> None:  # Server._net surface (LoopbackNet.join)
+        server._net = self
+        self._server = server
+
+    def leave(self, server) -> None:
+        server._net = None
+        self._server = None
+
+    @property
+    def nodes(self) -> dict[bytes, _Conn]:
+        """Connected peer ids (Server.peers() surface)."""
+        return self._conns
+
+    # ------------------------------------------------------------------
+    # lifecycle
+
+    async def start(self) -> tuple[str, int]:
+        host, _, port = self.listen.rpartition(":")
+        self._listener = await asyncio.start_server(
+            self._accept, host or "127.0.0.1", int(port or 0))
+        sock = self._listener.sockets[0]
+        self.address = sock.getsockname()[:2]
+        for spec in self.bootstrap:
+            h, _, p = spec.rpartition(":")
+            self._known[(h, int(p))] = 0.0
+        self._tasks.append(asyncio.ensure_future(self._maintain()))
+        return self.address
+
+    async def stop(self) -> None:
+        self._stopping = True
+        for task in self._tasks:
+            task.cancel()
+        for conn in list(self._conns.values()):
+            self._drop(conn)
+        self._conns.clear()
+        if self._listener is not None:
+            self._listener.close()
+            await self._listener.wait_closed()
+        for fut in self._pending.values():
+            if not fut.done():
+                fut.set_exception(ConnectionError("host stopped"))
+        self._pending.clear()
+
+    async def _maintain(self, interval: float = 1.0) -> None:
+        """Keep dialing known addresses until min_peers is met."""
+        while not self._stopping:
+            try:
+                if len(self._conns) < self.min_peers:
+                    now = time.monotonic()
+                    for addr, last in list(self._known.items()):
+                        if addr == self.address:
+                            continue
+                        if now - last < 2.0:
+                            continue
+                        if any(c.listen_addr == addr
+                               for c in self._conns.values()):
+                            continue
+                        self._known[addr] = now
+                        asyncio.ensure_future(self._dial(addr))
+            except Exception:  # noqa: BLE001 — keep the maintainer alive
+                pass
+            await asyncio.sleep(interval)
+
+    # ------------------------------------------------------------------
+    # connections
+
+    def _hello_payload(self) -> bytes:
+        port = self.address[1] if self.address else 0
+        return (struct.pack("<B", len(self.genesis_id)) + self.genesis_id
+                + self.node_id + struct.pack("<H", port))
+
+    @staticmethod
+    def _parse_hello(payload: bytes) -> tuple[bytes, bytes, int]:
+        glen = payload[0]
+        genesis = payload[1:1 + glen]
+        node_id = payload[1 + glen:1 + glen + 32]
+        (port,) = struct.unpack_from("<H", payload, 1 + glen + 32)
+        return genesis, node_id, port
+
+    async def _dial(self, addr: tuple[str, int]) -> None:
+        try:
+            reader, writer = await asyncio.wait_for(
+                asyncio.open_connection(addr[0], addr[1]), 5.0)
+        except (OSError, asyncio.TimeoutError):
+            return
+        try:
+            await self._handshake(reader, writer, outbound=True,
+                                  dialed_addr=addr)
+        except (HandshakeError, OSError, asyncio.IncompleteReadError,
+                asyncio.TimeoutError):
+            writer.close()
+
+    async def _accept(self, reader: asyncio.StreamReader,
+                      writer: asyncio.StreamWriter) -> None:
+        try:
+            await self._handshake(reader, writer, outbound=False)
+        except (HandshakeError, OSError, asyncio.IncompleteReadError,
+                asyncio.TimeoutError):
+            writer.close()
+
+    async def _handshake(self, reader, writer, *, outbound: bool,
+                         dialed_addr: tuple[str, int] | None = None) -> None:
+        await asyncio.wait_for(self._do_handshake(
+            reader, writer, outbound=outbound, dialed_addr=dialed_addr), 10.0)
+
+    async def _do_handshake(self, reader, writer, *, outbound: bool,
+                            dialed_addr=None) -> None:
+        writer.write(struct.pack("<IB", len(self._hello_payload()) + 1,
+                                 MSG_HELLO) + self._hello_payload())
+        await writer.drain()
+        ftype, payload = await _read_frame(reader)
+        if ftype != MSG_HELLO:
+            raise HandshakeError("expected HELLO")
+        genesis, peer_id, peer_port = self._parse_hello(payload)
+        if genesis != self.genesis_id:
+            raise HandshakeError("genesis mismatch")  # network cookie
+        if peer_id == self.node_id:
+            raise HandshakeError("self-dial")
+        if self._banned.get(peer_id, 0) > time.monotonic():
+            raise HandshakeError("peer banned")
+        if (len(self._conns) >= self.max_peers
+                and peer_id not in self._conns):
+            raise HandshakeError("max peers reached")
+        peer_host = writer.get_extra_info("peername")[0]
+        listen_addr = dialed_addr or ((peer_host, peer_port)
+                                      if peer_port else None)
+        conn = _Conn(reader, writer, peer_id, listen_addr, outbound)
+
+        # one connection per peer pair: on simultaneous dial, the dial
+        # initiated by the LOWER node id survives
+        existing = self._conns.get(peer_id)
+        if existing is not None and not existing.closed.is_set():
+            initiator = self.node_id if outbound else peer_id
+            if initiator == min(self.node_id, peer_id):
+                existing.close()
+            else:
+                raise HandshakeError("duplicate connection")
+        self._conns[peer_id] = conn
+        if listen_addr:
+            self._known.setdefault(listen_addr, 0.0)
+        conn.tasks = [asyncio.ensure_future(self._read_loop(conn)),
+                      asyncio.ensure_future(self._gossip_loop(conn)),
+                      asyncio.ensure_future(conn.write_loop())]
+        # peer exchange: tell the new peer every listen addr we know
+        await self._send_peers(conn)
+
+    async def _send_peers(self, conn: _Conn) -> None:
+        addrs = [a for a in self._known if a != conn.listen_addr][:64]
+        payload = struct.pack("<H", len(addrs))
+        for host_s, port in addrs:
+            hb = host_s.encode()
+            payload += struct.pack("<BH", len(hb), port) + hb
+        try:
+            await conn.send(MSG_PEERS, payload)
+        except (OSError, ConnectionError):
+            pass
+
+    def _drop(self, conn: _Conn, ban: bool = False) -> None:
+        conn.close()
+        if self._conns.get(conn.node_id) is conn:
+            del self._conns[conn.node_id]
+        if ban:
+            self._banned[conn.node_id] = time.monotonic() + self.ban_seconds
+        # let the conn's own loops finish, then reap them (peer churn must
+        # not accumulate tasks/queues forever)
+        for task in conn.tasks:
+            if task is not asyncio.current_task():
+                task.cancel()
+        conn.tasks = []
+
+    # ------------------------------------------------------------------
+    # frame processing
+
+    async def _read_loop(self, conn: _Conn) -> None:
+        try:
+            while not conn.closed.is_set():
+                ftype, payload = await _read_frame(conn.reader)
+                if ftype == MSG_GOSSIP:
+                    conn.gossip_queue.put_nowait(payload)
+                elif ftype == MSG_REQ:
+                    asyncio.ensure_future(self._handle_req(conn, payload))
+                elif ftype == MSG_RESP:
+                    self._handle_resp(payload)
+                elif ftype == MSG_PEERS:
+                    self._handle_peers(payload)
+        except (OSError, ConnectionError, asyncio.IncompleteReadError,
+                HandshakeError):
+            pass
+        finally:
+            self._drop(conn)
+
+    async def _gossip_loop(self, conn: _Conn) -> None:
+        while not conn.closed.is_set():
+            payload = await conn.gossip_queue.get()
+            if payload is None:  # close sentinel
+                return
+            try:
+                await self._handle_gossip(conn, payload)
+            except Exception:  # noqa: BLE001 — bad msg must not kill the loop
+                pass
+
+    @staticmethod
+    def _gossip_frame(topic: str, data: bytes) -> tuple[bytes, bytes]:
+        tb = topic.encode()
+        msg_id = sum256(tb, data)
+        return msg_id, struct.pack("<B", len(tb)) + tb + msg_id + data
+
+    def _mark_seen(self, msg_id: bytes) -> bool:
+        """True if newly seen."""
+        if msg_id in self._seen:
+            return False
+        self._seen[msg_id] = None
+        if len(self._seen) > SEEN_CAP:  # LRU-ish: evict oldest insertions
+            for key in list(self._seen)[:SEEN_CAP // 4]:
+                del self._seen[key]
+        return True
+
+    async def _handle_gossip(self, conn: _Conn, payload: bytes) -> None:
+        tlen = payload[0]
+        topic = payload[1:1 + tlen].decode()
+        msg_id = payload[1 + tlen:1 + tlen + 32]
+        data = payload[1 + tlen + 32:]
+        if sum256(topic.encode(), data) != msg_id:
+            self._penalize(conn)
+            return
+        if not self._mark_seen(msg_id):
+            return
+        ok = True
+        if self._pubsub is not None:
+            ok = await self._pubsub.deliver(topic, conn.node_id, data)
+        if ok:
+            await self._relay(payload, exclude=conn.node_id)
+        else:
+            self._penalize(conn)
+
+    def _penalize(self, conn: _Conn) -> None:
+        conn.score += 1
+        if conn.score >= self.reject_limit:
+            self._drop(conn, ban=True)
+
+    async def _relay(self, frame_payload: bytes, exclude: bytes) -> None:
+        for peer_id, conn in list(self._conns.items()):
+            if peer_id == exclude:
+                continue
+            try:
+                await conn.send(MSG_GOSSIP, frame_payload)
+            except (OSError, ConnectionError):
+                self._drop(conn)
+
+    async def _handle_req(self, conn: _Conn, payload: bytes) -> None:
+        (req_id,) = struct.unpack_from("<Q", payload)
+        plen = payload[8]
+        proto = payload[9:9 + plen].decode()
+        data = payload[9 + plen:]
+        status, resp = 0, b""
+        try:
+            if self._server is None:
+                raise ConnectionError("no server attached")
+            resp = await self._server.handle(proto, conn.node_id, data)
+        except Exception as e:  # noqa: BLE001 — error travels to the caller
+            status, resp = 1, str(e).encode()[:512]
+        try:
+            await conn.send(MSG_RESP,
+                            struct.pack("<QB", req_id, status) + resp)
+        except (OSError, ConnectionError):
+            self._drop(conn)
+
+    def _handle_resp(self, payload: bytes) -> None:
+        (req_id,) = struct.unpack_from("<Q", payload)
+        status = payload[8]
+        data = payload[9:]
+        fut = self._pending.pop(req_id, None)
+        if fut is None or fut.done():
+            return
+        if status == 0:
+            fut.set_result(data)
+        else:
+            from .server import RequestError
+
+            fut.set_exception(RequestError(data.decode(errors="replace")))
+
+    def _handle_peers(self, payload: bytes) -> None:
+        (count,) = struct.unpack_from("<H", payload)
+        off = 2
+        for _ in range(min(count, 64)):
+            hlen, port = struct.unpack_from("<BH", payload, off)
+            off += 3
+            host_s = payload[off:off + hlen].decode()
+            off += hlen
+            addr = (host_s, port)
+            if addr != self.address and len(self._known) < 1024:
+                self._known.setdefault(addr, 0.0)
+
+    # ------------------------------------------------------------------
+    # pubsub hub surface (PubSub._hub)
+
+    async def broadcast(self, sender, topic: str, data: bytes) -> None:
+        msg_id, frame = self._gossip_frame(topic, data)
+        self._mark_seen(msg_id)  # don't re-deliver our own message
+        await self._relay(frame, exclude=self.node_id)
+
+    # ------------------------------------------------------------------
+    # req/resp net surface (Server._net)
+
+    async def route(self, src: bytes, dst: bytes, protocol: str,
+                    data: bytes) -> bytes:
+        from .server import RequestError
+
+        conn = self._conns.get(dst)
+        if conn is None or conn.closed.is_set():
+            raise RequestError(f"peer {dst.hex()[:8]} not reachable")
+        self._req_id += 1
+        req_id = self._req_id
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._pending[req_id] = fut
+        pb = protocol.encode()
+        try:
+            await conn.send(MSG_REQ, struct.pack("<QB", req_id, len(pb))
+                            + pb + data)
+            return await fut
+        finally:
+            self._pending.pop(req_id, None)

@@ -107,6 +107,37 @@ def cmd_benchmark(a) -> int:
     return 0
 
 
+def cmd_serve(a) -> int:
+    """Serve every identity under --data-dir to the node over TCP
+    (the out-of-process worker; reference post-service + gRPC seam)."""
+    import asyncio
+
+    from .prover import ProofParams
+    from .remote import WorkerServer, discover_identities
+
+    params = ProofParams(k1=a.k1, k2=a.k2, k3=a.k3,
+                         pow_difficulty=bytes.fromhex(a.pow_difficulty))
+    service = discover_identities(a.data_dir, params=params)
+
+    async def go():
+        server = WorkerServer(service, listen=a.listen)
+        host, port = await server.start()
+        print(json.dumps({"event": "Serving", "host": host, "port": port,
+                          "identities": [n.hex() for n in
+                                         service.registered()]}),
+              flush=True)
+        try:
+            await asyncio.Event().wait()  # until killed
+        finally:
+            await server.stop()
+
+    try:
+        asyncio.run(go())
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="spacemesh_tpu.post")
     sub = p.add_subparsers(dest="cmd", required=True)
@@ -145,6 +176,18 @@ def main(argv=None) -> int:
     pb.add_argument("--batch", type=int, default=2048)
     pb.add_argument("--scrypt-n", type=int, default=8192)
     pb.set_defaults(fn=cmd_benchmark)
+
+    ps = sub.add_parser("serve", help="serve identities to the node "
+                        "(out-of-process worker)")
+    ps.add_argument("--data-dir", required=True,
+                    help="base dir holding per-identity POST data dirs")
+    ps.add_argument("--listen", default="127.0.0.1:0")
+    ps.add_argument("--k1", type=int, default=26)
+    ps.add_argument("--k2", type=int, default=37)
+    ps.add_argument("--k3", type=int, default=37)
+    ps.add_argument("--pow-difficulty", default="00ff" + "ff" * 30,
+                    help="32-byte hex PoW difficulty")
+    ps.set_defaults(fn=cmd_serve)
 
     a = p.parse_args(argv)
     return a.fn(a)

@@ -1,0 +1,107 @@
+"""PostSupervisor: spawn + babysit the out-of-process POST worker.
+
+Mirrors the reference's subprocess management (reference
+activation/post_supervisor.go:66-299: runCmd spawns the Rust post-service
+with its flags, captures logs, restarts it on exit until stopped). The
+worker here is this package's own CLI (`python -m spacemesh_tpu.post
+serve`), so one binary covers init/prove/verify/serve.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+from pathlib import Path
+
+
+class PostSupervisor:
+    def __init__(self, base_dir: str | Path, listen: str = "127.0.0.1:0",
+                 restart_backoff: float = 1.0, env: dict | None = None,
+                 params=None):
+        self.base_dir = str(base_dir)
+        self.listen = listen
+        self.restart_backoff = restart_backoff
+        self.env = env
+        self.params = params  # ProofParams for the worker's provers
+        self.address: tuple[str, int] | None = None
+        self._proc: subprocess.Popen | None = None
+        self._stopped = threading.Event()
+        self._ready = threading.Event()
+        self._thread: threading.Thread | None = None
+        self.restarts = -1  # first start is not a restart
+
+    def start(self, timeout: float = 60.0) -> tuple[str, int]:
+        """Spawn the worker and wait until it reports its listen port."""
+        self._thread = threading.Thread(target=self._babysit, daemon=True)
+        self._thread.start()
+        if not self._ready.wait(timeout):
+            self.stop()
+            raise TimeoutError("post worker did not come up")
+        assert self.address is not None
+        return self.address
+
+    def _spawn(self) -> subprocess.Popen:
+        env = dict(os.environ if self.env is None else self.env)
+        repo_root = str(Path(__file__).resolve().parent.parent.parent)
+        env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+        # keep the worker's port stable across restarts so clients reconnect
+        listen = self.listen
+        if self.address is not None:
+            listen = f"{self.address[0]}:{self.address[1]}"
+        cmd = [sys.executable, "-u", "-m", "spacemesh_tpu.post", "serve",
+               "--data-dir", self.base_dir, "--listen", listen]
+        if self.params is not None:
+            cmd += ["--k1", str(self.params.k1), "--k2", str(self.params.k2),
+                    "--k3", str(self.params.k3),
+                    "--pow-difficulty", self.params.pow_difficulty.hex()]
+        return subprocess.Popen(
+            cmd, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+            env=env, text=True)
+
+    def _babysit(self) -> None:
+        while not self._stopped.is_set():
+            self._proc = self._spawn()
+            self.restarts += 1
+            if self._stopped.is_set():
+                # stop() raced our spawn; it may have terminated only the
+                # previous proc — reap this one ourselves
+                self._proc.terminate()
+                self._proc.wait(timeout=10)
+                return
+            for line in self._proc.stdout:  # type: ignore[union-attr]
+                try:
+                    ev = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if ev.get("event") == "Serving":
+                    self.address = (ev["host"], ev["port"])
+                    self._ready.set()
+            self._proc.wait()
+            if self._stopped.is_set():
+                return
+            time.sleep(self.restart_backoff)  # crash: restart
+
+    def stop(self) -> None:
+        self._stopped.set()
+        # _babysit may be mid-restart: keep terminating whatever proc is
+        # current until the babysitter thread exits
+        for _ in range(5):
+            proc = self._proc
+            if proc is not None and proc.poll() is None:
+                proc.terminate()
+                try:
+                    proc.wait(timeout=5)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+            if self._thread is None or not self._thread.is_alive():
+                return
+            self._thread.join(timeout=3)
+            if not self._thread.is_alive():
+                return
+
+    def alive(self) -> bool:
+        return self._proc is not None and self._proc.poll() is None

@@ -46,6 +46,12 @@ class AtxCache:
                        self._epochs.get(target_epoch, {}).values()
                        if not i.malicious)
 
+    def epoch_count(self, target_epoch: int) -> int:
+        """Number of non-malicious ATXs targeting the epoch."""
+        with self._lock:
+            return sum(1 for i in self._epochs.get(target_epoch, {}).values()
+                       if not i.malicious)
+
     def weight_for_set(self, target_epoch: int, atx_ids: list[bytes]) -> int:
         with self._lock:
             e = self._epochs.get(target_epoch, {})

@@ -1,0 +1,48 @@
+"""Tortoise trace replayer (reference cmd/trace/main.go:19 -> RunTrace).
+
+  python -m spacemesh_tpu.tools.trace TRACE.jsonl
+
+Replays a recorded tortoise trace offline — deterministic consensus
+debugging: the trace is self-contained (ballot events carry full opinions
+and weights), so a node's exact vote-counting history can be re-executed
+and inspected without its database.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="spacemesh_tpu.tools.trace")
+    p.add_argument("trace", help="JSON-lines trace file (- for stdin)")
+    p.add_argument("--verbose", action="store_true",
+                   help="echo replayed events to stderr")
+    a = p.parse_args(argv)
+
+    from ..consensus.tortoise import replay_trace
+
+    fh = sys.stdin if a.trace == "-" else open(a.trace)
+    try:
+        echo = (lambda line: print(line, file=sys.stderr)) if a.verbose else None
+        t = replay_trace(fh, tracer=echo)
+    finally:
+        if fh is not sys.stdin:
+            fh.close()
+
+    print(json.dumps({
+        "verified": t.verified,
+        "processed": t.processed,
+        "mode": t.mode,
+        "ballots": len(t._ballots),
+        "blocks": sum(len(v) for v in t._blocks.values()),
+        "valid_blocks": sum(1 for v in t._validity.values() if v),
+        "invalid_blocks": sum(1 for v in t._validity.values() if not v),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

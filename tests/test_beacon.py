@@ -1,0 +1,161 @@
+"""Beacon protocol: multi-node convergence, adversaries, fallback.
+
+The round-2 "done" criterion (VERDICT item 3): N drivers over the in-proc
+hub, one adversarial proposer and one late joiner, all converging on one
+protocol-decided beacon; fallback only on explicit timeout with the
+reason recorded. Mirrors reference beacon/beacon.go runProposalPhase /
+runConsensusPhase + weakcoin.
+"""
+
+import asyncio
+
+from spacemesh_tpu.consensus import beacon as beacon_mod
+from spacemesh_tpu.consensus.eligibility import Oracle
+from spacemesh_tpu.core.signing import EdSigner, EdVerifier
+from spacemesh_tpu.p2p.pubsub import LoopbackHub, PubSub
+from spacemesh_tpu.storage import db as dbmod
+from spacemesh_tpu.storage import misc as miscstore
+from spacemesh_tpu.storage.cache import AtxCache, AtxInfo
+
+GEN = b"beacon-test-genesis!"
+EPOCH = 2
+LPE = 4
+
+
+def _driver(hub, cache, signer, **kw):
+    ps = PubSub(node_name=signer.node_id)
+    hub.join(ps)
+    db = dbmod.open_state(":memory:")
+    drv = beacon_mod.ProtocolDriver(
+        db=db, oracle=Oracle(cache, LPE), pubsub=ps, genesis_id=GEN,
+        verifier=EdVerifier(prefix=GEN),
+        proposal_duration=kw.pop("proposal_duration", 0.25),
+        first_voting_round_duration=0.25, voting_round_duration=0.2,
+        rounds_number=2, grace_period=0.1, theta=0.25, **kw)
+    return drv, db, ps
+
+
+def _cache_with(signers, weight=100):
+    cache = AtxCache()
+    atx_ids = {}
+    for i, s in enumerate(signers):
+        atx_id = b"ATX%05d" % i + bytes(24)
+        atx_ids[s.node_id] = atx_id
+        cache.add(EPOCH, atx_id, AtxInfo(
+            node_id=s.node_id, weight=weight, base_height=0, height=1,
+            num_units=1, vrf_nonce=0, vrf_public_key=s.node_id))
+    return cache, atx_ids
+
+
+def test_three_nodes_converge_one_beacon():
+    signers = [EdSigner(prefix=GEN) for _ in range(3)]
+    cache, atx_ids = _cache_with(signers)
+    hub = LoopbackHub()
+
+    async def go():
+        drivers = [_driver(hub, cache, s) for s in signers]
+        results = await asyncio.gather(*(
+            d.run_epoch(EPOCH, s, s.vrf_signer(), atx_ids[s.node_id])
+            for (d, _, _), s in zip(drivers, signers)))
+        assert len(set(results)) == 1, "nodes disagree on the beacon"
+        for d, db, _ in drivers:
+            assert miscstore.beacon_source(db, EPOCH) == \
+                miscstore.BEACON_PROTOCOL
+        return results[0]
+
+    beacon = asyncio.run(asyncio.wait_for(go(), 30))
+    assert len(beacon) == beacon_mod.BEACON_SIZE
+
+
+def test_adversarial_proposer_and_late_node_still_converge():
+    """One adversary (node 0) spams invalid proposals under someone
+    else's identity and withholds its votes; one node (node 3) starts
+    LATE, missing the whole proposal phase — all honest nodes plus the
+    late one still land on a single protocol beacon."""
+    signers = [EdSigner(prefix=GEN) for _ in range(4)]
+    cache, atx_ids = _cache_with(signers)
+    hub = LoopbackHub()
+
+    async def go():
+        honest = [_driver(hub, cache, s) for s in signers[1:3]]
+        late = _driver(hub, cache, signers[3])
+        adv_ps = PubSub(node_name=signers[0].node_id)
+        hub.join(adv_ps)
+
+        async def adversary():
+            # forged proposal: claims node 1's ATX with node 0's VRF
+            forged = beacon_mod.BeaconProposal(
+                epoch=EPOCH, atx_id=atx_ids[signers[1].node_id],
+                node_id=signers[1].node_id,
+                vrf_proof=signers[0].vrf_signer().prove(
+                    beacon_mod.proposal_alpha(EPOCH)))
+            for _ in range(3):
+                await adv_ps.publish(beacon_mod.TOPIC_BEACON_PROPOSAL,
+                                     forged.to_bytes())
+                await asyncio.sleep(0.05)
+
+        async def late_runner():
+            await asyncio.sleep(0.3)  # proposal phase is over
+            d, db, _ = late
+            return await d.run_epoch(EPOCH, signers[3],
+                                     signers[3].vrf_signer(),
+                                     atx_ids[signers[3].node_id])
+
+        results = await asyncio.gather(
+            *(d.run_epoch(EPOCH, s, s.vrf_signer(), atx_ids[s.node_id])
+              for (d, _, _), s in zip(honest, signers[1:3])),
+            late_runner(), adversary())
+        beacons = results[:3]
+        assert len(set(beacons)) == 1, f"divergence: {beacons}"
+        # node 1's slot must hold its OWN proposal, not the forged one:
+        # the forged VRF proof cannot verify under node 1's key
+        legit = beacon_mod.proposal_id(
+            signers[1].vrf_signer().prove(beacon_mod.proposal_alpha(EPOCH)))
+        forged_pid = beacon_mod.proposal_id(
+            signers[0].vrf_signer().prove(beacon_mod.proposal_alpha(EPOCH)))
+        for d, _, _ in honest:
+            st = d._states.get(EPOCH)
+            if st and signers[1].node_id in st.proposals:
+                pid, _grade = st.proposals[signers[1].node_id]
+                assert pid == legit
+                assert pid != forged_pid
+
+    asyncio.run(asyncio.wait_for(go(), 30))
+
+
+def test_fallback_only_on_timeout_with_reason():
+    """No proposals at all (observer with no ATX): the protocol records a
+    fallback with an explicit reason instead of silently bootstrapping."""
+    signer = EdSigner(prefix=GEN)
+    cache = AtxCache()  # empty: nobody is active
+    hub = LoopbackHub()
+    reasons = []
+
+    async def go():
+        drv, db, _ = _driver(hub, cache, signer,
+                             on_fallback_used=lambda e, r: reasons.append(r))
+        beacon = await drv.run_epoch(EPOCH, signer, signer.vrf_signer(), None)
+        assert beacon == drv._bootstrap(EPOCH)
+        assert miscstore.beacon_source(db, EPOCH) == \
+            miscstore.BEACON_FALLBACK
+        assert reasons and "no proposals" in reasons[0]
+
+    asyncio.run(asyncio.wait_for(go(), 30))
+
+
+def test_protocol_beacon_not_superseded_fallback_is():
+    signer = EdSigner(prefix=GEN)
+    cache, atx_ids = _cache_with([signer])
+    hub = LoopbackHub()
+
+    async def go():
+        drv, db, _ = _driver(hub, cache, signer)
+        b1 = await drv.run_epoch(EPOCH, signer, signer.vrf_signer(),
+                                 atx_ids[signer.node_id])
+        drv.on_fallback(EPOCH, b"\xde\xad\xbe\xef")
+        assert miscstore.get_beacon(db, EPOCH) == b1  # protocol is final
+        drv.on_fallback(5, b"\x01\x02\x03\x04")
+        drv.on_fallback(5, b"\x05\x06\x07\x08")       # fallback supersedes
+        assert miscstore.get_beacon(db, 5) == b"\x05\x06\x07\x08"
+
+    asyncio.run(asyncio.wait_for(go(), 30))

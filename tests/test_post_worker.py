@@ -1,0 +1,88 @@
+"""Out-of-process POST worker: transport, supervisor restart, proofs.
+
+The reference runs proving in a separate babysat process speaking gRPC
+(reference activation/post_supervisor.go, api/grpcserver/post_service.go);
+here the worker is `python -m spacemesh_tpu.post serve` and the node dials
+it with RemotePostClient. End-to-end: init tiny POST data on disk, serve
+it from a REAL subprocess, prove + verify through the wire, kill the
+worker and watch the supervisor restart it.
+"""
+
+import hashlib
+
+import pytest
+
+from spacemesh_tpu.post import initializer, verifier
+from spacemesh_tpu.post.prover import ProofParams
+from spacemesh_tpu.post.remote import RemotePostClient
+from spacemesh_tpu.post.supervisor import PostSupervisor
+
+NODE_ID = hashlib.sha256(b"worker-test-node").digest()
+COMMITMENT = hashlib.sha256(b"worker-test-commitment").digest()
+PARAMS = ProofParams(k1=64, k2=8, k3=4,
+                     pow_difficulty=b"\x20" + b"\xff" * 31)
+
+
+@pytest.fixture(scope="module")
+def data_dir(tmp_path_factory):
+    base = tmp_path_factory.mktemp("postworker")
+    d = base / NODE_ID.hex()[:16]
+    initializer.initialize(
+        d, node_id=NODE_ID, commitment=COMMITMENT, num_units=1,
+        labels_per_unit=256, scrypt_n=2, batch_size=128)
+    return base
+
+
+@pytest.fixture(scope="module")
+def supervisor(data_dir):
+    sup = PostSupervisor(data_dir, listen="127.0.0.1:0", params=PARAMS,
+                         restart_backoff=0.2)
+    sup.start(timeout=120)
+    yield sup
+    sup.stop()
+
+
+def test_info_over_the_wire(supervisor):
+    client = RemotePostClient(supervisor.address, NODE_ID)
+    info = client.info()
+    assert info.node_id == NODE_ID
+    assert info.commitment == COMMITMENT
+    assert info.num_units == 1
+    assert info.labels_per_unit == 256
+    assert client.ping() == [NODE_ID]
+
+
+def test_proof_over_the_wire_verifies(supervisor):
+    client = RemotePostClient(supervisor.address, NODE_ID, timeout=300)
+    challenge = hashlib.sha256(b"worker-challenge").digest()
+    proof, meta = client.proof(challenge)
+    assert len(proof.indices) == PARAMS.k2
+    ok = verifier.verify(verifier.VerifyItem(
+        proof=proof, challenge=challenge, node_id=NODE_ID,
+        commitment=COMMITMENT, scrypt_n=2, total_labels=256), PARAMS)
+    assert ok, "remote proof failed local verification"
+
+
+def test_unknown_identity_is_an_error(supervisor):
+    client = RemotePostClient(supervisor.address, b"\x42" * 32)
+    with pytest.raises(RuntimeError, match="not registered"):
+        client.info()
+
+
+def test_supervisor_restarts_killed_worker(supervisor):
+    assert supervisor.alive()
+    before = supervisor.restarts
+    supervisor._proc.kill()
+    client = RemotePostClient(supervisor.address, NODE_ID, timeout=10)
+
+    import time
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        try:
+            if client.ping() == [NODE_ID]:
+                break
+        except (OSError, RuntimeError):
+            time.sleep(0.3)
+    else:
+        raise AssertionError("worker did not come back after kill")
+    assert supervisor.restarts > before
