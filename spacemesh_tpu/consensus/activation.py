@@ -189,6 +189,19 @@ class Builder:
         self.handler = handler
         self.num_units = num_units
 
+    async def register_challenge(self, publish_epoch: int) -> None:
+        """Phase 0: register the NIPoST challenge at the poet BEFORE the
+        round starts (reference nipost.go:349 submitPoetChallenges). Split
+        from finish() so a multi-identity node registers every signer
+        before any of them executes/awaits the round."""
+        node_id = self.signer.node_id
+        prev = atxstore.latest_by_node(self.db, node_id)
+        prev_id = prev.id if prev is not None else EMPTY32
+        challenge = nipost_challenge(prev_id, publish_epoch)
+        round_id = str(publish_epoch)
+        self._pending = (publish_epoch, prev, prev_id, challenge, round_id)
+        await self.poet.register(round_id, challenge)
+
     async def build_and_publish(self, publish_epoch: int,
                                 execute_round: bool = False) -> ActivationTx:
         """One NIPoST cycle for ``publish_epoch``.
@@ -196,14 +209,19 @@ class Builder:
         Standalone mode sets execute_round=True: this node drives the poet
         round itself (reference launchStandalone runs an in-proc poet).
         """
-        node_id = self.signer.node_id
-        prev = atxstore.latest_by_node(self.db, node_id)
-        prev_id = prev.id if prev is not None else EMPTY32
-        challenge = nipost_challenge(prev_id, publish_epoch)
-        round_id = str(publish_epoch)
+        await self.register_challenge(publish_epoch)
+        return await self.finish(publish_epoch, execute_round)
 
-        # phase 0: register at the poet before the round starts
-        await self.poet.register(round_id, challenge)
+    async def finish(self, publish_epoch: int,
+                     execute_round: bool = False) -> ActivationTx:
+        """Phases 1-2: await the poet round, prove POST over its statement,
+        assemble + sign + publish the ATX."""
+        pending = getattr(self, "_pending", None)
+        if pending is None or pending[0] != publish_epoch:
+            raise RuntimeError("register_challenge was not called")
+        _, prev, prev_id, challenge, round_id = pending
+        node_id = self.signer.node_id
+
         # phase 1: poet round runs (await its result)
         if execute_round:
             result = await self.poet.execute_round(round_id)

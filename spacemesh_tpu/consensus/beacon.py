@@ -35,7 +35,6 @@ import asyncio
 import dataclasses
 import struct
 import time
-from typing import Optional
 
 from ..core import codec
 from ..core.codec import fixed, u8, u32, vec
@@ -335,14 +334,22 @@ class ProtocolDriver:
         return total
 
     async def run_epoch(self, epoch: int, signer, vrf_signer,
-                        atx_id: bytes | None) -> bytes:
+                        atx_id: bytes | None,
+                        participants: list | None = None) -> bytes:
         """Run the full protocol for ``epoch``. Observers (atx_id=None)
-        tally without voting and still converge on the majority value."""
+        tally without voting and still converge on the majority value.
+        Multi-identity nodes pass ``participants`` as a list of
+        (signer, vrf_signer, atx_id) — every identity proposes and votes
+        with its own weight (reference beacon iterates registered
+        signers)."""
         if epoch <= 1:
             return self._bootstrap(epoch)
         stored = miscstore.get_beacon(self.db, epoch)
         if stored is not None:
             return stored
+        if participants is None:
+            participants = ([(signer, vrf_signer, atx_id)]
+                            if atx_id is not None else [])
         st = self._state(epoch)
         start = self.wall()
         if st.started is None:
@@ -350,11 +357,12 @@ class ProtocolDriver:
         total_w = self._total_weight(epoch)
 
         # --- phase 1: proposals ---
-        if atx_id is not None:
-            proof = vrf_signer.prove(proposal_alpha(epoch))
+        for p_signer, p_vrf, p_atx in participants:
+            proof = p_vrf.prove(proposal_alpha(epoch))
             if self._proposal_eligible(epoch, proof):
-                msg = BeaconProposal(epoch=epoch, atx_id=atx_id,
-                                     node_id=signer.node_id, vrf_proof=proof)
+                msg = BeaconProposal(epoch=epoch, atx_id=p_atx,
+                                     node_id=p_signer.node_id,
+                                     vrf_proof=proof)
                 await self.pubsub.publish(TOPIC_BEACON_PROPOSAL,
                                           msg.to_bytes())
         await self._sleep_until(start + self.proposal_duration)
@@ -363,12 +371,12 @@ class ProtocolDriver:
         late = sorted(p for p, g in st.proposals.values() if g == 0)
 
         # --- phase 2: first voting round ---
-        if atx_id is not None:
+        for p_signer, _p_vrf, p_atx in participants:
             fv = FirstVotes(epoch=epoch, valid=valid, late=late,
-                            atx_id=atx_id, node_id=signer.node_id,
+                            atx_id=p_atx, node_id=p_signer.node_id,
                             signature=bytes(64))
-            fv.signature = signer.sign(Domain.BEACON_FIRST_MSG,
-                                       fv.signed_bytes())
+            fv.signature = p_signer.sign(Domain.BEACON_FIRST_MSG,
+                                         fv.signed_bytes())
             await self.pubsub.publish(TOPIC_BEACON_FIRST, fv.to_bytes())
         first_deadline = start + self.proposal_duration + self.first_duration
         await self._sleep_until(
@@ -393,19 +401,20 @@ class ProtocolDriver:
         own: set[bytes] = {p for p in candidates if margins.get(p, 0) > 0}
         for rnd in range(1, self.rounds + 1):
             round_start = first_deadline + (rnd - 1) * self.round_duration
-            if atx_id is not None:
+            for p_signer, p_vrf, p_atx in participants:
                 # weak coin VRF for this round
-                wc = WeakCoinMsg(epoch=epoch, round=rnd, atx_id=atx_id,
-                                 node_id=signer.node_id,
-                                 vrf_proof=vrf_signer.prove(
+                wc = WeakCoinMsg(epoch=epoch, round=rnd, atx_id=p_atx,
+                                 node_id=p_signer.node_id,
+                                 vrf_proof=p_vrf.prove(
                                      weak_coin_alpha(epoch, rnd)))
                 await self.pubsub.publish(TOPIC_BEACON_WEAK_COIN,
                                           wc.to_bytes())
                 fw = FollowVotes(epoch=epoch, round=rnd,
-                                 votes_for=sorted(own), atx_id=atx_id,
-                                 node_id=signer.node_id, signature=bytes(64))
-                fw.signature = signer.sign(Domain.BEACON_FOLLOWUP_MSG,
-                                           fw.signed_bytes())
+                                 votes_for=sorted(own), atx_id=p_atx,
+                                 node_id=p_signer.node_id,
+                                 signature=bytes(64))
+                fw.signature = p_signer.sign(Domain.BEACON_FOLLOWUP_MSG,
+                                             fw.signed_bytes())
                 await self.pubsub.publish(TOPIC_BEACON_FOLLOW, fw.to_bytes())
             votes = st.follow_votes.setdefault(rnd, {})
             await self._sleep_until(

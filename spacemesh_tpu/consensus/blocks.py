@@ -94,22 +94,26 @@ class Certifier:
     CERT_ROUND = 250  # distinct VRF round tag for certifier eligibility
 
     async def certify_if_eligible(self, layer: int, block_id: bytes,
-                                  atx_id: bytes | None) -> None:
+                                  atx_id: bytes | None,
+                                  signer: EdSigner | None = None) -> None:
+        """Sign a certificate share if this (identity, layer) holds
+        committee seats; multi-identity nodes call once per signer."""
+        signer = signer or self.signer
         if atx_id is None:
             return
         epoch = layer // self.layers_per_epoch
         beacon = await self.beacon_getter(epoch)
         el = self.oracle.hare_eligibility(
-            self.signer.vrf_signer(), beacon, layer, self.CERT_ROUND, epoch,
+            signer.vrf_signer(), beacon, layer, self.CERT_ROUND, epoch,
             atx_id, self.committee)
         if el is None:
             return
         proof, count = el
         msg = CertifyMessage(layer=layer, block_id=block_id,
                              eligibility_count=count, proof=proof,
-                             atx_id=atx_id, node_id=self.signer.node_id,
+                             atx_id=atx_id, node_id=signer.node_id,
                              signature=bytes(64))
-        msg.signature = self.signer.sign(Domain.CERTIFY, msg.signed_bytes())
+        msg.signature = signer.sign(Domain.CERTIFY, msg.signed_bytes())
         await self.pubsub.publish(TOPIC_CERTIFY, msg.to_bytes())
 
     async def _gossip(self, peer: bytes, data: bytes) -> bool:

@@ -131,19 +131,24 @@ class HareSession:
 
 
 class Hare:
-    def __init__(self, *, signer: EdSigner, verifier: EdVerifier,
+    def __init__(self, *, signer: EdSigner | None = None,
+                 signers: list[EdSigner] | None = None,
+                 verifier: EdVerifier,
                  oracle: Oracle, pubsub: PubSub, committee_size: int,
                  round_duration: float, iteration_limit: int,
                  layers_per_epoch: int,
                  beacon_of: Callable[[int], Awaitable[bytes]],
-                 atx_for: Callable[[int], Optional[bytes]],
+                 atx_for: Callable[[int, bytes], Optional[bytes]],
                  proposals_for: Callable[[int], list[bytes]],
                  on_output: Callable[[ConsensusOutput], Awaitable[None]],
                  on_equivocation=None, preround_delay: float = 0.0,
                  wall=None):
+        """Multi-identity: every signer in ``signers`` participates with
+        its own eligibility (reference hare iterates registered signers);
+        atx_for(epoch, node_id) resolves each signer's ATX."""
         import time as _time
 
-        self.signer = signer
+        self.signers = signers if signers is not None else [signer]
         self.verifier = verifier
         self.oracle = oracle
         self.pubsub = pubsub
@@ -224,14 +229,18 @@ class Hare:
 
         epoch = layer // self.layers_per_epoch
         beacon = await self.beacon_of(epoch)
-        atx = self.atx_for(epoch)
+        # every local signer with an ATX participates with its own seats
+        participants = [
+            (s, s.vrf_signer(), atx)
+            for s in self.signers
+            if s is not None
+            and (atx := self.atx_for(epoch, s.node_id)) is not None]
         session = HareSession(self, layer, [])
         self.sessions[layer] = session
         for msg in self._pending.pop(layer, ()):  # replay early arrivals
             session.on_message(msg)
         for stale in [x for x in self._pending if x < layer]:
             del self._pending[stale]
-        vrf = self.signer.vrf_signer()
 
         # preround_delay gives proposals time to build + propagate
         # (reference PreroundDelay); the proposal snapshot happens at the
@@ -241,21 +250,20 @@ class Hare:
         session.my_proposals = sorted(self.proposals_for(layer))
 
         async def maybe_send(iteration: int, round_: int, values: list[bytes]):
-            if atx is None:
-                return
             round_tag = iteration * 4 + round_
-            el = self.oracle.hare_eligibility(
-                vrf, beacon, layer, round_tag, epoch, atx, self.committee)
-            if el is None:
-                return
-            proof, count = el
-            msg = HareMessage(
-                layer=layer, iteration=iteration, round=round_,
-                values=sorted(values), eligibility_proof=proof,
-                eligibility_count=count, atx_id=atx,
-                node_id=self.signer.node_id, signature=bytes(64))
-            msg.signature = self.signer.sign(Domain.HARE, msg.signed_bytes())
-            await self.pubsub.publish(TOPIC_HARE, msg.to_bytes())
+            for signer, vrf, atx in participants:
+                el = self.oracle.hare_eligibility(
+                    vrf, beacon, layer, round_tag, epoch, atx, self.committee)
+                if el is None:
+                    continue
+                proof, count = el
+                msg = HareMessage(
+                    layer=layer, iteration=iteration, round=round_,
+                    values=sorted(values), eligibility_proof=proof,
+                    eligibility_count=count, atx_id=atx,
+                    node_id=signer.node_id, signature=bytes(64))
+                msg.signature = signer.sign(Domain.HARE, msg.signed_bytes())
+                await self.pubsub.publish(TOPIC_HARE, msg.to_bytes())
 
         # > half the committee seats. Seat counts are weight-derived (the
         # committee's total seats sum to ~committee_size network-wide), so

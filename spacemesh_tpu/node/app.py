@@ -49,7 +49,9 @@ class App:
         self.data = Path(cfg.data_dir)
         self.data.mkdir(parents=True, exist_ok=True)
         prefix = cfg.genesis.genesis_id
-        self.signer = signer or self._load_or_create_identity(prefix)
+        self.signers = self._load_or_create_identities(
+            prefix, cfg.smeshing.num_identities, primary=signer)
+        self.signer = self.signers[0]
         self.verifier = EdVerifier(prefix=prefix)
         self.events = events_mod.EventBus()
         self.clock = clock_mod.LayerClock(cfg.genesis.time, cfg.layer_duration,
@@ -64,19 +66,32 @@ class App:
         self.stopped = asyncio.Event()
         self._recover_state()
 
-    def _load_or_create_identity(self, prefix: bytes) -> EdSigner:
-        """Persisted node identity (reference node/node_identities.go:
-        ed25519 keys live in the data dir and survive restarts)."""
+    def _load_or_create_identities(self, prefix: bytes, n: int,
+                                   primary: EdSigner | None = None
+                                   ) -> list[EdSigner]:
+        """Persisted node identities (reference node/node_identities.go:
+        ed25519 keys live in the data dir and survive restarts; one node
+        may host many smeshers). local.key is the primary; extras are
+        local_01.key, local_02.key, ..."""
         key_dir = self.data / "identities"
         key_dir.mkdir(parents=True, exist_ok=True)
-        key_file = key_dir / "local.key"
-        if key_file.exists():
-            return EdSigner(seed=bytes.fromhex(key_file.read_text().strip()),
-                            prefix=prefix)
-        signer = EdSigner(prefix=prefix)
-        key_file.write_text(signer.private_bytes().hex())
-        key_file.chmod(0o600)
-        return signer
+        signers: list[EdSigner] = []
+        for i in range(max(n, 1)):
+            if i == 0 and primary is not None:
+                signers.append(primary)
+                continue
+            name = "local.key" if i == 0 else f"local_{i:02d}.key"
+            key_file = key_dir / name
+            if key_file.exists():
+                signers.append(EdSigner(
+                    seed=bytes.fromhex(key_file.read_text().strip()),
+                    prefix=prefix))
+            else:
+                s = EdSigner(prefix=prefix)
+                key_file.write_text(s.private_bytes().hex())
+                key_file.chmod(0o600)
+                signers.append(s)
+        return signers
 
     def _wire(self) -> None:
         cfg = self.cfg
@@ -123,11 +138,12 @@ class App:
             threshold=cfg.hare.committee_size // 2 + 1,
             layers_per_epoch=cfg.layers_per_epoch,
             beacon_getter=self.beacon.get)
-        self.miner = miner_mod.ProposalBuilder(
-            signer=self.signer, db=self.state, cache=self.cache,
+        self.miners = [miner_mod.ProposalBuilder(
+            signer=s, db=self.state, cache=self.cache,
             oracle=self.oracle, tortoise=self.tortoise, cstate=self.cstate,
             pubsub=self.pubsub, layers_per_epoch=cfg.layers_per_epoch,
-            beacon_getter=self.beacon.get)
+            beacon_getter=self.beacon.get) for s in self.signers]
+        self.miner = self.miners[0]
         self.malfeasance = malfeasance_mod.Handler(
             db=self.state, cache=self.cache, verifier=self.verifier,
             pubsub=self.pubsub, tortoise=self.tortoise,
@@ -151,19 +167,20 @@ class App:
             beacon_getter=self.beacon.get,
             on_malfeasance=on_double_ballot)
         self.hare = hare_mod.Hare(
-            signer=self.signer, verifier=self.verifier, oracle=self.oracle,
+            signers=self.signers, verifier=self.verifier, oracle=self.oracle,
             pubsub=self.pubsub, committee_size=cfg.hare.committee_size,
             round_duration=cfg.hare.round_duration,
             iteration_limit=cfg.hare.iteration_limit,
             preround_delay=cfg.hare.preround_delay,
             layers_per_epoch=cfg.layers_per_epoch,
-            beacon_of=self.beacon.get, atx_for=self.miner.own_atx,
+            beacon_of=self.beacon.get, atx_for=self._atx_of,
             proposals_for=self.proposal_store.ids_in_layer,
             on_output=self._on_hare_output)
         self.poet = poet_mod.PoetService(
             poet_id=sum256(b"poet", cfg.genesis.genesis_id), ticks=64)
         self.post_service = PostService()
-        self.atx_builder: activation.Builder | None = None
+        self.atx_builders: list[activation.Builder] = []
+        self.post_supervisor = None
         from ..p2p.pubsub import TOPIC_POET, TOPIC_TX
 
         self.pubsub.register(TOPIC_TX, self._on_tx)
@@ -226,7 +243,8 @@ class App:
         """Point every service that captured the tortoise at the recovered
         instance (recovery replaces the object built in _wire)."""
         self.mesh.tortoise = self.tortoise
-        self.miner.tortoise = self.tortoise
+        for m in self.miners:
+            m.tortoise = self.tortoise
         self.proposal_handler.tortoise = self.tortoise
         self.malfeasance.tortoise = self.tortoise
 
@@ -413,6 +431,13 @@ class App:
         activation.store_poet_blob(self.state, blob)
         return True
 
+    def _atx_of(self, epoch: int, node_id: bytes):
+        """The ATX a local identity holds for ``epoch`` (cache lookup)."""
+        for atx_id, info in self.cache.iter_epoch(epoch):
+            if info.node_id == node_id:
+                return atx_id
+        return None
+
     def _on_atx(self, atx) -> None:
         self.events.emit(events_mod.AtxEvent(
             atx_id=atx.id, node_id=atx.node_id, epoch=atx.publish_epoch))
@@ -433,52 +458,91 @@ class App:
                                                 status="hare_done"))
         if block is not None:
             epoch = out.layer // self.cfg.layers_per_epoch
-            await self.certifier.certify_if_eligible(
-                out.layer, block.id, self.miner.own_atx(epoch))
+            for s in self.signers:
+                await self.certifier.certify_if_eligible(
+                    out.layer, block.id, self._atx_of(epoch, s.node_id),
+                    signer=s)
 
     # --- smeshing ------------------------------------------------------
 
     async def start_smeshing(self) -> None:
+        """POST-init every identity and build one ATX Builder per signer
+        (reference activation.Builder.Register, activation.go:218;
+        BASELINE config 5: N smeshers in one node). With
+        smeshing.external_worker, proofs come from the out-of-process
+        worker via PostSupervisor + RemotePostClient."""
         cfg = self.cfg
-        post_dir = self.data / "post" / self.signer.node_id.hex()[:16]
-        commitment = activation.commitment_of(self.signer.node_id,
-                                              self.golden_atx)
-        self.events.emit(events_mod.PostEvent(node_id=self.signer.node_id,
-                                              kind="init_start"))
-        await asyncio.to_thread(
-            post_init.initialize, post_dir,
-            node_id=self.signer.node_id, commitment=commitment,
-            num_units=cfg.smeshing.num_units,
-            labels_per_unit=cfg.post.labels_per_unit,
-            scrypt_n=cfg.post.scrypt_n,
-            batch_size=cfg.smeshing.init_batch)
-        self.events.emit(events_mod.PostEvent(node_id=self.signer.node_id,
-                                              kind="init_complete"))
-        client = PostClient(post_dir, self.post_params)
-        self.post_service.register(self.signer.node_id, client)
-        coinbase = (Address.decode(cfg.smeshing.coinbase).raw
-                    if cfg.smeshing.coinbase
-                    else vm_sdk.wallet_address(self.signer.public_key).raw)
-        self.atx_builder = activation.Builder(
-            signer=self.signer, db=self.state, pubsub=self.pubsub,
-            poet=self.poet, post_client=client, golden_atx=self.golden_atx,
-            coinbase=coinbase, handler=self.atx_handler,
-            num_units=cfg.smeshing.num_units)
+        post_base = self.data / "post"
+        for s in self.signers:
+            post_dir = post_base / s.node_id.hex()[:16]
+            commitment = activation.commitment_of(s.node_id, self.golden_atx)
+            self.events.emit(events_mod.PostEvent(node_id=s.node_id,
+                                                  kind="init_start"))
+            await asyncio.to_thread(
+                post_init.initialize, post_dir,
+                node_id=s.node_id, commitment=commitment,
+                num_units=cfg.smeshing.num_units,
+                labels_per_unit=cfg.post.labels_per_unit,
+                scrypt_n=cfg.post.scrypt_n,
+                batch_size=cfg.smeshing.init_batch)
+            self.events.emit(events_mod.PostEvent(node_id=s.node_id,
+                                                  kind="init_complete"))
+        clients = {}
+        if cfg.smeshing.external_worker:
+            from ..post.supervisor import PostSupervisor
+            from ..post.remote import RemotePostClient
+
+            self.post_supervisor = PostSupervisor(
+                post_base, params=self.post_params)
+            addr = await asyncio.to_thread(self.post_supervisor.start)
+            for s in self.signers:
+                clients[s.node_id] = RemotePostClient(addr, s.node_id)
+        else:
+            for s in self.signers:
+                clients[s.node_id] = PostClient(
+                    post_base / s.node_id.hex()[:16], self.post_params)
+        self.atx_builders = []
+        for s in self.signers:
+            client = clients[s.node_id]
+            self.post_service.register(s.node_id, client)
+            coinbase = (Address.decode(cfg.smeshing.coinbase).raw
+                        if cfg.smeshing.coinbase
+                        else vm_sdk.wallet_address(s.public_key).raw)
+            self.atx_builders.append(activation.Builder(
+                signer=s, db=self.state, pubsub=self.pubsub,
+                poet=self.poet, post_client=client,
+                golden_atx=self.golden_atx, coinbase=coinbase,
+                handler=self.atx_handler,
+                num_units=cfg.smeshing.num_units))
+
+    @property
+    def atx_builder(self):
+        return self.atx_builders[0] if self.atx_builders else None
 
     async def publish_atx(self, publish_epoch: int) -> None:
-        if self.atx_builder is None:
+        if not self.atx_builders:
             return
         from ..storage import atxs as atxstore
 
         # restart safety: publishing a SECOND (different) ATX for an epoch
         # already covered would be self-equivocation -> malfeasance
-        if atxstore.by_node_in_epoch(self.state, self.signer.node_id,
-                                     publish_epoch) is not None:
+        builders = [b for b in self.atx_builders
+                    if atxstore.by_node_in_epoch(
+                        self.state, b.signer.node_id, publish_epoch) is None]
+        if not builders:
             return
-        atx = await self.atx_builder.build_and_publish(
-            publish_epoch, execute_round=self.cfg.standalone)
-        self.events.emit(events_mod.AtxPublished(
-            atx_id=atx.id, node_id=atx.node_id, epoch=publish_epoch))
+        # phase 0 for EVERY identity before the round runs, then one
+        # builder drives the in-proc poet round (standalone) while the
+        # rest await its result
+        for b in builders:
+            await b.register_challenge(publish_epoch)
+        results = await asyncio.gather(
+            builders[0].finish(publish_epoch,
+                               execute_round=self.cfg.standalone),
+            *(b.finish(publish_epoch) for b in builders[1:]))
+        for atx in results:
+            self.events.emit(events_mod.AtxPublished(
+                atx_id=atx.id, node_id=atx.node_id, epoch=publish_epoch))
 
     # --- lifecycle -----------------------------------------------------
 
@@ -519,7 +583,7 @@ class App:
             # preround snapshot waits preround_delay, which covers the
             # build (VRF slot proofs) + gossip propagation
             await asyncio.gather(
-                self.miner.build(layer),
+                *(m.build(layer) for m in self.miners),
                 self.hare.run_layer(layer, self.clock.time_of(layer)))
             self.mesh.process_layer(layer)
             self.events.emit(events_mod.LayerUpdate(layer=layer,
@@ -529,12 +593,19 @@ class App:
         self.stopped.set()
 
     async def _epoch_start(self, epoch: int) -> None:
-        vrf = self.signer.vrf_signer()
-        atx = self.miner.own_atx(epoch)
-        await self.beacon.run_epoch(epoch, self.signer, vrf, atx)
+        participants = [
+            (s, s.vrf_signer(), atx) for s in self.signers
+            if (atx := self._atx_of(epoch, s.node_id)) is not None]
+        await self.beacon.run_epoch(epoch, self.signer,
+                                    self.signer.vrf_signer(),
+                                    participants[0][2] if participants
+                                    else None,
+                                    participants=participants)
         if self.cfg.smeshing.start:
             await self.publish_atx(epoch)  # targets epoch+1
 
     def close(self) -> None:
+        if self.post_supervisor is not None:
+            self.post_supervisor.stop()
         self.state.close()
         self.local.close()

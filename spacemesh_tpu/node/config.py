@@ -53,6 +53,10 @@ class SmeshingConfig:
     data_dir: str = "post-data"
     num_units: int = 4
     init_batch: int = 1 << 13
+    num_identities: int = 1      # signers per node (reference
+                                 # node_identities.go multi-smesher)
+    external_worker: bool = False  # prove via the out-of-proc POST worker
+                                   # (PostSupervisor + RemotePostClient)
 
 
 @dataclasses.dataclass

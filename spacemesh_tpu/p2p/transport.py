@@ -338,11 +338,18 @@ class Host:
             while not conn.closed.is_set():
                 ftype, payload = await _read_frame(conn.reader)
                 if ftype == MSG_GOSSIP:
-                    conn.gossip_queue.put_nowait(payload)
+                    # bounded like the send side: a gossip flood faster
+                    # than local validation drains must not grow memory —
+                    # drop the frame (gossip is redundant across peers)
+                    # and penalize the flooder
+                    if conn.gossip_queue.qsize() >= SEND_QUEUE_CAP:
+                        self._penalize(conn)
+                    else:
+                        conn.gossip_queue.put_nowait(payload)
                 elif ftype == MSG_REQ:
                     asyncio.ensure_future(self._handle_req(conn, payload))
                 elif ftype == MSG_RESP:
-                    self._handle_resp(payload)
+                    self._handle_resp(conn, payload)
                 elif ftype == MSG_PEERS:
                     self._handle_peers(payload)
         except (OSError, ConnectionError, asyncio.IncompleteReadError,
@@ -427,11 +434,15 @@ class Host:
         except (OSError, ConnectionError):
             self._drop(conn)
 
-    def _handle_resp(self, payload: bytes) -> None:
+    def _handle_resp(self, conn: _Conn, payload: bytes) -> None:
         (req_id,) = struct.unpack_from("<Q", payload)
         status = payload[8]
         data = payload[9:]
-        fut = self._pending.pop(req_id, None)
+        # keyed by (peer, req_id): a response only resolves a request that
+        # was sent to THAT peer — req_ids are sequential and guessable, so
+        # a malicious peer must not be able to answer someone else's
+        # request with forged data
+        fut = self._pending.pop((conn.node_id, req_id), None)
         if fut is None or fut.done():
             return
         if status == 0:
@@ -474,11 +485,16 @@ class Host:
         self._req_id += 1
         req_id = self._req_id
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
-        self._pending[req_id] = fut
+        self._pending[(dst, req_id)] = fut
         pb = protocol.encode()
         try:
             await conn.send(MSG_REQ, struct.pack("<QB", req_id, len(pb))
                             + pb + data)
-            return await fut
+            # bounded even when called without Server.request's wait_for:
+            # a peer that accepts the request but never answers must not
+            # hang the caller
+            return await asyncio.wait_for(fut, self.request_timeout)
+        except asyncio.TimeoutError:
+            raise RequestError(f"request to {dst.hex()[:8]} timed out")
         finally:
-            self._pending.pop(req_id, None)
+            self._pending.pop((dst, req_id), None)

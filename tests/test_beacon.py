@@ -29,9 +29,11 @@ def _driver(hub, cache, signer, **kw):
     drv = beacon_mod.ProtocolDriver(
         db=db, oracle=Oracle(cache, LPE), pubsub=ps, genesis_id=GEN,
         verifier=EdVerifier(prefix=GEN),
-        proposal_duration=kw.pop("proposal_duration", 0.25),
-        first_voting_round_duration=0.25, voting_round_duration=0.2,
-        rounds_number=2, grace_period=0.1, theta=0.25, **kw)
+        # deadlines are generous for loaded CI machines; the early-complete
+        # rule (all active weight voted) keeps the happy path fast anyway
+        proposal_duration=kw.pop("proposal_duration", 0.4),
+        first_voting_round_duration=0.8, voting_round_duration=0.8,
+        rounds_number=2, grace_period=0.3, theta=0.25, **kw)
     return drv, db, ps
 
 
@@ -95,7 +97,7 @@ def test_adversarial_proposer_and_late_node_still_converge():
                 await asyncio.sleep(0.05)
 
         async def late_runner():
-            await asyncio.sleep(0.3)  # proposal phase is over
+            await asyncio.sleep(0.5)  # proposal phase is over
             d, db, _ = late
             return await d.run_epoch(EPOCH, signers[3],
                                      signers[3].vrf_signer(),
