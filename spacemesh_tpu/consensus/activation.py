@@ -226,7 +226,9 @@ class Builder:
         if execute_round:
             result = await self.poet.execute_round(round_id)
         else:
-            while (result := self.poet.result(round_id)) is None:
+            # result() may do blocking I/O (remote poet) — poll off-loop
+            while (result := await asyncio.to_thread(
+                    self.poet.result, round_id)) is None:
                 await asyncio.sleep(0.05)
         membership = result.membership(challenge)
         if membership is None:

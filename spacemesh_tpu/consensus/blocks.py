@@ -116,6 +116,36 @@ class Certifier:
         msg.signature = signer.sign(Domain.CERTIFY, msg.signed_bytes())
         await self.pubsub.publish(TOPIC_CERTIFY, msg.to_bytes())
 
+    async def validate_certificate(self, layer: int,
+                                   cert: Certificate) -> bool:
+        """Verify a full certificate fetched from a peer (sync adoption,
+        reference blocks/handler.go + certifier threshold check): every
+        share signed, eligibility-validated, distinct, and the summed
+        seat count reaching the threshold. A synced certificate is NEVER
+        trusted on a peer's word."""
+        epoch = layer // self.layers_per_epoch
+        beacon = await self.beacon_getter(epoch)
+        total = 0
+        seen: set[bytes] = set()
+        for msg in cert.signatures:
+            if msg.layer != layer or msg.block_id != cert.block_id:
+                return False
+            if msg.node_id in seen:
+                return False
+            seen.add(msg.node_id)
+            if not self.verifier.verify(Domain.CERTIFY, msg.node_id,
+                                        msg.signed_bytes(), msg.signature):
+                return False
+            info = self.oracle.cache.get(epoch, msg.atx_id)
+            if info is None or info.node_id != msg.node_id:
+                return False
+            if not self.oracle.validate_hare(
+                    beacon, msg.layer, self.CERT_ROUND, epoch, msg.atx_id,
+                    self.committee, msg.proof, msg.eligibility_count):
+                return False
+            total += msg.eligibility_count
+        return total >= self.threshold
+
     async def _gossip(self, peer: bytes, data: bytes) -> bool:
         try:
             msg = CertifyMessage.from_bytes(data)

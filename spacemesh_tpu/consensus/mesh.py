@@ -73,13 +73,25 @@ class Executor:
         _, root = self.vm.apply(block.layer, block.id, txs,
                                 list(block.rewards))
         layerstore.set_applied(self.db, block.layer, block.id, root)
+        self._aggregate(block.layer, block.id)
         self.cstate.on_applied()
         return root
 
     def execute_empty(self, layer: int) -> bytes:
         prev = layerstore.state_hash(self.db, layer - 1) or bytes(32)
         layerstore.set_applied(self.db, layer, EMPTY, prev)
+        self._aggregate(layer, EMPTY)
         return prev
+
+    def _aggregate(self, layer: int, block_id: bytes) -> None:
+        """Chained per-layer mesh hash (reference aggregated layer hash):
+        agg(L) = H(agg(L-1) || applied block id). Peers comparing these
+        detect forks and bisect to the divergence point (fork finder)."""
+        from ..core.hashing import sum256
+
+        prev = layerstore.aggregated_hash(self.db, layer - 1) or bytes(32)
+        layerstore.set_aggregated_hash(self.db, layer,
+                                       sum256(prev, block_id))
 
     def revert(self, to_layer: int) -> None:
         self.vm.revert(to_layer)

@@ -70,6 +70,7 @@ def main(argv=None) -> int:
                 net_started = True
                 print(json.dumps({"event": "P2PStarted", "host": addr[0],
                                   "port": addr[1]}), flush=True)
+            app.start_ops()
             await app.prepare()
             if a.genesis_now:
                 # rebase the CLOCK only, after the slow prepare (POST init,

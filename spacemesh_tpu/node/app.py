@@ -176,8 +176,19 @@ class App:
             beacon_of=self.beacon.get, atx_for=self._atx_of,
             proposals_for=self.proposal_store.ids_in_layer,
             on_output=self._on_hare_output)
-        self.poet = poet_mod.PoetService(
-            poet_id=sum256(b"poet", cfg.genesis.genesis_id), ticks=64)
+        if cfg.poet_servers:
+            # external poet daemons (reference activation/poet.go client;
+            # multi-poet best-by-ticks, nipost.go getBestProof)
+            from ..consensus.poet_remote import MultiPoet, RemotePoetClient
+
+            clients = []
+            for spec in cfg.poet_servers:
+                host, _, port = spec.rpartition(":")
+                clients.append(RemotePoetClient((host, int(port))))
+            self.poet = clients[0] if len(clients) == 1 else MultiPoet(clients)
+        else:
+            self.poet = poet_mod.PoetService(
+                poet_id=sum256(b"poet", cfg.genesis.genesis_id), ticks=64)
         self.post_service = PostService()
         self.atx_builders: list[activation.Builder] = []
         self.post_supervisor = None
@@ -280,6 +291,26 @@ class App:
         self.fetch.set_reader(fetch_mod.HINT_BALLOT, _r(ballotstore.get))
         self.fetch.set_reader(fetch_mod.HINT_BLOCK, _r(blockstore.get))
 
+        from ..storage import transactions as txstore_mod
+
+        def read_tx(h: bytes):
+            tx = txstore_mod.get_tx(self.state, h)
+            return tx.raw if tx is not None else None
+
+        self.fetch.set_reader(fetch_mod.HINT_TX, read_tx)
+
+        def read_malfeasance(node_id: bytes):
+            proof = miscstore.malfeasance_proof(self.state, node_id)
+            return proof.to_bytes() if proof is not None else None
+
+        self.fetch.set_reader(fetch_mod.HINT_MALFEASANCE, read_malfeasance)
+
+        def read_active_set(set_id: bytes):
+            ids = miscstore.active_set(self.state, set_id)
+            return b"".join(ids) if ids is not None else None
+
+        self.fetch.set_reader(fetch_mod.HINT_ACTIVESET, read_active_set)
+
         def read_poet(ref: bytes):
             proof = miscstore.poet_proof(self.state, ref)
             if proof is None:
@@ -320,7 +351,57 @@ class App:
                 return False
             if block.id != h:
                 return False
+            # data availability: the executor needs the block's txs at
+            # apply time — backfill missing ones now (round-1 gap: the
+            # TX hint existed but nothing ever fetched it)
+            missing = [t for t in block.tx_ids
+                       if not txstore_mod.has_tx(self.state, t)]
+            if missing:
+                got = await self.fetch.get_hashes(fetch_mod.HINT_TX, missing)
+                if not all(got.values()):
+                    # applying a block without its txs would silently
+                    # compute a divergent state root — refuse and retry
+                    # the block (and its txs) on a later pass
+                    return False
             self.mesh.add_block(block)
+            return True
+
+        async def v_tx(h: bytes, blob: bytes) -> bool:
+            from ..core.types import Transaction
+
+            tx = Transaction(raw=blob)
+            if tx.id != h:
+                return False
+            if self.vm.parse(tx) is None:
+                return False
+            # store for block application; historical txs may no longer be
+            # mempool-admissible (nonce consumed), so storage is enough
+            txstore_mod.add_tx(self.state, tx)
+            self.cstate.add(tx)
+            return True
+
+        async def v_malfeasance(node_id: bytes, blob: bytes) -> bool:
+            from ..core.types import MalfeasanceProof
+
+            try:
+                proof = MalfeasanceProof.from_bytes(blob)
+            except Exception:  # noqa: BLE001
+                return False
+            if proof.node_id != node_id:
+                return False
+            return self.malfeasance.process(proof)
+
+        async def v_active_set(set_id: bytes, blob: bytes) -> bool:
+            if len(blob) % 32:
+                return False
+            ids = [blob[i:i + 32] for i in range(0, len(blob), 32)]
+            from ..consensus.miner import active_set_root
+
+            if active_set_root(ids) != set_id:  # content-addressed
+                return False
+            # epoch unknown at fetch time: -1 keeps the row out of the
+            # pruner's epoch-horizon deletes (it prunes epoch>=0 only)
+            miscstore.add_active_set(self.state, set_id, -1, ids)
             return True
 
         async def v_poet(h: bytes, blob: bytes) -> bool:
@@ -337,6 +418,9 @@ class App:
         self.fetch.set_validator(fetch_mod.HINT_BALLOT, v_ballot)
         self.fetch.set_validator(fetch_mod.HINT_BLOCK, v_block)
         self.fetch.set_validator(fetch_mod.HINT_POET, v_poet)
+        self.fetch.set_validator(fetch_mod.HINT_TX, v_tx)
+        self.fetch.set_validator(fetch_mod.HINT_MALFEASANCE, v_malfeasance)
+        self.fetch.set_validator(fetch_mod.HINT_ACTIVESET, v_active_set)
 
         # index endpoints
         async def serve_epoch(peer: bytes, data: bytes) -> bytes:
@@ -365,20 +449,125 @@ class App:
             stored = miscstore.get_beacon(self.state, epoch)
             return stored or b""  # never serve a fabricated fallback
 
+        async def serve_certificate(peer: bytes, data: bytes) -> bytes:
+            layer = _struct.unpack("<I", data)[0]
+            cert = miscstore.certificate(self.state, layer)
+            return cert.to_bytes() if cert is not None else b""
+
+        async def serve_malicious_ids(peer: bytes, data: bytes) -> bytes:
+            return b"".join(miscstore.all_malicious(self.state))
+
+        async def serve_layer_hash(peer: bytes, data: bytes) -> bytes:
+            layer = _struct.unpack("<I", data)[0]
+            return layerstore.aggregated_hash(self.state, layer) or b""
+
         self.server.register(fetch_mod.P_EPOCH, serve_epoch)
         self.server.register(fetch_mod.P_LAYER, serve_layer)
         self.server.register("pt/1", serve_poet_refs)
         self.server.register("bk/1", serve_beacon)
+        self.server.register("ct/1", serve_certificate)
+        self.server.register("ml/1", serve_malicious_ids)
+        self.server.register("lh/1", serve_layer_hash)
+
+        async def adopt_certificate(layer: int, block_id: bytes) -> bool:
+            """Fetch + VERIFY the full certificate before trusting a
+            peer-reported hare output (a majority of layer-data answers
+            plus a threshold of validated certifier signatures)."""
+            from ..core.types import Certificate
+            from ..p2p.server import RequestError as _RE
+
+            if miscstore.certified_block(self.state, layer) == block_id:
+                return True
+            for peer in self.fetch.peers()[:3]:
+                try:
+                    blob = await self.server.request(
+                        peer, "ct/1", _struct.pack("<I", layer))
+                except (_RE, asyncio.TimeoutError):
+                    self.fetch.report_failure(peer)
+                    continue
+                if not blob:
+                    continue
+                try:
+                    cert = Certificate.from_bytes(blob)
+                except Exception:  # noqa: BLE001
+                    self.fetch.report_failure(peer, 3)
+                    continue
+                if cert.block_id != block_id:
+                    continue
+                if await self.certifier.validate_certificate(layer, cert):
+                    with self.state.tx():
+                        miscstore.add_certificate(self.state, layer, cert)
+                    return True
+                self.fetch.report_failure(peer, 3)
+            return False
 
         async def process_synced_layer(layer: int, data) -> None:
             from ..storage import blocks as bs
 
-            if data is not None and data.certified != bytes(32):
-                block = bs.get(self.state, data.certified)
-                if block is not None:
-                    self.mesh.process_hare_output(block, layer)
-                    return
+            # candidates vote-ordered; certificate VALIDATION picks the
+            # real one when peers disagree (a forged cert cannot verify)
+            candidates = []
+            if data is not None:
+                candidates = list(getattr(data, "cert_candidates", []))
+                if data.certified != bytes(32) and \
+                        data.certified not in candidates:
+                    candidates.insert(0, data.certified)
+            for cand in candidates:
+                if await adopt_certificate(layer, cand):
+                    block = bs.get(self.state, cand)
+                    if block is not None:
+                        self.mesh.process_hare_output(block, layer)
+                        return
             self.mesh.process_hare_output(None, layer)
+
+        async def derive_beacon(epoch: int, ballot_ids: list[bytes]) -> None:
+            """Beacon from ballots (reference: ballots carry the beacon in
+            EpochData and the network's weight majority defines it): fetch
+            raw ballot blobs WITHOUT ingestion, verify signatures and ATX
+            binding, and adopt the ATX-weight-majority beacon. A lying
+            peer cannot forge this — it has no weighty identities."""
+            from ..core.signing import Domain as _Domain
+            from ..core.types import Ballot as _Ballot
+
+            if epoch <= 1 or miscstore.get_beacon(self.state, epoch) \
+                    is not None:
+                return
+            votes: dict[bytes, int] = {}
+            seen_nodes: set[bytes] = set()
+            req = fetch_mod.HashRequest(
+                hint=fetch_mod.HINT_BALLOT,
+                hashes=list(dict.fromkeys(ballot_ids))[:256])
+            for peer in self.fetch.peers()[:3]:
+                try:
+                    resp = fetch_mod.HashResponse.from_bytes(
+                        await self.server.request(peer, fetch_mod.P_HASH,
+                                                  req.to_bytes()))
+                except Exception:  # noqa: BLE001
+                    continue
+                for blob in resp.blobs:
+                    if not blob:
+                        continue
+                    try:
+                        b = _Ballot.from_bytes(blob)
+                    except Exception:  # noqa: BLE001
+                        continue
+                    if (b.epoch_data is None
+                            or b.layer // self.cfg.layers_per_epoch != epoch
+                            or b.node_id in seen_nodes):
+                        continue
+                    if not self.verifier.verify(_Domain.BALLOT, b.node_id,
+                                                b.signed_bytes(),
+                                                b.signature):
+                        continue
+                    info = self.cache.get(epoch, b.atx_id)
+                    if info is None or info.node_id != b.node_id:
+                        continue
+                    seen_nodes.add(b.node_id)
+                    beacon = b.epoch_data.beacon
+                    votes[beacon] = votes.get(beacon, 0) + info.weight
+            if votes:
+                best = max(votes.items(), key=lambda kv: kv[1])[0]
+                self.beacon.on_fallback(epoch, best)
 
         def resume_point() -> int:
             # a crash can leave processed ahead of applied; resync from the
@@ -391,7 +580,9 @@ class App:
             processed_layer=resume_point,
             process_layer=process_synced_layer,
             layers_per_epoch=self.cfg.layers_per_epoch,
-            store_beacon=self.beacon.on_fallback)
+            store_beacon=self.beacon.on_fallback,
+            layer_hash=lambda lyr: layerstore.aggregated_hash(self.state, lyr),
+            on_fork=self._on_fork, derive_beacon=derive_beacon)
 
     async def start_network(self) -> tuple[str, int]:
         """Open the real TCP transport (p2p/transport.Host) on
@@ -418,6 +609,13 @@ class App:
                 self.syncer.stop()
             await self.host.stop()
             self.host = None
+
+    def _on_fork(self, divergent_layer: int) -> None:
+        """Fork finder hit (reference syncer/find_fork.go): the network's
+        aggregated mesh hash diverges from ours at ``divergent_layer`` —
+        roll the applied state back so the next sync pass refetches and
+        reprocesses from the divergence point."""
+        self.executor.revert(max(divergent_layer - 1, 0))
 
     # --- handlers ------------------------------------------------------
 
@@ -552,6 +750,32 @@ class App:
         if self.cfg.smeshing.start and self.atx_builder is None:
             await self.start_smeshing()
             await self.publish_atx(0)
+
+    def start_ops(self) -> None:
+        """Bootstrap updater + pruner background loops (reference
+        bootstrap/updater.go, prune/prune.go), driven by config."""
+        from . import bootstrap as bootstrap_mod
+        from ..storage import misc as miscstore
+        from ..consensus.miner import active_set_root
+
+        if self.cfg.bootstrap_source:
+            def on_activeset(epoch: int, ids: list[bytes]) -> None:
+                miscstore.add_active_set(self.state, active_set_root(ids),
+                                         epoch, ids)
+
+            self.bootstrap = bootstrap_mod.BootstrapUpdater(
+                self.cfg.bootstrap_source,
+                on_beacon=self.beacon.on_fallback,
+                on_activeset=on_activeset,
+                cache_dir=self.data / "bootstrap")
+            self._tasks.append(asyncio.ensure_future(self.bootstrap.run()))
+        if self.cfg.prune_retention_layers > 0:
+            self.pruner = bootstrap_mod.Pruner(
+                self.state,
+                retention_layers=self.cfg.prune_retention_layers,
+                current_layer=lambda: int(self.clock.current_layer()),
+                layers_per_epoch=self.cfg.layers_per_epoch)
+            self._tasks.append(asyncio.ensure_future(self.pruner.run()))
 
     async def start_api(self) -> int:
         """Start the JSON API (reference startAPIServices, node.go:1603)."""

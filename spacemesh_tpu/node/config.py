@@ -126,6 +126,9 @@ class Config:
     poet_servers: list[str] = dataclasses.field(default_factory=list)
     poet_cycle_gap: float = 43200.0        # 12 h
     standalone: bool = False
+    bootstrap_source: str = ""             # file path or URL of epoch
+                                           # fallback docs (bootstrap/)
+    prune_retention_layers: int = 0        # 0 = pruning disabled
 
     def epoch_of(self, layer: int) -> int:
         return layer // self.layers_per_epoch

@@ -65,12 +65,39 @@ Validator = Callable[[bytes, bytes], Awaitable[bool]]  # (id, blob) -> ok
 
 
 class Fetch:
-    def __init__(self, server: Server, batch_size: int = 128):
+    def __init__(self, server: Server, batch_size: int = 128,
+                 bad_peer_threshold: int = 10):
         self.server = server
         self.batch = batch_size
+        self.bad_peer_threshold = bad_peer_threshold
         self._readers: dict[int, Reader] = {}
         self._validators: dict[int, Validator] = {}
+        # peer scoring (reference fetch/peers/peers.go): failures — bad
+        # blobs, short answers, timeouts — push a peer down the selection
+        # order and eventually out of it; successes slowly rehabilitate
+        self._peer_score: dict[bytes, int] = {}
         server.register(P_HASH, self._serve_hashes)
+
+    # --- peer selection ---------------------------------------------
+
+    def report_failure(self, peer: bytes, weight: int = 1) -> None:
+        self._peer_score[peer] = self._peer_score.get(peer, 0) + weight
+
+    def report_success(self, peer: bytes) -> None:
+        s = self._peer_score.get(peer, 0)
+        if s > 0:
+            self._peer_score[peer] = s - 1
+
+    def peers(self) -> list[bytes]:
+        """Connected peers, best score first, chronically bad ones dropped
+        from selection entirely."""
+        ranked = sorted(self.server.peers(),
+                        key=lambda p: self._peer_score.get(p, 0))
+        good = [p for p in ranked
+                if self._peer_score.get(p, 0) < self.bad_peer_threshold]
+        # if everyone looks bad, fall back to the least-bad peers rather
+        # than stalling sync forever
+        return good or ranked[:2]
 
     # --- wiring -----------------------------------------------------
 
@@ -106,7 +133,7 @@ class Fetch:
                 result[i] = True  # already stored locally
             else:
                 missing.append(i)
-        peers = self.server.peers()
+        peers = self.peers()
         if not peers:
             return result
         validator = self._validators.get(hint)
@@ -121,11 +148,13 @@ class Fetch:
                         peer, P_HASH,
                         HashRequest(hint=hint, hashes=chunk).to_bytes()))
                 except (RequestError, asyncio.TimeoutError, codec.DecodeError):
+                    self.report_failure(peer)
                     still.extend(chunk)
                     continue
                 if len(resp.blobs) != len(chunk):
                     # short answer: nothing in it is trustworthy-complete;
                     # retry the whole chunk elsewhere
+                    self.report_failure(peer)
                     still.extend(chunk)
                     continue
                 for h, blob in zip(chunk, resp.blobs):
@@ -134,7 +163,12 @@ class Fetch:
                         continue
                     ok = await validator(h, blob) if validator else True
                     result[h] = bool(ok)
-                    if not ok:
+                    if ok:
+                        self.report_success(peer)
+                    else:
+                        # an invalid blob for a requested id is strong
+                        # evidence of a bad peer (content-hash-addressed)
+                        self.report_failure(peer, weight=3)
                         still.append(h)
             missing = still
         return result
@@ -143,11 +177,12 @@ class Fetch:
         """Union of peers' ATX id lists for the epoch, fetched + validated."""
         ids: list[bytes] = []
         seen: set[bytes] = set()
-        for peer in self.server.peers():
+        for peer in self.peers():
             try:
                 resp = await self.server.request(
                     peer, P_EPOCH, struct.pack("<I", epoch))
             except (RequestError, asyncio.TimeoutError):
+                self.report_failure(peer)
                 continue
             for k in range(0, len(resp), 32):
                 i = resp[k:k + 32]
@@ -157,12 +192,48 @@ class Fetch:
         await self.get_hashes(HINT_ATX, ids)
         return ids
 
-    async def get_layer_data(self, layer: int) -> LayerData | None:
-        for peer in self.server.peers():
+    async def get_layer_data(self, layer: int,
+                             max_peers: int = 5) -> LayerData | None:
+        """Cross-peer layer opinion (reference syncer/data_fetch.go polls
+        several peers): UNION of ballot/block ids — one lying peer cannot
+        hide data the rest of the network has (fabricated ids fail the
+        content-hash validators and cost the liar its score) — and the
+        MAJORITY certified block id (a single peer cannot steer a late
+        joiner onto a fake hare output)."""
+        ballots: list[bytes] = []
+        blocks: list[bytes] = []
+        cert_votes: dict[bytes, int] = {}
+        answered = 0
+        for peer in self.peers()[:max_peers]:
             try:
                 resp = await self.server.request(
                     peer, P_LAYER, struct.pack("<I", layer))
-                return LayerData.from_bytes(resp)
+                data = LayerData.from_bytes(resp)
             except (RequestError, asyncio.TimeoutError, codec.DecodeError):
+                self.report_failure(peer)
                 continue
-        return None
+            answered += 1
+            for b in data.ballots:
+                if b not in ballots:
+                    ballots.append(b)
+            for b in data.blocks:
+                if b not in blocks:
+                    blocks.append(b)
+            if data.certified != bytes(32):
+                cert_votes[data.certified] = \
+                    cert_votes.get(data.certified, 0) + 1
+        if answered == 0:
+            return None
+        # majority certified id if one exists; ALL reported candidates ride
+        # along (vote-ordered) so the caller can let certificate
+        # VALIDATION arbitrate ties — with one honest and one lying peer
+        # the vote is 1-1, but only the honest certificate verifies
+        candidates = [c for c, _ in sorted(cert_votes.items(),
+                                           key=lambda kv: -kv[1])]
+        certified = bytes(32)
+        if candidates and (cert_votes[candidates[0]] * 2 > answered
+                           or answered == 1):
+            certified = candidates[0]
+        data = LayerData(ballots=ballots, blocks=blocks, certified=certified)
+        data.cert_candidates = candidates  # non-wire, local-only attribute
+        return data

@@ -39,13 +39,22 @@ class Syncer:
                  process_layer: Callable[[int, "LayerData | None"],
                                          Awaitable[None]],
                  layers_per_epoch: int,
-                 store_beacon: Callable[[int, bytes], None] | None = None):
+                 store_beacon: Callable[[int, bytes], None] | None = None,
+                 layer_hash: Callable[[int], bytes | None] | None = None,
+                 on_fork: Callable[[int], None] | None = None,
+                 derive_beacon=None):
         self.store_beacon = store_beacon
+        # derive_beacon(epoch, ballot_ids): adopt the epoch beacon from
+        # synced ballots' signed EpochData (weight-majority) when peer
+        # answers alone can't settle it
+        self.derive_beacon = derive_beacon
         self.fetch = fetch
         self.current_layer = current_layer
         self.processed_layer = processed_layer
         self.process_layer = process_layer
         self.layers_per_epoch = layers_per_epoch
+        self.layer_hash = layer_hash      # local aggregated mesh hash
+        self.on_fork = on_fork
         self.state = SyncState.NOT_SYNCED
         self._stop = False
 
@@ -63,6 +72,9 @@ class Syncer:
             if refs:
                 await self.fetch.get_hashes(HINT_POET, refs)
             await self.fetch.get_epoch_atxs(epoch)
+        # 1b) malfeasance proofs (reference syncer/malsync): a node must
+        # learn who is malicious before counting their weight
+        await self._sync_malfeasance()
         # 2) per-layer data up to the tip
         start = self.processed_layer() + 1
         for layer in range(start, tip + 1):
@@ -74,9 +86,19 @@ class Syncer:
             # settling on "empty" (the reference's layerpatrol keeps
             # hare-owned layers away from the syncer, layerpatrol/patrol.go)
             recent = layer > tip - 2
-            if recent and (data is None or data.certified == bytes(32)):
+            has_cert = data is not None and (
+                data.certified != bytes(32)
+                or getattr(data, "cert_candidates", []))
+            if recent and not has_cert:
                 break
             if data is not None:
+                # beacon first: ballot eligibility and certificate shares
+                # verify against the epoch beacon — when peer answers
+                # couldn't settle it (tie from a lying peer), derive it
+                # from the ballots' own signed, ATX-weighted EpochData
+                if self.derive_beacon is not None and data.ballots:
+                    await self.derive_beacon(
+                        layer // self.layers_per_epoch, data.ballots)
                 # blocks BEFORE ballots: tortoise.on_ballot must be able to
                 # resolve every support vote against a known block, else the
                 # votes count as AGAINST and a fresh node invalidates layers
@@ -91,7 +113,81 @@ class Syncer:
             self.state = SyncState.GOSSIP
         else:
             self.state = SyncState.NOT_SYNCED
+        # 3) fork detection once caught up: our aggregated mesh hash at
+        # the frontier must match the network's
+        if self.state == SyncState.SYNCED and await self._check_fork():
+            self.state = SyncState.NOT_SYNCED
+            return False
         return self.state == SyncState.SYNCED
+
+    async def _sync_malfeasance(self) -> None:
+        from .fetch import HINT_MALFEASANCE
+        from .server import RequestError
+
+        ids: set[bytes] = set()
+        for peer in self.fetch.peers()[:3]:
+            try:
+                resp = await self.fetch.server.request(peer, "ml/1", b"")
+            except (RequestError, asyncio.TimeoutError):
+                continue
+            for k in range(0, len(resp), 32):
+                nid = resp[k:k + 32]
+                if len(nid) == 32:  # ragged tail from a bad peer
+                    ids.add(nid)
+        if ids:
+            await self.fetch.get_hashes(HINT_MALFEASANCE, sorted(ids))
+
+    async def _check_fork(self) -> bool:
+        """Compare aggregated layer hashes with a peer at the frontier;
+        on mismatch bisect to the FIRST divergent layer and hand it to
+        on_fork (reference syncer/find_fork.go). Returns True if a fork
+        was found and a rollback was requested."""
+        import struct
+
+        from .server import RequestError
+
+        if self.layer_hash is None or self.on_fork is None:
+            return False
+        frontier = self.processed_layer() - 1
+        if frontier < 1:
+            return False
+        local = self.layer_hash(frontier)
+        if local is None:
+            return False
+
+        async def peer_hash(peer, layer) -> bytes | None:
+            try:
+                resp = await self.fetch.server.request(
+                    peer, "lh/1", struct.pack("<I", layer))
+            except (RequestError, asyncio.TimeoutError):
+                return None
+            return resp if len(resp) == 32 else None
+
+        for peer in self.fetch.peers()[:2]:
+            remote = await peer_hash(peer, frontier)
+            if remote is None or remote == local:
+                continue
+            # bisect [1, frontier] for the first layer where we diverge;
+            # a peer that stops answering mid-bisect yields NO divergence
+            # point — never roll back on a guess
+            lo, hi = 1, frontier
+            aborted = False
+            while lo < hi:
+                mid = (lo + hi) // 2
+                rm = await peer_hash(peer, mid)
+                lm = self.layer_hash(mid)
+                if rm is None or lm is None:
+                    aborted = True
+                    break
+                if rm == lm:
+                    lo = mid + 1
+                else:
+                    hi = mid
+            if aborted:
+                continue
+            self.on_fork(lo)
+            return True
+        return False
 
     async def _sync_beacon(self, epoch: int) -> None:
         """Adopt peers' beacon for the epoch (late joiners never ran the
@@ -114,7 +210,7 @@ class Syncer:
                 return None
 
         responses = await asyncio.gather(
-            *(ask(p) for p in self.fetch.server.peers()))
+            *(ask(p) for p in self.fetch.peers()))
         votes: dict[bytes, int] = {}
         answered = 0
         for resp in responses:
@@ -134,7 +230,7 @@ class Syncer:
         from .server import RequestError
 
         refs: list[bytes] = []
-        for peer in self.fetch.server.peers():
+        for peer in self.fetch.peers():
             try:
                 resp = await self.fetch.server.request(
                     peer, "pt/1", struct.pack("<I", epoch))

@@ -1,0 +1,70 @@
+"""Operational shell: bootstrap updater + prune loop.
+
+Reference: bootstrap/updater.go (epoch fallback beacon/activeset from an
+operator-provided source) and prune/prune.go (retention cleanup).
+"""
+
+import json
+
+from spacemesh_tpu.core.types import Certificate
+from spacemesh_tpu.node.bootstrap import BootstrapUpdater, Pruner
+from spacemesh_tpu.storage import db as dbmod
+from spacemesh_tpu.storage import misc as miscstore
+
+
+def test_bootstrap_applies_beacon_and_activeset(tmp_path):
+    src = tmp_path / "fallback.json"
+    src.write_text(json.dumps([
+        {"epoch": 5, "beacon": "aabbccdd", "activeset": ["11" * 32]},
+        {"epoch": 6, "beacon": "deadbeef"},
+    ]))
+    beacons, sets_ = [], []
+    upd = BootstrapUpdater(
+        str(src),
+        on_beacon=lambda e, b: beacons.append((e, b)),
+        on_activeset=lambda e, ids: sets_.append((e, ids)),
+        cache_dir=tmp_path / "cache")
+    assert upd.poll_once() == 2
+    assert beacons == [(5, bytes.fromhex("aabbccdd")),
+                       (6, bytes.fromhex("deadbeef"))]
+    assert sets_ == [(5, [b"\x11" * 32])]
+    # idempotent: same docs are not re-applied
+    assert upd.poll_once() == 0
+    assert (tmp_path / "cache" / "epoch-5.json").exists()
+
+
+def test_bootstrap_rejects_malformed(tmp_path):
+    src = tmp_path / "bad.json"
+    src.write_text(json.dumps([
+        {"epoch": 7, "beacon": "toolongbeacon00"},
+        {"no_epoch": True},
+        {"epoch": 8, "activeset": ["ff"]},
+    ]))
+    applied = []
+    upd = BootstrapUpdater(str(src),
+                           on_beacon=lambda e, b: applied.append(e))
+    assert upd.poll_once() == 0
+    assert applied == []
+
+
+def test_prune_removes_stale_rows():
+    db = dbmod.open_state(":memory:")
+    for layer in (1, 2, 50):
+        miscstore.add_certificate(
+            db, layer, Certificate(block_id=bytes(32), signatures=[]))
+    miscstore.add_active_set(db, b"s" * 32, 0, [b"a" * 32])
+    miscstore.add_active_set(db, b"t" * 32, 9, [b"a" * 32])
+    db.exec("INSERT INTO poet_proofs (ref, poet_id, round_id, ticks, data)"
+            " VALUES (?,?,?,?,?)", (b"r" * 32, b"p" * 32, "0", 1, b"x"))
+    db.exec("INSERT INTO poet_proofs (ref, poet_id, round_id, ticks, data)"
+            " VALUES (?,?,?,?,?)", (b"q" * 32, b"p" * 32, "9", 1, b"x"))
+
+    pruner = Pruner(db, retention_layers=10, current_layer=lambda: 40,
+                    layers_per_epoch=3, interval=0.1)
+    out = pruner.prune_once()
+    assert out["certificates"] == 2          # layers 1, 2 < horizon 30
+    assert miscstore.certificate(db, 50) is not None
+    assert out["active_sets"] == 1           # epoch 0 < horizon epoch 9
+    assert miscstore.active_set(db, b"t" * 32) is not None
+    assert out["poet_proofs"] == 1           # round 0 pruned, round 9 kept
+    db.close()

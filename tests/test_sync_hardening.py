@@ -1,0 +1,230 @@
+"""Sync/fetch trust hardening: lying peers, scoring, certs, fork finder.
+
+Round-2 VERDICT item 8: a late joiner must converge despite a lying peer
+(reference cross-checks opinions across peers, syncer/data_fetch.go; peer
+scoring fetch/peers/peers.go; fork finder syncer/find_fork.go; cert
+verification on adoption; malfeasance sync syncer/malsync).
+"""
+
+import asyncio
+import struct
+import time
+
+import pytest
+
+from spacemesh_tpu.core.hashing import sum256
+from spacemesh_tpu.core.signing import EdSigner
+from spacemesh_tpu.node import clock as clock_mod
+from spacemesh_tpu.node.app import App
+from spacemesh_tpu.node.config import load
+from spacemesh_tpu.p2p import fetch as fetch_mod
+from spacemesh_tpu.p2p.pubsub import LoopbackHub, PubSub
+from spacemesh_tpu.p2p.server import LoopbackNet, Server
+from spacemesh_tpu.p2p.sync import Syncer
+from spacemesh_tpu.storage import blocks as blockstore
+from spacemesh_tpu.storage import layers as layerstore
+from spacemesh_tpu.storage import misc as miscstore
+
+LPE = 3
+LAYER_SEC = 0.8
+
+GENESIS_PLACEHOLDER = float(int(time.time()) + 3600)
+
+
+def _config(tmp_path, name, smesh):
+    return load("standalone", overrides={
+        "data_dir": str(tmp_path / name),
+        "layer_duration": LAYER_SEC,
+        "layers_per_epoch": LPE,
+        "slots_per_layer": 2,
+        "genesis": {"time": GENESIS_PLACEHOLDER},
+        "post": {"labels_per_unit": 256, "scrypt_n": 2, "k1": 64, "k2": 8,
+                 "k3": 4, "min_num_units": 1,
+                 "pow_difficulty": "20" + "ff" * 31},
+        "smeshing": {"start": smesh, "num_units": 1, "init_batch": 128},
+        "hare": {"committee_size": 20, "round_duration": 0.1,
+                 "preround_delay": 0.35, "iteration_limit": 2},
+        "beacon": {"proposal_duration": 0.1},
+        "tortoise": {"hdist": 4, "window_size": 50},
+    })
+
+
+class LiarServer(Server):
+    """A peer that serves garbage layer data, a forged certificate, and a
+    fake beacon — everything a malicious peer could use to poison a late
+    joiner."""
+
+    def __init__(self):
+        super().__init__(b"liar" + bytes(28))
+        self.fake_block = sum256(b"fake block id")
+
+        async def lie_layer(peer, data):
+            return fetch_mod.LayerData(
+                ballots=[], blocks=[self.fake_block],
+                certified=self.fake_block).to_bytes()
+
+        async def lie_cert(peer, data):
+            from spacemesh_tpu.core.types import Certificate
+
+            return Certificate(block_id=self.fake_block,
+                               signatures=[]).to_bytes()
+
+        async def lie_beacon(peer, data):
+            return b"\xba\xad\xf0\x0d"
+
+        async def empty(peer, data):
+            return b""
+
+        self.register(fetch_mod.P_LAYER, lie_layer)
+        self.register("ct/1", lie_cert)
+        self.register("bk/1", lie_beacon)
+        self.register(fetch_mod.P_EPOCH, empty)
+        self.register("pt/1", empty)
+        self.register("ml/1", empty)
+        self.register("lh/1", empty)
+        self.register(fetch_mod.P_HASH, self._lie_hashes)
+
+    async def _lie_hashes(self, peer, data):
+        req = fetch_mod.HashRequest.from_bytes(data)
+        # serve garbage bytes for every requested id
+        return fetch_mod.HashResponse(
+            blobs=[b"garbage" for _ in req.hashes]).to_bytes()
+
+
+@pytest.fixture(scope="module")
+def network(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("synchard")
+    hub = LoopbackHub()
+    net = LoopbackNet()
+    liar = LiarServer()
+    net.join(liar)
+
+    def make(name, smesh):
+        cfg = _config(tmp, name, smesh)
+        signer = EdSigner(prefix=cfg.genesis.genesis_id)
+        ps = PubSub(node_name=signer.node_id)
+        hub.join(ps)
+        app = App(cfg, signer=signer, pubsub=ps)
+        app.connect_network(net)
+        return app
+
+    a = make("a", smesh=True)
+    holder = {}
+
+    async def go():
+        await a.prepare()
+        genesis = time.time() + 0.3
+        a.clock = clock_mod.LayerClock(genesis, LAYER_SEC)
+        until = 2 * LPE + 1
+        task_a = asyncio.create_task(a.run(until_layer=until))
+        await asyncio.sleep(LAYER_SEC * (LPE + 1))
+        # C joins late; the liar is among its peers
+        c = make("c", smesh=False)
+        c.clock = clock_mod.LayerClock(genesis, LAYER_SEC)
+        holder["c"] = c
+        await c.syncer.synchronize()
+        await task_a
+        await c.syncer.synchronize()
+
+    asyncio.run(asyncio.wait_for(go(), timeout=180))
+    return a, holder["c"], liar
+
+
+def test_late_joiner_converges_despite_lying_peer(network):
+    a, c, liar = network
+    applied_a = layerstore.last_applied(a.state)
+    applied_c = layerstore.last_applied(c.state)
+    assert applied_c >= applied_a - 1
+    for lyr in range(LPE, applied_c + 1):
+        assert blockstore.ids_in_layer(a.state, lyr) == \
+            blockstore.ids_in_layer(c.state, lyr), f"layer {lyr} diverged"
+    # the liar's fabricated block must not exist anywhere in C
+    assert blockstore.get(c.state, liar.fake_block) is None
+
+
+def test_forged_certificate_rejected(network):
+    a, c, liar = network
+    # no layer in C is certified by the liar's fake block
+    for lyr in range(1, layerstore.last_applied(c.state) + 1):
+        assert miscstore.certified_block(c.state, lyr) != liar.fake_block
+
+
+def test_lying_peer_scored_down(network):
+    a, c, liar = network
+    # the liar served garbage blobs; its score must be above any honest
+    # peer's and (with this much lying) past the drop threshold
+    score = c.fetch._peer_score.get(liar.node_id, 0)
+    assert score >= c.fetch.bad_peer_threshold, score
+    assert liar.node_id not in c.fetch.peers()
+
+
+def test_beacon_not_poisoned_by_single_liar(network):
+    a, c, liar = network
+    for epoch in (0, 1, 2):
+        assert miscstore.get_beacon(c.state, epoch) != b"\xba\xad\xf0\x0d"
+
+
+def test_malfeasance_syncs(network):
+    """Mark an identity malicious on A; C learns it on the next pass."""
+    a, c, liar = network
+    from spacemesh_tpu.consensus import malfeasance as mal_mod
+    from spacemesh_tpu.consensus.hare import HareMessage
+    from spacemesh_tpu.core.signing import Domain
+
+    evil = EdSigner(prefix=a.cfg.genesis.genesis_id)
+
+    def hare_msg(values):
+        m = HareMessage(layer=2, iteration=0, round=0, values=values,
+                        eligibility_proof=bytes(80), eligibility_count=1,
+                        atx_id=bytes(32), node_id=evil.node_id,
+                        signature=bytes(64))
+        m.signature = evil.sign(Domain.HARE, m.signed_bytes())
+        return m
+
+    m1, m2 = hare_msg([sum256(b"p1")]), hare_msg([sum256(b"p2")])
+    proof = mal_mod.MalfeasanceProof(
+        domain=int(Domain.HARE), msg1=m1.signed_bytes(), sig1=m1.signature,
+        msg2=m2.signed_bytes(), sig2=m2.signature, node_id=evil.node_id)
+    assert a.malfeasance.process(proof)
+
+    async def go():
+        await c.syncer.synchronize()
+
+    asyncio.run(go())
+    assert miscstore.is_malicious(c.state, evil.node_id)
+
+
+def test_fork_finder_bisects_divergence():
+    """Unit: a peer whose aggregated hashes diverge from layer 5 on makes
+    the syncer call on_fork(5)."""
+    net = LoopbackNet()
+    me = Server(b"m" * 32)
+    peer = Server(b"p" * 32)
+    net.join(me)
+    net.join(peer)
+
+    local = {lyr: sum256(b"shared", bytes([lyr])) for lyr in range(1, 11)}
+    remote = dict(local)
+    for lyr in range(5, 11):
+        remote[lyr] = sum256(b"forked", bytes([lyr]))
+
+    async def serve_hash(_, data):
+        lyr = struct.unpack("<I", data)[0]
+        return remote.get(lyr, b"")
+
+    peer.register("lh/1", serve_hash)
+    forks = []
+
+    fetch = fetch_mod.Fetch(me)
+    syncer = Syncer(
+        fetch=fetch, current_layer=lambda: 10,
+        processed_layer=lambda: 10,
+        process_layer=None, layers_per_epoch=LPE,
+        layer_hash=lambda lyr: local.get(lyr),
+        on_fork=forks.append)
+
+    async def go():
+        assert await syncer._check_fork()
+
+    asyncio.run(go())
+    assert forks == [5]
