@@ -74,6 +74,16 @@ def nipost_challenge(prev_atx: bytes, epoch: int) -> bytes:
     return sum256(prev_atx, struct.pack("<I", epoch))
 
 
+def poet_leaf_count(db: Database, poet: PoetProof) -> int:
+    """Member count recorded beside the proof (store_poet_blob); unknown
+    counts are bounded above — membership still binds."""
+    row = db.one("SELECT data FROM active_sets WHERE id=?",
+                 (b"poetcnt!" + poet.id[:24],))
+    if row is None:
+        return 1 << 20
+    return int.from_bytes(row["data"], "little")
+
+
 def post_challenge(poet_root: bytes, challenge: bytes) -> bytes:
     return sum256(poet_root, challenge)
 
@@ -148,12 +158,7 @@ class Handler:
         return True
 
     def _leaf_count(self, poet: PoetProof) -> int:
-        # leaf count travels beside the proof in storage
-        row = self.db.one("SELECT data FROM active_sets WHERE id=?",
-                          (b"poetcnt!" + poet.id[:24],))
-        if row is None:
-            return 1 << 20  # unknown: bounded above, membership still binds
-        return int.from_bytes(row["data"], "little")
+        return poet_leaf_count(self.db, poet)
 
     def store(self, atx: ActivationTx, ticks: int) -> None:
         prev_height = 0

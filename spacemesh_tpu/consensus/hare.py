@@ -45,12 +45,17 @@ class HareMessage:
     eligibility_count: int
     atx_id: bytes
     node_id: bytes
+    # NOTIFY only: the commit certificate — encoded COMMIT messages whose
+    # summed seats reach the threshold (reference hare carries commit
+    # certificates so nodes that missed the commits still accept)
+    cert_msgs: list[bytes]
     signature: bytes
 
     FIELDS = [("layer", u32), ("iteration", u8), ("round", u8),
               ("values", vec(fixed(32), 1 << 12)),
               ("eligibility_proof", fixed(80)), ("eligibility_count", u16),
               ("atx_id", fixed(32)), ("node_id", fixed(32)),
+              ("cert_msgs", vec(codec.var_bytes, 1 << 11)),
               ("signature", fixed(64))]
 
     def signed_bytes(self) -> bytes:
@@ -83,9 +88,39 @@ class HareSession:
         # iteration -> (vrf_output, values) of best PROPOSE; lowest VRF wins
         self._best_propose: dict[int, tuple[bytes, list[bytes]]] = {}
         self.commits: dict[bytes, tuple[int, tuple]] = {}
+        # (iteration, values) -> node_id -> (raw COMMIT, its own seat
+        # count) — kept to assemble the NOTIFY commit certificate; the
+        # count MUST come from the stored message, not the node's latest
+        # commit (per-round VRF counts differ and receivers sum the raws)
+        self.commit_raw: dict[tuple, dict[bytes, tuple[bytes, int]]] = {}
         self.notifies: dict[bytes, tuple[int, tuple]] = {}
         self.output: Optional[list[bytes]] = None
         self.seen: dict[tuple, tuple[bytes, bytes]] = {}  # equivocation watch
+        self.excluded: set[bytes] = set()  # equivocators: zero weight
+        self.layer_start: float | None = None  # set when the driver runs
+
+    # --- timing (grade windows) ------------------------------------
+
+    def _slot_of(self, iteration: int, round_: int) -> int:
+        base = {PREROUND: 0, PROPOSE: 1, COMMIT: 2, NOTIFY: 3}[round_]
+        return 0 if round_ == PREROUND else base + 3 * iteration
+
+    def too_late(self, msg: HareMessage) -> bool:
+        """Acceptance window (the gradecast equivalent): COMMIT/NOTIFY
+        messages count only within a few slots of their own round — a
+        message that surfaces much later must not flip decisions. The
+        window is deliberately wider than one slot: weights are read at
+        fixed instants anyway (late arrivals cannot rewrite a past read,
+        and late NOTIFYs are commit-certificate-backed so counting them
+        in the grace pass is safe), while validation latency must not
+        disqualify honest messages. PREROUND/PROPOSE stay open (their
+        reads are one-shot, and late prerounds only help liveness)."""
+        if self.layer_start is None or msg.round in (PREROUND, PROPOSE):
+            return False
+        slot = self._slot_of(msg.iteration, msg.round)
+        deadline = (self.layer_start + self.h.preround_delay
+                    + (slot + 4) * self.h.round_duration)
+        return self.h.wall() > deadline
 
     # --- message handling ------------------------------------------
 
@@ -94,9 +129,13 @@ class HareSession:
         prev = self.seen.get(key)
         raw = msg.signed_bytes()
         if prev is not None and prev[0] != raw:
+            # equivocator: report AND exclude its weight from every round
+            self.excluded.add(msg.node_id)
             self.h._report_equivocation(msg, prev)
             return
         self.seen[key] = (raw, msg.signature)
+        if msg.node_id in self.excluded or self.too_late(msg):
+            return
         w = msg.eligibility_count
         if msg.round == PREROUND:
             self.preround_sets[msg.node_id] = (w, msg.values)
@@ -112,6 +151,9 @@ class HareSession:
                 self._best_propose[msg.iteration] = (out, sorted(msg.values))
         elif msg.round == COMMIT:
             self.commits[msg.node_id] = (w, tuple(msg.values))
+            self.commit_raw.setdefault(
+                (msg.iteration, tuple(msg.values)), {})[msg.node_id] = \
+                (msg.to_bytes(), w)
         elif msg.round == NOTIFY:
             self.notifies[msg.node_id] = (w, tuple(msg.values))
 
@@ -119,15 +161,33 @@ class HareSession:
 
     def candidates(self) -> list[bytes]:
         union: set[bytes] = set(self.my_proposals)
-        for _, values in self.preround_sets.values():
-            union.update(values)
+        for node_id, (_, values) in self.preround_sets.items():
+            if node_id not in self.excluded:
+                union.update(values)
         return sorted(union)
 
     def commit_weight(self, values: tuple) -> int:
-        return sum(w for w, v in self.commits.values() if v == values)
+        return sum(w for n, (w, v) in self.commits.items()
+                   if v == values and n not in self.excluded)
 
     def notify_weight(self, values: tuple) -> int:
-        return sum(w for w, v in self.notifies.values() if v == values)
+        return sum(w for n, (w, v) in self.notifies.items()
+                   if v == values and n not in self.excluded)
+
+    def build_certificate(self, iteration: int, values: tuple,
+                          threshold: int) -> list[bytes]:
+        """Enough observed COMMIT messages for ``values`` to prove the
+        threshold was reached (carried in NOTIFY)."""
+        raws = self.commit_raw.get((iteration, values), {})
+        out, total = [], 0
+        for node_id, (raw, w) in raws.items():
+            if node_id in self.excluded:
+                continue
+            out.append(raw)
+            total += w
+            if total >= threshold:
+                return out
+        return out if total >= threshold else []
 
 
 class Hare:
@@ -164,6 +224,10 @@ class Hare:
         self.on_output = on_output
         self.on_equivocation = on_equivocation
         self.sessions: dict[int, HareSession] = {}
+        # COMMIT messages already fully validated via gossip: their raw
+        # bytes skip the crypto re-check inside NOTIFY certificates
+        # (ECVRF verifies are the expensive part of cert validation)
+        self._valid_commits: dict[bytes, None] = {}
         # messages for layers whose session hasn't started here yet — peers'
         # clocks are never perfectly aligned (reference buffers early
         # messages the same way)
@@ -189,6 +253,16 @@ class Hare:
                 self.committee, msg.eligibility_proof,
                 msg.eligibility_count):
             return False
+        if msg.round == COMMIT:
+            self._valid_commits[data] = None
+            if len(self._valid_commits) > (1 << 12):
+                for k in list(self._valid_commits)[:1 << 10]:
+                    del self._valid_commits[k]
+        # NOTIFY must PROVE its commit threshold: a valid commit
+        # certificate travels with it (reference hare certificates) — a
+        # bare keypair cannot fabricate agreement
+        if msg.round == NOTIFY and not await self._validate_cert(msg):
+            return False
         session = self.sessions.get(msg.layer)
         if session is not None:
             session.on_message(msg)
@@ -197,6 +271,41 @@ class Hare:
             if len(buf) < self._pending_cap:
                 buf.append(msg)
         return True
+
+    async def _validate_cert(self, msg: HareMessage) -> bool:
+        """Check the commit certificate inside a NOTIFY: every inner
+        COMMIT decodes, is signed, eligibility-validated for the same
+        (layer, iteration) and values, senders distinct, and the summed
+        seats reach the commit threshold."""
+        threshold = self.committee // 2 + 1
+        epoch = msg.layer // self.layers_per_epoch
+        beacon = await self.beacon_of(epoch)
+        total = 0
+        senders: set[bytes] = set()
+        for raw in msg.cert_msgs:
+            try:
+                cm = HareMessage.from_bytes(raw)
+            except (codec.DecodeError, ValueError):
+                return False
+            if (cm.round != COMMIT or cm.layer != msg.layer
+                    or cm.iteration != msg.iteration
+                    or cm.values != msg.values
+                    or cm.node_id in senders):
+                return False
+            if raw not in self._valid_commits:  # gossip-validated skip
+                if not self.verifier.verify(Domain.HARE, cm.node_id,
+                                            cm.signed_bytes(), cm.signature):
+                    return False
+                tag = cm.iteration * 4 + COMMIT
+                if not self.oracle.validate_hare(
+                        beacon, cm.layer, tag, epoch, cm.atx_id,
+                        self.committee, cm.eligibility_proof,
+                        cm.eligibility_count):
+                    return False
+                self._valid_commits[raw] = None
+            senders.add(cm.node_id)
+            total += cm.eligibility_count
+        return total >= threshold
 
     def _report_equivocation(self, msg: HareMessage, prev) -> None:
         if self.on_equivocation:
@@ -236,6 +345,7 @@ class Hare:
             if s is not None
             and (atx := self.atx_for(epoch, s.node_id)) is not None]
         session = HareSession(self, layer, [])
+        session.layer_start = layer_start
         self.sessions[layer] = session
         for msg in self._pending.pop(layer, ()):  # replay early arrivals
             session.on_message(msg)
@@ -249,7 +359,8 @@ class Hare:
         await until_slot(-1)
         session.my_proposals = sorted(self.proposals_for(layer))
 
-        async def maybe_send(iteration: int, round_: int, values: list[bytes]):
+        async def maybe_send(iteration: int, round_: int, values: list[bytes],
+                             cert: list[bytes] | None = None):
             round_tag = iteration * 4 + round_
             for signer, vrf, atx in participants:
                 el = self.oracle.hare_eligibility(
@@ -261,7 +372,8 @@ class Hare:
                     layer=layer, iteration=iteration, round=round_,
                     values=sorted(values), eligibility_proof=proof,
                     eligibility_count=count, atx_id=atx,
-                    node_id=signer.node_id, signature=bytes(64))
+                    node_id=signer.node_id, cert_msgs=list(cert or []),
+                    signature=bytes(64))
                 msg.signature = signer.sign(Domain.HARE, msg.signed_bytes())
                 await self.pubsub.publish(TOPIC_HARE, msg.to_bytes())
 
@@ -285,13 +397,27 @@ class Hare:
             await until_slot(2 + 3 * it)
             committed = tuple(sorted(proposal))
             have = session.commit_weight(committed)
-            # NOTIFY happens if enough commit weight was observed
+            # NOTIFY happens if enough commit weight was observed — and it
+            # carries the commit certificate PROVING that threshold
             if have >= threshold:
-                await maybe_send(it, NOTIFY, list(committed))
+                cert = session.build_certificate(it, committed, threshold)
+                if cert:
+                    await maybe_send(it, NOTIFY, list(committed), cert=cert)
             await until_slot(3 + 3 * it)
             if session.notify_weight(committed) >= threshold:
                 session.output = list(committed)
                 break
+
+        if session.output is None:
+            # grace pass: NOTIFYs are certificate-backed, so if threshold
+            # notify weight for ANY value set arrives a beat late, it is
+            # still a safe output — better than wrongly concluding empty
+            # while the rest of the network agreed
+            await until_slot(3 + 3 * (self.iteration_limit - 1) + 1)
+            for values in {v for _, v in session.notifies.values()}:
+                if session.notify_weight(values) >= threshold:
+                    session.output = list(values)
+                    break
 
         out = ConsensusOutput(layer=layer,
                               proposals=session.output or [])

@@ -42,6 +42,19 @@ def proof_from_hare(node_id: bytes, msg1: bytes, sig1: bytes, msg2: bytes,
                             msg2=msg2, sig2=sig2, node_id=node_id)
 
 
+# non-signature domain tag: a single ATX whose POST proof carries an index
+# that does not qualify (reference malfeasance/handler.go InvalidPostIndex)
+DOMAIN_INVALID_POST = 100
+
+
+def proof_invalid_post(atx: ActivationTx, index_pos: int) -> MalfeasanceProof:
+    """msg1 = the signed ATX, msg2 = the offending index position."""
+    return MalfeasanceProof(
+        domain=DOMAIN_INVALID_POST, msg1=atx.signed_bytes(),
+        sig1=atx.signature, msg2=index_pos.to_bytes(4, "little"),
+        sig2=bytes(64), node_id=atx.node_id)
+
+
 def _conflicting(domain: int, msg1: bytes, msg2: bytes) -> bool:
     """Domain rule: the two messages occupy the same protocol slot."""
     try:
@@ -52,8 +65,16 @@ def _conflicting(domain: int, msg1: bytes, msg2: bytes) -> bool:
         if domain == int(Domain.ATX):
             a1 = ActivationTx.from_bytes(msg1)
             a2 = ActivationTx.from_bytes(msg2)
+            if a1.node_id != a2.node_id:
+                return False
+            # double publish in one epoch, OR two ATXs claiming the same
+            # prev (InvalidPrevATX, reference malfeasance/handler.go:33-42
+            # — a forked ATX chain)
+            from ..core.types import EMPTY32
+
             return (a1.publish_epoch == a2.publish_epoch
-                    and a1.node_id == a2.node_id)
+                    or (a1.prev_atx == a2.prev_atx
+                        and a1.prev_atx != EMPTY32))
         if domain == int(Domain.HARE):
             from .hare import HareMessage
 
@@ -70,16 +91,23 @@ class Handler:
     def __init__(self, *, db: Database, cache: AtxCache,
                  verifier: EdVerifier, pubsub: PubSub,
                  tortoise=None,
-                 on_malicious: Optional[Callable[[bytes], None]] = None):
+                 on_malicious: Optional[Callable[[bytes], None]] = None,
+                 post_checker=None):
         self.db = db
         self.cache = cache
         self.verifier = verifier
         self.pubsub = pubsub
         self.tortoise = tortoise
         self.on_malicious = on_malicious
+        # post_checker(atx, index_pos) -> True when the ATX's POST index
+        # at that position does NOT qualify (InvalidPostIndex validation;
+        # wired by the node with its POST params)
+        self.post_checker = post_checker
         pubsub.register(TOPIC_MALFEASANCE, self._gossip)
 
     def validate(self, proof: MalfeasanceProof) -> bool:
+        if proof.domain == DOMAIN_INVALID_POST:
+            return self._validate_invalid_post(proof)
         if proof.msg1 == proof.msg2:
             return False
         dom = Domain(proof.domain) if proof.domain in set(Domain) else None
@@ -91,18 +119,46 @@ class Handler:
             return False
         return _conflicting(proof.domain, proof.msg1, proof.msg2)
 
+    def _validate_invalid_post(self, proof: MalfeasanceProof) -> bool:
+        """The ATX really is signed by the accused AND the named POST
+        index really fails the recompute (reference InvalidPostIndex)."""
+        if self.post_checker is None:
+            return False
+        if not self.verifier.verify(Domain.ATX, proof.node_id, proof.msg1,
+                                    proof.sig1):
+            return False
+        try:
+            atx = ActivationTx.from_bytes(proof.msg1)
+            index_pos = int.from_bytes(proof.msg2[:4], "little")
+        except (codec.DecodeError, ValueError):
+            return False
+        if atx.node_id != proof.node_id:
+            return False
+        if index_pos >= len(atx.nipost.post.indices):
+            return False
+        return bool(self.post_checker(atx, index_pos))
+
     def process(self, proof: MalfeasanceProof) -> bool:
         if miscstore.is_malicious(self.db, proof.node_id):
             return True  # already known; don't regossip storms
         if not self.validate(proof):
             return False
+        # the whole equivocation set falls with any member (reference
+        # married identities share fate, handler_v2.go/sql/marriage)
+        condemned = [proof.node_id]
+        marriage = miscstore.marriage_of(self.db, proof.node_id)
+        if marriage is not None:
+            condemned += [n for n in miscstore.married_set(self.db, marriage)
+                          if n != proof.node_id]
         with self.db.tx():
-            miscstore.set_malicious(self.db, proof.node_id, proof)
-        self.cache.set_malicious(proof.node_id)
-        if self.tortoise is not None:
-            self.tortoise.on_malfeasance(proof.node_id)
-        if self.on_malicious:
-            self.on_malicious(proof.node_id)
+            for node_id in condemned:
+                miscstore.set_malicious(self.db, node_id, proof)
+        for node_id in condemned:
+            self.cache.set_malicious(node_id)
+            if self.tortoise is not None:
+                self.tortoise.on_malfeasance(node_id)
+            if self.on_malicious:
+                self.on_malicious(node_id)
         return True
 
     async def _gossip(self, peer: bytes, data: bytes) -> bool:

@@ -262,6 +262,81 @@ class ActivationTx:
         return self.publish_epoch + 1
 
 
+@codec.register
+class MarriageCert:
+    """Partner's consent to join the signer's equivocation set
+    (reference activation/wire/wire_v2.go:198 MarriageCertificate):
+    ``signature`` is the partner's ed25519 over
+    Domain.ATX || "marry" || primary node id."""
+
+    partner_id: bytes
+    signature: bytes
+
+    FIELDS = [("partner_id", HASH32), ("signature", SIG)]
+
+    @staticmethod
+    def message(primary_id: bytes) -> bytes:
+        return b"marry" + primary_id
+
+
+@codec.register
+class SubPostV2:
+    """One married identity's contribution inside a merged ATX
+    (reference activation/wire/wire_v2.go:227 SubPostV2)."""
+
+    node_id: bytes
+    prev_atx: bytes              # EMPTY32 for initial
+    num_units: int
+    vrf_nonce: int
+    nipost: NIPost
+
+    FIELDS = [("node_id", HASH32), ("prev_atx", HASH32),
+              ("num_units", u32), ("vrf_nonce", u64),
+              ("nipost", codec.struct(NIPost))]
+
+
+@codec.register
+class ActivationTxV2:
+    """Merged / multi-identity ATX (reference activation/wire/wire_v2.go:17
+    ActivationTxV2): one envelope signed by the primary identity carries a
+    SubPost per married identity plus the marriage certificates binding
+    them into one equivocation set."""
+
+    publish_epoch: int
+    pos_atx: bytes
+    coinbase: bytes
+    marriages: list[MarriageCert]
+    subposts: list[SubPostV2]
+    node_id: bytes               # primary (envelope signer)
+    signature: bytes
+
+    FIELDS = [
+        ("publish_epoch", u32),
+        ("pos_atx", HASH32),
+        ("coinbase", ADDRESS),
+        ("marriages", vec(codec.struct(MarriageCert), 256)),
+        ("subposts", vec(codec.struct(SubPostV2), 256)),
+        ("node_id", HASH32),
+        ("signature", SIG),
+    ]
+
+    def signed_bytes(self) -> bytes:
+        clone = dataclasses.replace(self, signature=bytes(64))
+        return clone.to_bytes()
+
+    @property
+    def id(self) -> bytes:
+        return sum256(self.to_bytes())
+
+    def target_epoch(self) -> int:
+        return self.publish_epoch + 1
+
+    def identity_atx_id(self, node_id: bytes) -> bytes:
+        """Per-identity synthetic ATX id: merged ATXs still give each
+        identity its own id for eligibility/cache keying."""
+        return sum256(self.id, node_id)
+
+
 # --- ballots / proposals / blocks -----------------------------------------
 
 

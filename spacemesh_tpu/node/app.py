@@ -128,6 +128,13 @@ class App:
             labels_per_unit=cfg.post.labels_per_unit,
             scrypt_n=cfg.post.scrypt_n, pubsub=self.pubsub,
             on_atx=self._on_atx)
+        from ..consensus import activation_v2
+
+        self.atx_handler_v2 = activation_v2.HandlerV2(
+            db=self.state, cache=self.cache, verifier=self.verifier,
+            golden_atx=self.golden_atx, post_params=self.post_params,
+            labels_per_unit=cfg.post.labels_per_unit,
+            scrypt_n=cfg.post.scrypt_n, pubsub=self.pubsub)
         self.generator = blocks.Generator(
             mesh=self.mesh, proposals=self.proposal_store, cache=self.cache,
             layers_per_epoch=cfg.layers_per_epoch)
@@ -144,9 +151,39 @@ class App:
             pubsub=self.pubsub, layers_per_epoch=cfg.layers_per_epoch,
             beacon_getter=self.beacon.get) for s in self.signers]
         self.miner = self.miners[0]
+        def post_checker(atx, index_pos: int) -> bool:
+            """True when the ATX's POST index at ``index_pos`` fails its
+            recompute (InvalidPostIndex validation)."""
+            import dataclasses as _dc
+
+            from ..post import verifier as pv
+            from ..post.prover import Proof as _Proof
+            from ..storage import misc as _misc
+
+            poet = _misc.poet_proof(self.state,
+                                    atx.nipost.post_metadata.challenge)
+            if poet is None:
+                return False
+            challenge = activation.nipost_challenge(atx.prev_atx,
+                                                    atx.publish_epoch)
+            params = _dc.replace(self.post_params, k2=1, k3=1)
+            item = pv.VerifyItem(
+                proof=_Proof(
+                    nonce=atx.nipost.post.nonce,
+                    indices=[atx.nipost.post.indices[index_pos]],
+                    pow_nonce=atx.nipost.post.pow_nonce, k2=1),
+                challenge=activation.post_challenge(poet.root, challenge),
+                node_id=atx.node_id,
+                commitment=activation.commitment_of(atx.node_id,
+                                                    self.golden_atx),
+                scrypt_n=cfg.post.scrypt_n,
+                total_labels=atx.num_units * cfg.post.labels_per_unit)
+            return not pv.verify(item, params)
+
         self.malfeasance = malfeasance_mod.Handler(
             db=self.state, cache=self.cache, verifier=self.verifier,
             pubsub=self.pubsub, tortoise=self.tortoise,
+            post_checker=post_checker,
             on_malicious=lambda nid: self.events.emit(
                 events_mod.Malfeasance(node_id=nid)))
 
@@ -205,23 +242,24 @@ class App:
         atxsdata warmup node.go:1963 setupDBs + tortoise.Recover
         tortoise/recover.go:20): the ATX cache, then the tortoise rebuilt
         through Tortoise.recover."""
-        from ..core.types import ActivationTx
         from ..storage import atxs as atxstore
         from ..storage import misc as miscstore
         from ..storage.cache import AtxInfo
 
         ticks_by_id: dict[bytes, int] = {}
         for row in atxstore.all_rows(self.state):
-            atx = ActivationTx.from_bytes(row["data"])
-            prev_height = ticks_by_id.get(atx.prev_atx, 0)
+            v = atxstore._view(row)
+            if v is None:
+                continue
+            prev_height = ticks_by_id.get(v.prev_atx, 0)
             height = row["tick_height"]
             ticks_by_id[row["id"]] = height
-            self.cache.add(atx.target_epoch(), row["id"], AtxInfo(
-                node_id=atx.node_id,
-                weight=atx.num_units * max(height - prev_height, 0),
+            self.cache.add(v.target_epoch(), row["id"], AtxInfo(
+                node_id=v.node_id,
+                weight=v.num_units * max(height - prev_height, 0),
                 base_height=prev_height, height=height,
-                num_units=atx.num_units, vrf_nonce=atx.vrf_nonce,
-                vrf_public_key=atx.vrf_public_key))
+                num_units=v.num_units, vrf_nonce=v.vrf_nonce,
+                vrf_public_key=v.vrf_public_key))
         for node_id in miscstore.all_malicious(self.state):
             self.cache.set_malicious(node_id)
 
@@ -287,7 +325,9 @@ class App:
             return lambda h: (lambda v: encode(v) if v is not None else None)(
                 getter(self.state, h))
 
-        self.fetch.set_reader(fetch_mod.HINT_ATX, _r(atxstore.get))
+        # get_blob, not get: v2 (merged) envelope rows must be servable too
+        self.fetch.set_reader(fetch_mod.HINT_ATX,
+                              lambda h: atxstore.get_blob(self.state, h))
         self.fetch.set_reader(fetch_mod.HINT_BALLOT, _r(ballotstore.get))
         self.fetch.set_reader(fetch_mod.HINT_BLOCK, _r(blockstore.get))
 
@@ -328,12 +368,21 @@ class App:
         # a different (valid-looking) object and the real one is never
         # retried from honest peers.
         async def v_atx(h: bytes, blob: bytes) -> bool:
+            from ..core.types import ActivationTxV2
+
             try:
-                if ActivationTx.from_bytes(blob).id != h:
-                    return False
+                if ActivationTx.from_bytes(blob).id == h:
+                    return await self.atx_handler._gossip(b"sync", blob)
+            except Exception:  # noqa: BLE001
+                pass
+            try:  # v2: the id must be one of the envelope's identity ids
+                atx2 = ActivationTxV2.from_bytes(blob)
             except Exception:  # noqa: BLE001
                 return False
-            return await self.atx_handler._gossip(b"sync", blob)
+            if h not in {atx2.identity_atx_id(sp.node_id)
+                         for sp in atx2.subposts}:
+                return False
+            return self.atx_handler_v2.process(atx2)
 
         async def v_ballot(h: bytes, blob: bytes) -> bool:
             try:
@@ -352,17 +401,15 @@ class App:
             if block.id != h:
                 return False
             # data availability: the executor needs the block's txs at
-            # apply time — backfill missing ones now (round-1 gap: the
-            # TX hint existed but nothing ever fetched it)
+            # apply time — backfill best-effort now (round-1 gap: the TX
+            # hint existed but nothing ever fetched it). The BLOB itself
+            # is exactly what was requested, so the serving peer earns a
+            # success either way; apply-time deferral (process_synced_
+            # layer) guards against executing with txs still missing.
             missing = [t for t in block.tx_ids
                        if not txstore_mod.has_tx(self.state, t)]
             if missing:
-                got = await self.fetch.get_hashes(fetch_mod.HINT_TX, missing)
-                if not all(got.values()):
-                    # applying a block without its txs would silently
-                    # compute a divergent state root — refuse and retry
-                    # the block (and its txs) on a later pass
-                    return False
+                await self.fetch.get_hashes(fetch_mod.HINT_TX, missing)
             self.mesh.add_block(block)
             return True
 
@@ -387,9 +434,13 @@ class App:
                 proof = MalfeasanceProof.from_bytes(blob)
             except Exception:  # noqa: BLE001
                 return False
-            if proof.node_id != node_id:
+            # a married member's malice is proven by the OFFENDER's proof
+            # (the whole equivocation set shares one proof) — accept when
+            # processing it actually condemns the requested identity
+            if not self.malfeasance.process(proof):
                 return False
-            return self.malfeasance.process(proof)
+            return (proof.node_id == node_id
+                    or miscstore.is_malicious(self.state, node_id))
 
         async def v_active_set(set_id: bytes, blob: bytes) -> bool:
             if len(blob) % 32:
@@ -515,9 +566,20 @@ class App:
             for cand in candidates:
                 if await adopt_certificate(layer, cand):
                     block = bs.get(self.state, cand)
-                    if block is not None:
-                        self.mesh.process_hare_output(block, layer)
-                        return
+                    if block is None:
+                        continue
+                    # never execute a block whose txs are still missing —
+                    # a divergent state root is silent; defer the layer
+                    # so the next sync pass retries the txs
+                    missing = [t for t in block.tx_ids
+                               if not txstore_mod.has_tx(self.state, t)]
+                    if missing:
+                        got = await self.fetch.get_hashes(
+                            fetch_mod.HINT_TX, missing)
+                        if not all(got.values()):
+                            return
+                    self.mesh.process_hare_output(block, layer)
+                    return
             self.mesh.process_hare_output(None, layer)
 
         async def derive_beacon(epoch: int, ballot_ids: list[bytes]) -> None:

@@ -163,10 +163,20 @@ class Syncer:
                 return None
             return resp if len(resp) == 32 else None
 
-        for peer in self.fetch.peers()[:2]:
-            remote = await peer_hash(peer, frontier)
-            if remote is None or remote == local:
-                continue
+        # corroboration first: rolling back applied state is expensive and
+        # a rollback loop is a DoS — only act when the RESPONDING MAJORITY
+        # disagrees with us, and score down a lone dissenter instead
+        peers = self.fetch.peers()[:3]
+        frontier_hashes = [(p, await peer_hash(p, frontier)) for p in peers]
+        answered = [(p, h) for p, h in frontier_hashes if h is not None]
+        if not answered:
+            return False
+        disagree = [(p, h) for p, h in answered if h != local]
+        if len(disagree) * 2 <= len(answered):
+            for p, _ in disagree:  # minority dissenter: likely lying
+                self.fetch.report_failure(p)
+            return False
+        for peer, _ in disagree:
             # bisect [1, frontier] for the first layer where we diverge;
             # a peer that stops answering mid-bisect yields NO divergence
             # point — never roll back on a guess

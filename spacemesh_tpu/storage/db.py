@@ -209,6 +209,11 @@ STATE_MIGRATIONS = [
     """
     ALTER TABLE beacons ADD COLUMN source INT NOT NULL DEFAULT 1;
     """,
+    # 0003: ATX wire version — v2 (merged/multi-identity) rows store the
+    # shared envelope blob once per covered identity under synthetic ids
+    """
+    ALTER TABLE atxs ADD COLUMN version INT NOT NULL DEFAULT 1;
+    """,
 ]
 
 # --- local database (node-private progress) -------------------------------

@@ -59,14 +59,17 @@ def certified_block(db: Database, layer: int) -> bytes | None:
 
 def set_malicious(db: Database, node_id: bytes, proof: MalfeasanceProof,
                   received: int = 0) -> None:
+    # identities rows also carry marriages: upsert, first proof wins
     db.exec(
-        "INSERT OR IGNORE INTO identities (node_id, proof, received)"
-        " VALUES (?,?,?)", (node_id, proof.to_bytes(), received))
+        "INSERT INTO identities (node_id, proof, received) VALUES (?,?,?)"
+        " ON CONFLICT(node_id) DO UPDATE SET"
+        " proof=COALESCE(identities.proof, excluded.proof)",
+        (node_id, proof.to_bytes(), received))
 
 
 def is_malicious(db: Database, node_id: bytes) -> bool:
-    return db.one("SELECT 1 FROM identities WHERE node_id=?",
-                  (node_id,)) is not None
+    row = db.one("SELECT proof FROM identities WHERE node_id=?", (node_id,))
+    return row is not None and row["proof"] is not None
 
 
 def malfeasance_proof(db: Database, node_id: bytes) -> MalfeasanceProof | None:
@@ -75,7 +78,31 @@ def malfeasance_proof(db: Database, node_id: bytes) -> MalfeasanceProof | None:
 
 
 def all_malicious(db: Database) -> list[bytes]:
-    return [r["node_id"] for r in db.all("SELECT node_id FROM identities")]
+    return [r["node_id"] for r in
+            db.all("SELECT node_id FROM identities WHERE proof IS NOT NULL")]
+
+
+# --- marriages (equivocation sets; reference sql/marriage) -----------------
+
+
+def set_marriage(db: Database, node_id: bytes, marriage_atx: bytes) -> None:
+    db.exec(
+        "INSERT INTO identities (node_id, marriage_atx) VALUES (?,?)"
+        " ON CONFLICT(node_id) DO UPDATE SET"
+        " marriage_atx=COALESCE(identities.marriage_atx,"
+        " excluded.marriage_atx)", (node_id, marriage_atx))
+
+
+def marriage_of(db: Database, node_id: bytes) -> bytes | None:
+    row = db.one("SELECT marriage_atx FROM identities WHERE node_id=?",
+                 (node_id,))
+    return row["marriage_atx"] if row else None
+
+
+def married_set(db: Database, marriage_atx: bytes) -> list[bytes]:
+    return [r["node_id"] for r in
+            db.all("SELECT node_id FROM identities WHERE marriage_atx=?",
+                   (marriage_atx,))]
 
 
 # --- rewards ---------------------------------------------------------------

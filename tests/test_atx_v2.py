@@ -1,0 +1,236 @@
+"""ATX v2: merged multi-identity ATXs, marriages, equivocation sets,
+InvalidPrevATX and InvalidPostIndex malfeasance.
+
+Reference: activation/wire/wire_v2.go, handler_v2.go:379 marriages,
+malfeasance/handler.go:33-42 proof types. End-to-end: two identities
+POST-init tiny data, one merged ATX covers both through a real poet
+round, the handler validates it batched, marriage condemns both when one
+equivocates.
+"""
+
+import asyncio
+import dataclasses
+import hashlib
+
+import pytest
+
+from spacemesh_tpu.consensus import activation_v2, malfeasance as mal_mod
+from spacemesh_tpu.consensus.activation import commitment_of
+from spacemesh_tpu.consensus.poet import PoetService
+from spacemesh_tpu.core.hashing import sum256
+from spacemesh_tpu.core.signing import Domain, EdSigner, EdVerifier
+from spacemesh_tpu.core.types import ActivationTxV2, MarriageCert
+from spacemesh_tpu.p2p.pubsub import PubSub
+from spacemesh_tpu.post import initializer
+from spacemesh_tpu.post.prover import ProofParams
+from spacemesh_tpu.post.service import PostClient
+from spacemesh_tpu.storage import atxs as atxstore
+from spacemesh_tpu.storage import db as dbmod
+from spacemesh_tpu.storage import misc as miscstore
+from spacemesh_tpu.storage.cache import AtxCache
+
+GEN = b"atxv2-test-genesis!!"
+GOLDEN = sum256(b"golden", GEN)
+PARAMS = ProofParams(k1=64, k2=8, k3=4,
+                     pow_difficulty=b"\x20" + b"\xff" * 31)
+LPU = 256  # labels per unit
+
+
+@pytest.fixture(scope="module")
+def world(tmp_path_factory):
+    """Two identities with initialized POST data + a built merged ATX."""
+    tmp = tmp_path_factory.mktemp("atxv2")
+    primary = EdSigner(prefix=GEN)
+    partner = EdSigner(prefix=GEN)
+    clients = {}
+    for s in (primary, partner):
+        d = tmp / s.node_id.hex()[:12]
+        initializer.initialize(
+            d, node_id=s.node_id,
+            commitment=commitment_of(s.node_id, GOLDEN),
+            num_units=1, labels_per_unit=LPU, scrypt_n=2, batch_size=128)
+        clients[s.node_id] = PostClient(d, PARAMS)
+
+    db = dbmod.open_state(":memory:")
+    poet = PoetService(poet_id=sum256(b"poet", GEN), ticks=64)
+
+    atx2 = asyncio.run(activation_v2.build_merged_atx(
+        primary=primary, partners=[partner], db=db, poet=poet,
+        post_clients=clients, golden_atx=GOLDEN, coinbase=bytes(24),
+        publish_epoch=1, execute_round=True))
+    return primary, partner, db, atx2
+
+
+def _handler(db):
+    cache = AtxCache()
+    return activation_v2.HandlerV2(
+        db=db, cache=cache, verifier=EdVerifier(prefix=GEN),
+        golden_atx=GOLDEN, post_params=PARAMS, labels_per_unit=LPU,
+        scrypt_n=2), cache
+
+
+def test_merged_atx_validates_and_stores_per_identity(world):
+    primary, partner, db, atx2 = world
+    handler, cache = _handler(db)
+    assert handler.process(atx2)
+    for s in (primary, partner):
+        view = atxstore.by_node_in_epoch(db, s.node_id, 1)
+        assert view is not None
+        assert view.id == atx2.identity_atx_id(s.node_id)
+        assert view.version == 2
+        info = cache.get(2, view.id)
+        assert info is not None and info.node_id == s.node_id
+        assert info.weight > 0
+    # marriage recorded for both
+    m1 = miscstore.marriage_of(db, primary.node_id)
+    m2 = miscstore.marriage_of(db, partner.node_id)
+    assert m1 == m2 == atx2.id
+
+
+def test_unmarried_identity_rejected(world):
+    primary, partner, db, atx2 = world
+    stranger = EdSigner(prefix=GEN)
+    bad = dataclasses.replace(
+        atx2,
+        subposts=[dataclasses.replace(atx2.subposts[0]),
+                  dataclasses.replace(atx2.subposts[1],
+                                      node_id=stranger.node_id)],
+        signature=bytes(64))
+    bad = dataclasses.replace(
+        bad, signature=primary.sign(Domain.ATX, bad.signed_bytes()))
+    handler, _ = _handler(dbmod.open_state(":memory:"))
+    # needs the poet blob; reuse original db's handler instead
+    handler2, _ = _handler(db)
+    assert not handler2.process(bad)
+
+
+def test_forged_marriage_cert_rejected(world):
+    primary, partner, db, atx2 = world
+    stranger = EdSigner(prefix=GEN)
+    forged_cert = MarriageCert(
+        partner_id=partner.node_id,
+        signature=stranger.sign(Domain.ATX,
+                                MarriageCert.message(primary.node_id)))
+    bad = dataclasses.replace(atx2, marriages=[forged_cert],
+                              signature=bytes(64))
+    bad = dataclasses.replace(
+        bad, signature=primary.sign(Domain.ATX, bad.signed_bytes()))
+    handler, _ = _handler(db)
+    assert not handler.process(bad)
+
+
+def test_marriage_condemns_whole_set(world):
+    """One married identity equivocates -> the WHOLE set is malicious."""
+    primary, partner, db, atx2 = world
+    handler, cache = _handler(db)
+    assert handler.process(atx2)
+
+    ps = PubSub(node_name=b"test")
+    mal = mal_mod.Handler(db=db, cache=cache,
+                          verifier=EdVerifier(prefix=GEN), pubsub=ps)
+    # the PARTNER double-signs hare messages
+    from spacemesh_tpu.consensus.hare import HareMessage
+
+    def hare_msg(values):
+        m = HareMessage(layer=3, iteration=0, round=0, values=values,
+                        eligibility_proof=bytes(80), eligibility_count=1,
+                        atx_id=bytes(32), node_id=partner.node_id,
+                        cert_msgs=[], signature=bytes(64))
+        m.signature = partner.sign(Domain.HARE, m.signed_bytes())
+        return m
+
+    m1, m2 = hare_msg([sum256(b"x")]), hare_msg([sum256(b"y")])
+    proof = mal_mod.MalfeasanceProof(
+        domain=int(Domain.HARE), msg1=m1.signed_bytes(), sig1=m1.signature,
+        msg2=m2.signed_bytes(), sig2=m2.signature, node_id=partner.node_id)
+    assert mal.process(proof)
+    assert miscstore.is_malicious(db, partner.node_id)
+    assert miscstore.is_malicious(db, primary.node_id), \
+        "married primary must fall with the equivocating partner"
+    assert cache.is_malicious(primary.node_id)
+
+
+def test_invalid_prev_atx_proof():
+    """Two v1 ATXs claiming the same prev -> malfeasance."""
+    from spacemesh_tpu.core.types import (
+        ActivationTx, MerkleProof, NIPost, Post, PostMetadataWire)
+
+    db = dbmod.open_state(":memory:")
+    cache = AtxCache()
+    evil = EdSigner(prefix=GEN)
+    prev = sum256(b"some prev atx")
+
+    def make_atx(epoch):
+        atx = ActivationTx(
+            publish_epoch=epoch, prev_atx=prev, pos_atx=GOLDEN,
+            commitment_atx=None, initial_post=None,
+            nipost=NIPost(membership=MerkleProof(leaf_index=0, nodes=[]),
+                          post=Post(nonce=0, indices=[1], pow_nonce=0),
+                          post_metadata=PostMetadataWire(
+                              challenge=bytes(32), labels_per_unit=LPU)),
+            num_units=1, vrf_nonce=0, vrf_public_key=evil.node_id,
+            coinbase=bytes(24), node_id=evil.node_id, signature=bytes(64))
+        return dataclasses.replace(
+            atx, signature=evil.sign(Domain.ATX, atx.signed_bytes()))
+
+    a1, a2 = make_atx(3), make_atx(4)  # different epochs, same prev
+    proof = mal_mod.MalfeasanceProof(
+        domain=int(Domain.ATX), msg1=a1.signed_bytes(), sig1=a1.signature,
+        msg2=a2.signed_bytes(), sig2=a2.signature, node_id=evil.node_id)
+    ps = PubSub(node_name=b"t")
+    mal = mal_mod.Handler(db=db, cache=cache,
+                          verifier=EdVerifier(prefix=GEN), pubsub=ps)
+    assert mal.process(proof)
+    assert miscstore.is_malicious(db, evil.node_id)
+
+
+def test_invalid_post_index_proof(world, tmp_path):
+    """An ATX carrying a non-qualifying POST index is provably bad."""
+    primary, partner, db, atx2 = world
+    from spacemesh_tpu.consensus.activation import (
+        nipost_challenge, post_challenge)
+    from spacemesh_tpu.core.types import (
+        ActivationTx, NIPost, Post, PostMetadataWire)
+    from spacemesh_tpu.post import verifier as pv
+    from spacemesh_tpu.post.prover import Proof as PProof
+
+    cheat = EdSigner(prefix=GEN)
+    # take the real poet proof from the merged build
+    poet = miscstore.poet_proof(db, atx2.subposts[0].nipost
+                                .post_metadata.challenge)
+    assert poet is not None
+
+    atx = ActivationTx(
+        publish_epoch=1, prev_atx=bytes(32), pos_atx=GOLDEN,
+        commitment_atx=None, initial_post=None,
+        nipost=NIPost(
+            membership=atx2.subposts[0].nipost.membership,
+            post=Post(nonce=0, indices=[0, 7, 13], pow_nonce=0),
+            post_metadata=PostMetadataWire(challenge=poet.id,
+                                           labels_per_unit=LPU)),
+        num_units=1, vrf_nonce=0, vrf_public_key=cheat.node_id,
+        coinbase=bytes(24), node_id=cheat.node_id, signature=bytes(64))
+    atx = dataclasses.replace(
+        atx, signature=cheat.sign(Domain.ATX, atx.signed_bytes()))
+
+    def post_checker(a, index_pos):
+        challenge = nipost_challenge(a.prev_atx, a.publish_epoch)
+        params = dataclasses.replace(PARAMS, k2=1, k3=1)
+        item = pv.VerifyItem(
+            proof=PProof(nonce=a.nipost.post.nonce,
+                         indices=[a.nipost.post.indices[index_pos]],
+                         pow_nonce=a.nipost.post.pow_nonce, k2=1),
+            challenge=post_challenge(poet.root, challenge),
+            node_id=a.node_id,
+            commitment=commitment_of(a.node_id, GOLDEN),
+            scrypt_n=2, total_labels=LPU)
+        return not pv.verify(item, params)
+
+    cache = AtxCache()
+    ps = PubSub(node_name=b"t")
+    mal = mal_mod.Handler(db=db, cache=cache,
+                          verifier=EdVerifier(prefix=GEN), pubsub=ps,
+                          post_checker=post_checker)
+    proof = mal_mod.proof_invalid_post(atx, 1)
+    assert mal.process(proof)
+    assert miscstore.is_malicious(db, cheat.node_id)

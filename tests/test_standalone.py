@@ -69,10 +69,13 @@ def test_atxs_published_across_epochs(ran):
             for e in range(3)]
     assert mine[0] is not None, "initial ATX (epoch 0) missing"
     assert mine[1] is not None, "epoch-1 ATX missing"
-    # chain: epoch-1 ATX references the initial one
+    # chain: epoch-1 ATX references the initial one (views are
+    # version-independent; fetch the full v1 wire for initial-ATX fields)
     assert mine[1].prev_atx == mine[0].id
-    assert mine[0].commitment_atx is not None
-    assert mine[1].commitment_atx is None
+    full0 = atxstore.get(app.state, mine[0].id)
+    full1 = atxstore.get(app.state, mine[1].id)
+    assert full0.commitment_atx is not None
+    assert full1.commitment_atx is None
 
 
 def test_beacon_decided_for_epoch2(ran):

@@ -31,7 +31,9 @@ def test_atx_roundtrip(state):
     assert atxs.has(state, a.id)
     assert atxs.get(state, a.id) == a
     assert atxs.tick_height(state, a.id) == 100
-    assert atxs.by_node_in_epoch(state, a.node_id, 1) == a
+    view = atxs.by_node_in_epoch(state, a.node_id, 1)
+    assert view.id == a.id and view.prev_atx == a.prev_atx
+    assert view.num_units == a.num_units and view.version == 1
     assert atxs.ids_in_epoch(state, 1) == [a.id]
     assert atxs.count_in_epoch(state, 1) == 1
     assert atxs.count_in_epoch(state, 2) == 0

@@ -177,7 +177,7 @@ def test_malfeasance_syncs(network):
         m = HareMessage(layer=2, iteration=0, round=0, values=values,
                         eligibility_proof=bytes(80), eligibility_count=1,
                         atx_id=bytes(32), node_id=evil.node_id,
-                        signature=bytes(64))
+                        cert_msgs=[], signature=bytes(64))
         m.signature = evil.sign(Domain.HARE, m.signed_bytes())
         return m
 
