@@ -132,6 +132,14 @@ class Mesh:
                 self.latest_applied = layer
         layerstore.set_processed(self.db, layer)
 
+    def revert_to(self, layer: int) -> None:
+        """Roll the applied frontier back to ``layer`` (fork recovery):
+        state, layer rows, AND the in-memory frontier — callers must not
+        touch the executor directly or process_hare_output's frontier
+        check goes stale."""
+        self.executor.revert(layer)
+        self.latest_applied = min(self.latest_applied, max(layer, 0))
+
     def process_layer(self, layer: int) -> None:
         """Tortoise-driven path: tally votes, apply validity updates,
         revert + reapply on opinion change (reference mesh.go:302)."""

@@ -663,12 +663,23 @@ class App:
         self.host.join_pubsub(self.pubsub)
         self.connect_network(self.host)
         self._tasks.append(asyncio.ensure_future(self.syncer.run()))
+        from .peersync import PeerSync
+        from . import events as _ev
+
+        self.peersync = PeerSync(
+            self.server, self.fetch,
+            on_drift=lambda off: self.events.emit(
+                _ev.ClockDrift(offset=off)))
+        self._tasks.append(asyncio.ensure_future(self.peersync.run()))
         return addr
 
     async def stop_network(self) -> None:
         if getattr(self, "host", None) is not None:
             if self.syncer is not None:
                 self.syncer.stop()
+            if getattr(self, "peersync", None) is not None:
+                self.peersync.stop()
+                self.peersync = None
             await self.host.stop()
             self.host = None
 
@@ -677,7 +688,7 @@ class App:
         aggregated mesh hash diverges from ours at ``divergent_layer`` —
         roll the applied state back so the next sync pass refetches and
         reprocesses from the divergence point."""
-        self.executor.revert(max(divergent_layer - 1, 0))
+        self.mesh.revert_to(max(divergent_layer - 1, 0))
 
     # --- handlers ------------------------------------------------------
 

@@ -38,8 +38,16 @@ def generate(db: Database, layer: int | None = None) -> dict:
             "state": row["state"].hex() if row["state"] else None,
         })
     atx_rows = db.all(
-        "SELECT id, tick_height, data FROM atxs ORDER BY publish_epoch, id")
-    atxs = [r["data"].hex() for r in atx_rows]
+        "SELECT id, tick_height, data FROM atxs"
+        " ORDER BY publish_epoch, id")
+    # v2 (merged) envelopes appear once per covered identity — snapshot
+    # each blob once; ticks stay per-row (synthetic per-identity ids)
+    seen_blobs: set[bytes] = set()
+    atxs = []
+    for r in atx_rows:
+        if r["data"] not in seen_blobs:
+            seen_blobs.add(r["data"])
+            atxs.append(r["data"].hex())
     ticks = {r["id"].hex(): r["tick_height"] for r in atx_rows}
     beacons = {str(r["epoch"]): r["beacon"].hex() for r in
                db.all("SELECT epoch, beacon FROM beacons")}
@@ -78,8 +86,8 @@ def recover(db: Database, snapshot: dict, *,
     if preserve_node_id is not None:
         own = [tuple(r) for r in db.all(
             "SELECT id, node_id, publish_epoch, num_units, tick_height,"
-            " vrf_nonce, coinbase, received, data FROM atxs WHERE node_id=?",
-            (preserve_node_id,))]
+            " vrf_nonce, coinbase, received, data, version FROM atxs"
+            " WHERE node_id=?", (preserve_node_id,))]
     with db.tx():
         for table in ("atxs", "ballots", "blocks", "layers", "certificates",
                       "beacons", "transactions", "accounts", "rewards",
@@ -93,17 +101,31 @@ def recover(db: Database, snapshot: dict, *,
                 bytes.fromhex(acct["template"]) if acct["template"] else None,
                 bytes.fromhex(acct["state"]) if acct["state"] else None)
         ticks = snapshot.get("atx_ticks", {})
-        for blob in snapshot["atxs"]:
-            atx = ActivationTx.from_bytes(bytes.fromhex(blob))
-            atxstore.add(db, atx,
-                         tick_height=ticks.get(atx.id.hex(), 0))
+        for blob_hex in snapshot["atxs"]:
+            blob = bytes.fromhex(blob_hex)
+            atx = None
+            try:  # ONLY the parse probe — storage errors must surface
+                atx = ActivationTx.from_bytes(blob)
+            except Exception:  # noqa: BLE001 — not a v1 blob
+                pass
+            if atx is not None:
+                atxstore.add(db, atx,
+                             tick_height=ticks.get(atx.id.hex(), 0))
+                continue
+            from ..core.types import ActivationTxV2
+
+            atx2 = ActivationTxV2.from_bytes(blob)
+            atxstore.add_v2(db, atx2, tick_heights={
+                sp.node_id: ticks.get(
+                    atx2.identity_atx_id(sp.node_id).hex(), 0)
+                for sp in atx2.subposts})
         for epoch, beacon in snapshot.get("beacons", {}).items():
             miscstore.set_beacon(db, int(epoch), bytes.fromhex(beacon))
         for row in own:
             db.exec(
                 "INSERT OR IGNORE INTO atxs (id, node_id, publish_epoch,"
                 " num_units, tick_height, vrf_nonce, coinbase, received,"
-                " data) VALUES (?,?,?,?,?,?,?,?,?)", row)
+                " data, version) VALUES (?,?,?,?,?,?,?,?,?,?)", row)
         state_hash = bytes.fromhex(snapshot["state_hash"]) or bytes(32)
         layerstore.set_applied(db, layer, bytes(32), state_hash)
         layerstore.set_processed(db, layer)

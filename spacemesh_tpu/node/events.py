@@ -63,6 +63,13 @@ class AtxPublished:
 
 
 @dataclasses.dataclass
+class ClockDrift:
+    """Local clock drift vs the peer median exceeds tolerance."""
+
+    offset: float
+
+
+@dataclasses.dataclass
 class Malfeasance:
     node_id: bytes
 

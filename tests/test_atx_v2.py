@@ -150,6 +150,29 @@ def test_marriage_condemns_whole_set(world):
     assert cache.is_malicious(primary.node_id)
 
 
+def test_checkpoint_roundtrips_v2_atxs(world):
+    """Checkpoint snapshot + recover must carry merged ATXs intact
+    (one envelope blob, per-identity rows + ticks restored)."""
+    from spacemesh_tpu.node import checkpoint
+
+    primary, partner, db, atx2 = world
+    handler, _ = _handler(db)
+    handler.process(atx2)
+    snap = checkpoint.generate(db)
+    # the envelope appears ONCE even though two identity rows exist
+    assert sum(1 for b in snap["atxs"]
+               if bytes.fromhex(b) == atx2.to_bytes()) == 1
+
+    fresh = dbmod.open_state(":memory:")
+    checkpoint.recover(fresh, snap)
+    for s in (primary, partner):
+        view = atxstore.by_node_in_epoch(fresh, s.node_id, 1)
+        assert view is not None and view.version == 2
+        assert atxstore.tick_height(fresh, view.id) == \
+            atxstore.tick_height(db, view.id)
+    fresh.close()
+
+
 def test_invalid_prev_atx_proof():
     """Two v1 ATXs claiming the same prev -> malfeasance."""
     from spacemesh_tpu.core.types import (

@@ -83,8 +83,15 @@ def network(tmp_path_factory):
         c_holder["app"] = c
         synced = await c.syncer.synchronize()
         await asyncio.gather(task_a, task_b)
-        # final catch-up pass after A/B stopped
-        await c.syncer.synchronize()
+        # final catch-up after A/B stopped: loop until C reaches A's
+        # applied frontier (bounded; absorbs full-suite load jitter)
+        deadline = time.time() + 45
+        while time.time() < deadline:
+            await c.syncer.synchronize()
+            if layerstore.last_applied(c.state) >= \
+                    layerstore.last_applied(a.state) - 1:
+                break
+            await asyncio.sleep(0.2)
         return synced
 
     asyncio.run(asyncio.wait_for(go(), timeout=180))

@@ -1,0 +1,160 @@
+"""Partition healing: two islands diverge, merge, and reconverge.
+
+VERDICT round-2 item 4 "done" criterion: a partition-healing test where
+two hubs are merged and the network reconverges (reference
+tortoise/full.go healing + syncer/find_fork.go; systest partition_test).
+
+Deterministic asymmetry: node A holds 3/4 of the weight (3 identities),
+node B 1/4. During the partition A keeps certifying blocks (15/20
+committee seats >= threshold 11) while B's island produces empty layers
+(5 seats). After the merge, B's fork finder detects the aggregated-hash
+divergence, rolls back, and resyncs onto A's chain.
+"""
+
+import asyncio
+import time
+
+import pytest
+
+from spacemesh_tpu.core.signing import EdSigner
+from spacemesh_tpu.node import clock as clock_mod
+from spacemesh_tpu.node.app import App
+from spacemesh_tpu.node.config import load
+from spacemesh_tpu.p2p.pubsub import LoopbackHub, PubSub
+from spacemesh_tpu.p2p.server import LoopbackNet
+from spacemesh_tpu.storage import blocks as blockstore
+from spacemesh_tpu.storage import layers as layerstore
+
+LPE = 8            # one long epoch: the whole scenario rides the
+                   # bootstrap beacon, so islands cannot diverge on it
+LAYER_SEC = 0.9
+PARTITION_AT = 10  # B leaves before this layer ticks
+MERGE_AT = 13      # B rejoins before this one
+UNTIL = 14
+
+GENESIS_PLACEHOLDER = float(int(time.time()) + 3600)
+
+
+def _config(tmp_path, name, num_identities, num_units):
+    return load("standalone", overrides={
+        "data_dir": str(tmp_path / name),
+        "layer_duration": LAYER_SEC,
+        "layers_per_epoch": LPE,
+        "slots_per_layer": 2,
+        "genesis": {"time": GENESIS_PLACEHOLDER},
+        "post": {"labels_per_unit": 256, "scrypt_n": 2, "k1": 64, "k2": 8,
+                 "k3": 4, "min_num_units": 1,
+                 "pow_difficulty": "20" + "ff" * 31},
+        "smeshing": {"start": True, "num_units": num_units,
+                     "init_batch": 128, "num_identities": num_identities},
+        "hare": {"committee_size": 20, "round_duration": 0.1,
+                 "preround_delay": 0.3, "iteration_limit": 2},
+        "beacon": {"proposal_duration": 0.1},
+        "tortoise": {"hdist": 4, "zdist": 2, "window_size": 50},
+    })
+
+
+@pytest.fixture(scope="module")
+def healed(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("partition")
+    hub = LoopbackHub()
+    net = LoopbackNet()
+
+    def make(name, n_ids, units):
+        cfg = _config(tmp, name, n_ids, units)
+        signer = EdSigner(prefix=cfg.genesis.genesis_id)
+        ps = PubSub(node_name=signer.node_id)
+        hub.join(ps)
+        app = App(cfg, signer=signer, pubsub=ps)
+        app.connect_network(net)
+        return app, ps
+
+    a, ps_a = make("a", 3, 1)   # 3/4 of the weight
+    b, ps_b = make("b", 1, 1)   # 1/4
+
+    async def go():
+        await asyncio.gather(a.prepare(), b.prepare())
+        genesis = time.time() + 0.3
+        for app in (a, b):
+            app.clock = clock_mod.LayerClock(genesis, LAYER_SEC)
+        task_a = asyncio.create_task(a.run(until_layer=UNTIL))
+        task_b = asyncio.create_task(b.run(until_layer=UNTIL))
+
+        # partition: B drops off the network before PARTITION_AT ticks
+        await asyncio.sleep(max(genesis + LAYER_SEC * (PARTITION_AT - 1)
+                                + 0.3 - time.time(), 0))
+        hub.leave(ps_b)
+        net.leave(b.server)
+
+        # merge: B rejoins before MERGE_AT
+        await asyncio.sleep(max(genesis + LAYER_SEC * (MERGE_AT - 1)
+                                + 0.3 - time.time(), 0))
+        hub.join(ps_b)
+        net.join(b.server)
+
+        await asyncio.gather(task_a, task_b)
+        print("post-run A applied:", layerstore.last_applied(a.state),
+              "B applied:", layerstore.last_applied(b.state))
+        # healing: fork detection -> rollback -> resync, until B's chain
+        # matches A's at the merge frontier (bounded; the loop absorbs
+        # scheduling jitter under full-suite load)
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            ok = await b.syncer.synchronize()
+            match = (layerstore.last_applied(b.state) >= MERGE_AT - 1
+                     and layerstore.aggregated_hash(b.state, MERGE_AT - 1)
+                     == layerstore.aggregated_hash(a.state, MERGE_AT - 1))
+            print(f"heal: synced={ok} "
+                  f"B applied={layerstore.last_applied(b.state)} "
+                  f"match={match}")
+            if match:
+                break
+            await asyncio.sleep(0.2)
+
+    asyncio.run(asyncio.wait_for(go(), timeout=240))
+    return a, b
+
+
+def test_a_kept_certifying_through_partition(healed):
+    a, b = healed
+    partition_layers = [lyr for lyr in range(PARTITION_AT, MERGE_AT)
+                        if blockstore.ids_in_layer(a.state, lyr)]
+    assert partition_layers, \
+        "A (majority island) should have produced blocks during partition"
+
+
+def test_b_reconverges_after_merge(healed):
+    """APPLIED blocks must agree per layer. (The raw block pool may hold
+    extras — e.g. a block B's hare minted in the rejoin instant that
+    healing then discarded — the pool is content-addressed and unapplied
+    leftovers are harmless.)"""
+    a, b = healed
+    # assert through the merge frontier: the live tip keeps moving and is
+    # inherently racy, but everything up to MERGE_AT-1 must agree
+    top = min(layerstore.last_applied(a.state),
+              layerstore.last_applied(b.state), MERGE_AT - 1)
+    assert top >= MERGE_AT - 1
+    for lyr in range(LPE, top + 1):
+        applied_a = layerstore.applied_block(a.state, lyr)
+        applied_b = layerstore.applied_block(b.state, lyr)
+        assert applied_a == applied_b, \
+            f"layer {lyr}: islands still diverged after healing"
+
+
+def test_state_roots_match_after_healing(healed):
+    a, b = healed
+    top = min(layerstore.last_applied(a.state),
+              layerstore.last_applied(b.state), MERGE_AT - 1)
+    ra = layerstore.state_hash(a.state, top)
+    rb = layerstore.state_hash(b.state, top)
+    assert ra == rb, f"state divergence at layer {top} after healing"
+
+
+def test_aggregated_hashes_match_after_healing(healed):
+    a, b = healed
+    top = min(layerstore.last_applied(a.state),
+              layerstore.last_applied(b.state), MERGE_AT - 1)
+    for lyr in range(PARTITION_AT - 1, top + 1):
+        ha = layerstore.aggregated_hash(a.state, lyr)
+        hb = layerstore.aggregated_hash(b.state, lyr)
+        assert ha == hb, f"aggregated hash diverged at layer {lyr}"

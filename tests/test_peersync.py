@@ -1,0 +1,67 @@
+"""Peersync clock-drift detection (reference timesync/peersync)."""
+
+import asyncio
+
+from spacemesh_tpu.node.peersync import PeerSync
+from spacemesh_tpu.p2p.fetch import Fetch
+from spacemesh_tpu.p2p.server import LoopbackNet, Server
+
+
+def _pair(offset_b: float):
+    """Two connected servers; B's wall clock runs ``offset_b`` ahead."""
+    net = LoopbackNet()
+    a = Server(b"a" * 32)
+    b = Server(b"b" * 32)
+    net.join(a)
+    net.join(b)
+    base = [1000.0]
+
+    def wall_a():
+        return base[0]
+
+    def wall_b():
+        return base[0] + offset_b
+
+    # min_peers=1: the pair has a single peer (production default is a
+    # 3-response quorum)
+    ps_a = PeerSync(a, Fetch(a), wall=wall_a, max_drift=5.0, min_peers=1)
+    PeerSync(b, Fetch(b), wall=wall_b, max_drift=5.0, min_peers=1)
+    return ps_a
+
+
+def test_no_drift_measures_near_zero():
+    ps = _pair(offset_b=0.0)
+    offset = asyncio.run(ps.check())
+    assert offset is not None
+    assert abs(offset) < 0.5
+
+
+def test_skewed_peer_detected():
+    ps = _pair(offset_b=42.0)
+    offset = asyncio.run(ps.check())
+    assert offset is not None
+    assert 41.0 < offset < 43.0
+
+
+def test_drift_callback_fires():
+    drifts = []
+    ps = _pair(offset_b=42.0)
+    ps.on_drift = drifts.append
+    ps.interval = 0.01
+
+    async def go():
+        task = asyncio.ensure_future(ps.run())
+        await asyncio.sleep(0.05)
+        ps.stop()
+        task.cancel()
+
+    asyncio.run(go())
+    assert drifts and 41.0 < drifts[0] < 43.0
+
+
+def test_unreachable_peers_yield_no_verdict():
+    net = LoopbackNet()
+    a = Server(b"a" * 32)
+    net.join(a)  # alone: no peers to sample
+    ps = PeerSync(a, Fetch(a), max_drift=5.0)
+    assert asyncio.run(ps.check()) is None
