@@ -62,6 +62,55 @@ class HareMessage:
         return dataclasses.replace(self, signature=bytes(64)).to_bytes()
 
 
+COMPACT_ID_SIZE = 4
+
+
+def compact_id(full: bytes) -> bytes:
+    return full[:COMPACT_ID_SIZE]
+
+
+def values_root(values: list[bytes]) -> bytes:
+    from ..core.hashing import sum256
+
+    return sum256(*values) if values else bytes(32)
+
+
+@codec.register
+class CompactHareMessage:
+    """hare4-style compaction (reference hare4/types.go + hare.go:328):
+    messages carry 4-byte proposal-id prefixes plus a root over the full
+    ids; receivers reconstruct from their proposal store and fall back to
+    a full exchange (hf/1) with the delivering peer on a miss. The
+    signature covers THIS compact form; the root binds the full values."""
+
+    layer: int
+    iteration: int
+    round: int
+    compact_ids: list[bytes]     # 4-byte prefixes of sorted proposal ids
+    root: bytes                  # hash over the full sorted ids
+    eligibility_proof: bytes
+    eligibility_count: int
+    atx_id: bytes
+    node_id: bytes
+    cert_msgs: list[bytes]       # NOTIFY: encoded COMPACT commit messages
+    signature: bytes
+
+    FIELDS = [("layer", u32), ("iteration", u8), ("round", u8),
+              ("compact_ids", vec(fixed(COMPACT_ID_SIZE), 1 << 12)),
+              ("root", fixed(32)),
+              ("eligibility_proof", fixed(80)), ("eligibility_count", u16),
+              ("atx_id", fixed(32)), ("node_id", fixed(32)),
+              ("cert_msgs", vec(codec.var_bytes, 1 << 11)),
+              ("signature", fixed(64))]
+
+    def signed_bytes(self) -> bytes:
+        return dataclasses.replace(self, signature=bytes(64)).to_bytes()
+
+
+TOPIC_HARE_COMPACT = "b4"
+P_FULL_EXCHANGE = "hf/1"   # (layer, iteration, round, node_id) -> full ids
+
+
 @dataclasses.dataclass
 class ConsensusOutput:
     layer: int
@@ -124,14 +173,22 @@ class HareSession:
 
     # --- message handling ------------------------------------------
 
-    def on_message(self, msg: HareMessage) -> None:
+    def on_message(self, msg: HareMessage, raw_signed: bytes | None = None,
+                   raw_full: bytes | None = None) -> None:
+        """``raw_signed``/``raw_full`` override the wire bytes used for
+        the equivocation watch and certificate assembly — compact-mode
+        messages keep their COMPACT encoding (that's what signatures
+        cover and what certificates must carry)."""
         key = (msg.node_id, msg.iteration, msg.round)
         prev = self.seen.get(key)
-        raw = msg.signed_bytes()
+        raw = raw_signed if raw_signed is not None else msg.signed_bytes()
         if prev is not None and prev[0] != raw:
             # equivocator: report AND exclude its weight from every round
             self.excluded.add(msg.node_id)
-            self.h._report_equivocation(msg, prev)
+            # report with the WIRE bytes the signature actually covers
+            # (compact-mode signatures sign the compact encoding)
+            self.h._report_equivocation(msg.node_id, prev, raw,
+                                        msg.signature)
             return
         self.seen[key] = (raw, msg.signature)
         if msg.node_id in self.excluded or self.too_late(msg):
@@ -153,7 +210,7 @@ class HareSession:
             self.commits[msg.node_id] = (w, tuple(msg.values))
             self.commit_raw.setdefault(
                 (msg.iteration, tuple(msg.values)), {})[msg.node_id] = \
-                (msg.to_bytes(), w)
+                (raw_full if raw_full is not None else msg.to_bytes(), w)
         elif msg.round == NOTIFY:
             self.notifies[msg.node_id] = (w, tuple(msg.values))
 
@@ -202,10 +259,15 @@ class Hare:
                  proposals_for: Callable[[int], list[bytes]],
                  on_output: Callable[[ConsensusOutput], Awaitable[None]],
                  on_equivocation=None, preround_delay: float = 0.0,
-                 wall=None):
+                 wall=None, compact: bool = False, server=None):
         """Multi-identity: every signer in ``signers`` participates with
         its own eligibility (reference hare iterates registered signers);
-        atx_for(epoch, node_id) resolves each signer's ATX."""
+        atx_for(epoch, node_id) resolves each signer's ATX.
+
+        ``compact=True`` switches sends to hare4-style 4-byte proposal-id
+        prefixes + a values root (topic b4); receivers reconstruct from
+        their proposal store and fall back to the hf/1 full exchange on
+        ``server`` (reference hare4/hare.go:328 fetchFull)."""
         import time as _time
 
         self.signers = signers if signers is not None else [signer]
@@ -231,9 +293,18 @@ class Hare:
         # messages for layers whose session hasn't started here yet — peers'
         # clocks are never perfectly aligned (reference buffers early
         # messages the same way)
-        self._pending: dict[int, list[HareMessage]] = {}
+        self._pending: dict[int, list] = {}  # (msg, raw_signed, raw_full)
         self._pending_cap = 1 << 10
+        self.compact = compact
+        self.server = server
+        # full value lists we can serve over hf/1:
+        # (layer, iteration, round, node_id) -> list of full ids
+        self._full_values: dict[tuple, list[bytes]] = {}
         pubsub.register(TOPIC_HARE, self._gossip)
+        if compact:
+            pubsub.register(TOPIC_HARE_COMPACT, self._gossip_compact)
+        if server is not None:
+            server.register(P_FULL_EXCHANGE, self._serve_full)
 
     # --- gossip ingestion ------------------------------------------
 
@@ -254,42 +325,155 @@ class Hare:
                 msg.eligibility_count):
             return False
         if msg.round == COMMIT:
-            self._valid_commits[data] = None
-            if len(self._valid_commits) > (1 << 12):
-                for k in list(self._valid_commits)[:1 << 10]:
-                    del self._valid_commits[k]
+            self._remember_valid_commit(data)
         # NOTIFY must PROVE its commit threshold: a valid commit
         # certificate travels with it (reference hare certificates) — a
         # bare keypair cannot fabricate agreement
-        if msg.round == NOTIFY and not await self._validate_cert(msg):
+        if msg.round == NOTIFY and not await self._validate_cert(
+                msg.layer, msg.iteration, values_root(sorted(msg.values)),
+                msg.cert_msgs):
             return False
+        self._dispatch(msg)
+        return True
+
+    def _remember_valid_commit(self, raw: bytes) -> None:
+        self._valid_commits[raw] = None
+        if len(self._valid_commits) > (1 << 12):
+            for k in list(self._valid_commits)[:1 << 10]:
+                del self._valid_commits[k]
+
+    def _dispatch(self, msg: HareMessage, raw_signed: bytes | None = None,
+                  raw_full: bytes | None = None) -> None:
         session = self.sessions.get(msg.layer)
         if session is not None:
-            session.on_message(msg)
+            session.on_message(msg, raw_signed, raw_full)
         else:
             buf = self._pending.setdefault(msg.layer, [])
             if len(buf) < self._pending_cap:
-                buf.append(msg)
+                buf.append((msg, raw_signed, raw_full))
+
+    # --- compaction (reference hare4) -------------------------------
+
+    async def _serve_full(self, peer: bytes, data: bytes) -> bytes:
+        """hf/1: (layer u32, iteration u8, round u8, node_id 32) -> the
+        full 32-byte proposal ids behind a compact message we hold."""
+        import struct
+
+        if len(data) != 4 + 1 + 1 + 32:
+            return b""
+        layer, iteration, round_ = struct.unpack_from("<IBB", data)
+        node_id = data[6:38]
+        fulls = self._full_values.get((layer, iteration, round_, node_id))
+        return b"".join(fulls) if fulls else b""
+
+    def _remember_full(self, key: tuple, values: list[bytes]) -> None:
+        self._full_values[key] = list(values)
+        if len(self._full_values) > (1 << 12):
+            for k in list(self._full_values)[:1 << 10]:
+                del self._full_values[k]
+
+    async def _reconstruct(self, peer: bytes,
+                           cm: "CompactHareMessage") -> list[bytes] | None:
+        """Recover the full proposal ids behind a compact message: local
+        proposal store first (prefix match + root check), then the full
+        exchange with the delivering peer (reference hare4
+        reconstructProposals + fetchFull)."""
+        cached = self._full_values.get(
+            (cm.layer, cm.iteration, cm.round, cm.node_id))
+        if cached is not None and values_root(cached) == cm.root:
+            return cached  # own sends / already reconstructed
+        by_prefix = {compact_id(f): f
+                     for f in self.proposals_for(cm.layer)}
+        fulls = [by_prefix.get(c) for c in cm.compact_ids]
+        if all(f is not None for f in fulls):
+            candidate = sorted(fulls)
+            if values_root(candidate) == cm.root:
+                return candidate
+        if self.server is None or peer not in self.server.peers():
+            return None
+        import struct
+
+        try:
+            resp = await self.server.request(
+                peer, P_FULL_EXCHANGE,
+                struct.pack("<IBB", cm.layer, cm.iteration, cm.round)
+                + cm.node_id, timeout=5.0)
+        except Exception:  # noqa: BLE001 — peer gone: reconstruction fails
+            return None
+        if len(resp) % 32:
+            return None
+        candidate = sorted(resp[i:i + 32] for i in range(0, len(resp), 32))
+        if values_root(candidate) != cm.root:
+            return None
+        if [compact_id(f) for f in candidate] != list(cm.compact_ids):
+            return None
+        return candidate
+
+    async def _gossip_compact(self, peer: bytes, data: bytes) -> bool:
+        try:
+            cm = CompactHareMessage.from_bytes(data)
+        except (codec.DecodeError, ValueError):
+            return False
+        if not self.verifier.verify(Domain.HARE, cm.node_id,
+                                    cm.signed_bytes(), cm.signature):
+            return False
+        epoch = cm.layer // self.layers_per_epoch
+        beacon = await self.beacon_of(epoch)
+        round_tag = cm.iteration * 4 + cm.round
+        if not self.oracle.validate_hare(
+                beacon, cm.layer, round_tag, epoch, cm.atx_id,
+                self.committee, cm.eligibility_proof,
+                cm.eligibility_count):
+            return False
+        if cm.round == NOTIFY and not await self._validate_cert(
+                cm.layer, cm.iteration, cm.root, cm.cert_msgs):
+            return False
+        values = await self._reconstruct(peer, cm)
+        if values is None:
+            return False
+        key = (cm.layer, cm.iteration, cm.round, cm.node_id)
+        self._remember_full(key, values)  # we can now serve hf/1 ourselves
+        if cm.round == COMMIT:
+            self._remember_valid_commit(data)
+        full = HareMessage(
+            layer=cm.layer, iteration=cm.iteration, round=cm.round,
+            values=values, eligibility_proof=cm.eligibility_proof,
+            eligibility_count=cm.eligibility_count, atx_id=cm.atx_id,
+            node_id=cm.node_id, cert_msgs=[], signature=cm.signature)
+        self._dispatch(full, raw_signed=cm.signed_bytes(), raw_full=data)
         return True
 
-    async def _validate_cert(self, msg: HareMessage) -> bool:
-        """Check the commit certificate inside a NOTIFY: every inner
-        COMMIT decodes, is signed, eligibility-validated for the same
-        (layer, iteration) and values, senders distinct, and the summed
-        seats reach the commit threshold."""
+    async def _validate_cert(self, layer: int, iteration: int,
+                             expected_root: bytes,
+                             cert_msgs: list[bytes]) -> bool:
+        """ONE cert validator for both wire formats: every inner COMMIT
+        (full or compact encoding) decodes, is signed,
+        eligibility-validated for the same (layer, iteration), binds to
+        the SAME value set (compared by values root — the canonical form
+        both encodings share), senders distinct, summed seats reaching
+        the commit threshold. Mixed networks therefore interoperate: a
+        full-encoded commit can certify a compact NOTIFY and vice versa."""
         threshold = self.committee // 2 + 1
-        epoch = msg.layer // self.layers_per_epoch
+        epoch = layer // self.layers_per_epoch
         beacon = await self.beacon_of(epoch)
         total = 0
         senders: set[bytes] = set()
-        for raw in msg.cert_msgs:
-            try:
-                cm = HareMessage.from_bytes(raw)
-            except (codec.DecodeError, ValueError):
+        for raw in cert_msgs:
+            cm = None
+            root = None
+            for cls in (HareMessage, CompactHareMessage):
+                try:
+                    cm = cls.from_bytes(raw)
+                    root = (cm.root if cls is CompactHareMessage
+                            else values_root(sorted(cm.values)))
+                    break
+                except (codec.DecodeError, ValueError):
+                    continue
+            if cm is None:
                 return False
-            if (cm.round != COMMIT or cm.layer != msg.layer
-                    or cm.iteration != msg.iteration
-                    or cm.values != msg.values
+            if (cm.round != COMMIT or cm.layer != layer
+                    or cm.iteration != iteration
+                    or root != expected_root
                     or cm.node_id in senders):
                 return False
             if raw not in self._valid_commits:  # gossip-validated skip
@@ -302,16 +486,17 @@ class Hare:
                         self.committee, cm.eligibility_proof,
                         cm.eligibility_count):
                     return False
-                self._valid_commits[raw] = None
+                self._remember_valid_commit(raw)
             senders.add(cm.node_id)
             total += cm.eligibility_count
         return total >= threshold
 
-    def _report_equivocation(self, msg: HareMessage, prev) -> None:
+    def _report_equivocation(self, node_id: bytes, prev,
+                             raw_signed: bytes, signature: bytes) -> None:
         if self.on_equivocation:
             self.on_equivocation(Equivocation(
-                node_id=msg.node_id, msg1=prev[0], sig1=prev[1],
-                msg2=msg.signed_bytes(), sig2=msg.signature))
+                node_id=node_id, msg1=prev[0], sig1=prev[1],
+                msg2=raw_signed, sig2=signature))
 
     # --- session driving -------------------------------------------
 
@@ -347,8 +532,8 @@ class Hare:
         session = HareSession(self, layer, [])
         session.layer_start = layer_start
         self.sessions[layer] = session
-        for msg in self._pending.pop(layer, ()):  # replay early arrivals
-            session.on_message(msg)
+        for msg, rs, rf in self._pending.pop(layer, ()):  # early arrivals
+            session.on_message(msg, rs, rf)
         for stale in [x for x in self._pending if x < layer]:
             del self._pending[stale]
 
@@ -368,9 +553,26 @@ class Hare:
                 if el is None:
                     continue
                 proof, count = el
+                full_values = sorted(values)
+                if self.compact:
+                    cm = CompactHareMessage(
+                        layer=layer, iteration=iteration, round=round_,
+                        compact_ids=[compact_id(v) for v in full_values],
+                        root=values_root(full_values),
+                        eligibility_proof=proof, eligibility_count=count,
+                        atx_id=atx, node_id=signer.node_id,
+                        cert_msgs=list(cert or []), signature=bytes(64))
+                    cm.signature = signer.sign(Domain.HARE,
+                                               cm.signed_bytes())
+                    self._remember_full(
+                        (layer, iteration, round_, signer.node_id),
+                        full_values)
+                    await self.pubsub.publish(TOPIC_HARE_COMPACT,
+                                              cm.to_bytes())
+                    continue
                 msg = HareMessage(
                     layer=layer, iteration=iteration, round=round_,
-                    values=sorted(values), eligibility_proof=proof,
+                    values=full_values, eligibility_proof=proof,
                     eligibility_count=count, atx_id=atx,
                     node_id=signer.node_id, cert_msgs=list(cert or []),
                     signature=bytes(64))

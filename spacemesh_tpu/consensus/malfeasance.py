@@ -76,12 +76,20 @@ def _conflicting(domain: int, msg1: bytes, msg2: bytes) -> bool:
                     or (a1.prev_atx == a2.prev_atx
                         and a1.prev_atx != EMPTY32))
         if domain == int(Domain.HARE):
-            from .hare import HareMessage
+            from .hare import CompactHareMessage, HareMessage
 
-            h1 = HareMessage.from_bytes(msg1)
-            h2 = HareMessage.from_bytes(msg2)
-            return (h1.layer, h1.iteration, h1.round, h1.node_id) == \
-                   (h2.layer, h2.iteration, h2.round, h2.node_id)
+            def slot(raw: bytes):
+                # both wire encodings are conflict-provable
+                for cls in (HareMessage, CompactHareMessage):
+                    try:
+                        m = cls.from_bytes(raw)
+                        return (m.layer, m.iteration, m.round, m.node_id)
+                    except (codec.DecodeError, ValueError):
+                        continue
+                return None
+
+            s1, s2 = slot(msg1), slot(msg2)
+            return s1 is not None and s1 == s2
     except (codec.DecodeError, ValueError, TypeError):
         return False
     return False

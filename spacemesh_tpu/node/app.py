@@ -212,7 +212,7 @@ class App:
             layers_per_epoch=cfg.layers_per_epoch,
             beacon_of=self.beacon.get, atx_for=self._atx_of,
             proposals_for=self.proposal_store.ids_in_layer,
-            on_output=self._on_hare_output)
+            on_output=self._on_hare_output, compact=cfg.hare.compact)
         if cfg.poet_servers:
             # external poet daemons (reference activation/poet.go client;
             # multi-poet best-by-ticks, nipost.go getBestProof)
@@ -510,7 +510,22 @@ class App:
 
         async def serve_layer_hash(peer: bytes, data: bytes) -> bytes:
             layer = _struct.unpack("<I", data)[0]
+            if layer == 0xFFFFFFFF:
+                # tip probe: (u32 layer, hash) of our highest aggregated
+                # layer — fork finders anchor at the COMMON frontier
+                tip = layerstore.last_applied(self.state)
+                h = layerstore.aggregated_hash(self.state, tip)
+                if tip < 0 or h is None:
+                    return b""
+                return _struct.pack("<I", tip) + h
             return layerstore.aggregated_hash(self.state, layer) or b""
+
+        if self.cfg.hare.compact:
+            # hare4 full exchange rides the req/resp server
+            from ..consensus.hare import P_FULL_EXCHANGE
+
+            self.hare.server = self.server
+            self.server.register(P_FULL_EXCHANGE, self.hare._serve_full)
 
         self.server.register(fetch_mod.P_EPOCH, serve_epoch)
         self.server.register(fetch_mod.P_LAYER, serve_layer)

@@ -66,6 +66,7 @@ class HareConfig:
     round_duration: float = 25.0
     preround_delay: float = 25.0
     iteration_limit: int = 4
+    compact: bool = False        # hare4-style compact proposal ids (b4)
 
 
 @dataclasses.dataclass

@@ -163,10 +163,33 @@ class Syncer:
                 return None
             return resp if len(resp) == 32 else None
 
+        async def peer_tip(peer) -> int | None:
+            try:
+                resp = await self.fetch.server.request(
+                    peer, "lh/1", struct.pack("<I", 0xFFFFFFFF))
+            except (RequestError, asyncio.TimeoutError):
+                return None
+            if len(resp) != 36:
+                return None
+            return struct.unpack_from("<I", resp)[0]
+
+        # anchor at the COMMON frontier: our tip may be ahead of a peer's
+        # (e.g. we applied empty layers while it idled) — comparing where
+        # the peer has no hash would blind the fork finder entirely
+        peers = self.fetch.peers()[:3]
+        tips = [t for t in [await peer_tip(p) for p in peers]
+                if t is not None]
+        if tips:
+            frontier = min(frontier, max(tips))
+        if frontier < 1:
+            return False
+        local = self.layer_hash(frontier)
+        if local is None:
+            return False
+
         # corroboration first: rolling back applied state is expensive and
         # a rollback loop is a DoS — only act when the RESPONDING MAJORITY
         # disagrees with us, and score down a lone dissenter instead
-        peers = self.fetch.peers()[:3]
         frontier_hashes = [(p, await peer_hash(p, frontier)) for p in peers]
         answered = [(p, h) for p, h in frontier_hashes if h is not None]
         if not answered:

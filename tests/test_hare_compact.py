@@ -1,0 +1,255 @@
+"""hare4-style compaction: compact ids, roots, full exchange fallback.
+
+Reference hare4/hare.go:328 fetchFull + :394 reconstructProposals: hare
+messages carry 4-byte proposal-id prefixes and a root; receivers rebuild
+full ids from their store, or stream them from the delivering peer.
+"""
+
+import asyncio
+
+from spacemesh_tpu.consensus.eligibility import Oracle
+from spacemesh_tpu.consensus.hare import (
+    COMMIT,
+    CompactHareMessage,
+    Hare,
+    compact_id,
+    values_root,
+)
+from spacemesh_tpu.core.hashing import sum256
+from spacemesh_tpu.core.signing import Domain, EdSigner, EdVerifier
+from spacemesh_tpu.p2p.pubsub import LoopbackHub, PubSub
+from spacemesh_tpu.p2p.server import LoopbackNet, Server
+from spacemesh_tpu.storage.cache import AtxCache, AtxInfo
+
+GEN = b"hare-compact-gen!!!!"
+LPE = 4
+LAYER = 5
+EPOCH = LAYER // LPE
+BEACON = b"\x07\x07\x07\x07"
+COMMITTEE = 30
+
+
+def _cache_with(signers, weight=100):
+    cache = AtxCache()
+    atx_ids = {}
+    for i, s in enumerate(signers):
+        atx_id = b"CATX%04d" % i + bytes(24)
+        atx_ids[s.node_id] = atx_id
+        cache.add(EPOCH, atx_id, AtxInfo(
+            node_id=s.node_id, weight=weight, base_height=0, height=1,
+            num_units=1, vrf_nonce=0, vrf_public_key=s.node_id))
+    return cache, atx_ids
+
+
+async def _abeacon(epoch):
+    return BEACON
+
+
+def _mk(hub, net, cache, atx_ids, signer, outputs, proposals,
+        store: dict):
+    """store: layer -> list of full proposal ids this node knows."""
+    ps = PubSub(node_name=signer.node_id)
+    hub.join(ps)
+    srv = Server(signer.node_id)
+    net.join(srv)
+
+    async def on_output(out):
+        outputs.append((signer.node_id, tuple(out.proposals)))
+
+    hare = Hare(
+        signers=[signer], verifier=EdVerifier(prefix=GEN),
+        oracle=Oracle(cache, LPE), pubsub=ps, committee_size=COMMITTEE,
+        round_duration=0.15, iteration_limit=2, preround_delay=0.15,
+        layers_per_epoch=LPE, beacon_of=_abeacon,
+        atx_for=lambda epoch, node_id: atx_ids.get(node_id),
+        proposals_for=lambda layer: list(store.get(layer, [])),
+        on_output=on_output, compact=True, server=srv)
+    return hare
+
+
+def test_compact_agreement_with_shared_store():
+    """All nodes know the proposals: reconstruction is store-local and
+    they agree through compact messages only."""
+    signers = [EdSigner(prefix=GEN) for _ in range(3)]
+    cache, atx_ids = _cache_with(signers)
+    hub, net = LoopbackHub(), LoopbackNet()
+    props = sorted([sum256(b"p1"), sum256(b"p2")])
+    store = {LAYER: props}
+    outs = []
+
+    async def go():
+        hares = [_mk(hub, net, cache, atx_ids, s, outs, props, store)
+                 for s in signers]
+        await asyncio.gather(*(h.run_layer(LAYER) for h in hares))
+
+    asyncio.run(asyncio.wait_for(go(), 30))
+    values = {v for _, v in outs}
+    assert len(values) == 1
+    assert sorted(values.pop()) == props
+
+
+def test_full_exchange_recovers_missing_proposals():
+    """One node's proposal store is EMPTY: every reconstruction must go
+    through the hf/1 full exchange with the delivering peer — and the
+    node still reaches the same output."""
+    signers = [EdSigner(prefix=GEN) for _ in range(3)]
+    cache, atx_ids = _cache_with(signers)
+    hub, net = LoopbackHub(), LoopbackNet()
+    props = sorted([sum256(b"q1"), sum256(b"q2"), sum256(b"q3")])
+    full_store = {LAYER: props}
+    empty_store: dict = {}
+    outs = []
+
+    async def go():
+        hares = [
+            _mk(hub, net, cache, atx_ids, signers[0], outs, props,
+                full_store),
+            _mk(hub, net, cache, atx_ids, signers[1], outs, props,
+                full_store),
+            _mk(hub, net, cache, atx_ids, signers[2], outs, [],
+                empty_store),  # knows nothing locally
+        ]
+        await asyncio.gather(*(h.run_layer(LAYER) for h in hares))
+
+    asyncio.run(asyncio.wait_for(go(), 30))
+    by_node = dict(outs)
+    assert by_node[signers[2].node_id] == tuple(props), \
+        "store-less node failed to reconstruct via full exchange"
+    assert len({v for v in by_node.values()}) == 1
+
+
+def test_root_mismatch_rejected():
+    """A compact message whose root doesn't match its ids is refused."""
+    signers = [EdSigner(prefix=GEN) for _ in range(2)]
+    cache, atx_ids = _cache_with(signers)
+    hub, net = LoopbackHub(), LoopbackNet()
+    props = [sum256(b"z1")]
+    store = {LAYER: props}
+    outs = []
+    hare = _mk(hub, net, cache, atx_ids, signers[0], outs, props, store)
+    oracle = Oracle(cache, LPE)
+    attacker = signers[1]
+    el = oracle.hare_eligibility(attacker.vrf_signer(), BEACON, LAYER,
+                                 0 * 4 + COMMIT, EPOCH,
+                                 atx_ids[attacker.node_id], COMMITTEE)
+    proof, count = el
+    cm = CompactHareMessage(
+        layer=LAYER, iteration=0, round=COMMIT,
+        compact_ids=[compact_id(props[0])],
+        root=sum256(b"some other set"),  # lies about the values
+        eligibility_proof=proof, eligibility_count=count,
+        atx_id=atx_ids[attacker.node_id], node_id=attacker.node_id,
+        cert_msgs=[], signature=bytes(64))
+    cm.signature = attacker.sign(Domain.HARE, cm.signed_bytes())
+
+    async def go():
+        assert not await hare._gossip_compact(b"peer", cm.to_bytes())
+
+    asyncio.run(go())
+
+
+def test_standalone_node_runs_with_compact_hare(tmp_path):
+    """A full node lives through epochs with hare.compact=True — the
+    compact path is wired end to end (topic b4, hf/1 on the server)."""
+    import time
+
+    from spacemesh_tpu.node import clock as clock_mod
+    from spacemesh_tpu.node.app import App
+    from spacemesh_tpu.node.config import load
+    from spacemesh_tpu.storage import layers as layerstore
+
+    cfg = load("standalone", overrides={
+        "data_dir": str(tmp_path / "node"),
+        "layer_duration": 0.7, "layers_per_epoch": 3, "slots_per_layer": 2,
+        "genesis": {"time": time.time() + 3600},
+        "post": {"labels_per_unit": 256, "scrypt_n": 2, "k1": 64, "k2": 8,
+                 "k3": 4, "min_num_units": 1,
+                 "pow_difficulty": "20" + "ff" * 31},
+        "smeshing": {"start": True, "num_units": 1, "init_batch": 128},
+        "hare": {"committee_size": 20, "round_duration": 0.06,
+                 "preround_delay": 0.06, "iteration_limit": 2,
+                 "compact": True},
+        "beacon": {"proposal_duration": 0.05},
+        "tortoise": {"hdist": 4, "window_size": 50},
+    })
+    app = App(cfg)
+
+    async def go():
+        await app.prepare()
+        app.clock = clock_mod.LayerClock(time.time() + 0.3,
+                                         cfg.layer_duration)
+        await asyncio.wait_for(app.run(until_layer=7), timeout=120)
+
+    try:
+        asyncio.run(go())
+        assert layerstore.last_applied(app.state) >= 6
+        from spacemesh_tpu.storage import blocks as blockstore
+
+        assert any(blockstore.ids_in_layer(app.state, lyr)
+                   for lyr in range(3, 8)), "no blocks under compact hare"
+    finally:
+        app.close()
+
+
+def test_compact_equivocation_proof_validates():
+    """Two conflicting COMPACT messages must yield a malfeasance proof
+    that the handler accepts (signatures cover the compact encoding)."""
+    from spacemesh_tpu.consensus import malfeasance as mal_mod
+    from spacemesh_tpu.storage import db as dbmod
+    from spacemesh_tpu.storage import misc as miscstore
+
+    signers = [EdSigner(prefix=GEN) for _ in range(2)]
+    cache, atx_ids = _cache_with(signers)
+    hub, net = LoopbackHub(), LoopbackNet()
+    p1, p2 = sum256(b"e1"), sum256(b"e2")
+    store = {LAYER: [p1, p2]}
+    equivs = []
+    hare = _mk(hub, net, cache, atx_ids, signers[0], [], [p1, p2], store)
+    hare.on_equivocation = equivs.append
+    evil = signers[1]
+    oracle = Oracle(cache, LPE)
+
+    def compact_msg(vals):
+        el = oracle.hare_eligibility(evil.vrf_signer(), BEACON, LAYER,
+                                     0, EPOCH, atx_ids[evil.node_id],
+                                     COMMITTEE)
+        proof, count = el
+        vals = sorted(vals)
+        cm = CompactHareMessage(
+            layer=LAYER, iteration=0, round=0,
+            compact_ids=[compact_id(v) for v in vals],
+            root=values_root(vals), eligibility_proof=proof,
+            eligibility_count=count, atx_id=atx_ids[evil.node_id],
+            node_id=evil.node_id, cert_msgs=[], signature=bytes(64))
+        cm.signature = evil.sign(Domain.HARE, cm.signed_bytes())
+        return cm
+
+    async def go():
+        from spacemesh_tpu.consensus.hare import HareSession
+
+        session = HareSession(hare, LAYER, [])
+        hare.sessions[LAYER] = session
+        assert await hare._gossip_compact(b"x", compact_msg([p1]).to_bytes())
+        assert await hare._gossip_compact(b"x", compact_msg([p2]).to_bytes())
+
+    asyncio.run(go())
+    assert equivs, "compact equivocation went unreported"
+    eq = equivs[0]
+    proof = mal_mod.proof_from_hare(eq.node_id, eq.msg1, eq.sig1,
+                                    eq.msg2, eq.sig2)
+    db = dbmod.open_state(":memory:")
+    handler = mal_mod.Handler(db=db, cache=cache,
+                              verifier=EdVerifier(prefix=GEN),
+                              pubsub=PubSub(node_name=b"t"))
+    assert handler.process(proof), \
+        "compact-mode equivocation proof rejected by malfeasance handler"
+    assert miscstore.is_malicious(db, evil.node_id)
+    db.close()
+
+
+def test_compact_is_smaller_on_the_wire():
+    vals = [sum256(b"v%d" % i) for i in range(50)]
+    full_len = sum(len(v) for v in vals)
+    compact_len = sum(len(compact_id(v)) for v in vals) + 32  # + root
+    assert compact_len < full_len // 4
+    assert values_root(sorted(vals)) == values_root(sorted(vals))

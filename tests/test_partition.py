@@ -98,7 +98,7 @@ def healed(tmp_path_factory):
         # healing: fork detection -> rollback -> resync, until B's chain
         # matches A's at the merge frontier (bounded; the loop absorbs
         # scheduling jitter under full-suite load)
-        deadline = time.time() + 60
+        deadline = time.time() + 120
         while time.time() < deadline:
             ok = await b.syncer.synchronize()
             match = (layerstore.last_applied(b.state) >= MERGE_AT - 1
