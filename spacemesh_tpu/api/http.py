@@ -56,6 +56,7 @@ class ApiServer:
         r = self.web_app.router
         r.add_get("/v1/node/status", self.node_status)
         r.add_get("/v1/node/version", self.node_version)
+        r.add_get("/v1/node/peers", self.node_peers)
         r.add_get("/v1/mesh/genesis", self.mesh_genesis)
         r.add_get("/v1/mesh/layer/{layer}", self.mesh_layer)
         r.add_get("/v1/mesh/epoch/{epoch}/atxs", self.epoch_atxs)
@@ -257,10 +258,33 @@ class ApiServer:
             "verified_layer": n.tortoise.verified,
             "processed_layer": layerstore.processed(n.state),
             "last_applied": layerstore.last_applied(n.state),
+            "tortoise_mode": n.tortoise.mode,
+            "sync_state": n.syncer.state.value if n.syncer else None,
+            "identities": [s.node_id.hex() for s in n.signers],
             "mempool": n.cstate.pending_count(),
             "malicious_identities":
                 [i.hex() for i in miscstore.all_malicious(n.state)],
         })
+
+    async def node_peers(self, req) -> web.Response:
+        """Connected peers with fetch scores (reference admin/debug peer
+        listings)."""
+        n = self.node
+        peers = []
+        if n.server is not None:
+            for pid in n.server.peers():
+                entry = {"node_id": pid.hex(),
+                         "failure_score": (n.fetch.failure_score(pid)
+                                           if n.fetch else 0)}
+                host = getattr(n, "host", None)
+                if host is not None and pid in host.nodes:
+                    conn = host.nodes[pid]
+                    if conn.listen_addr:
+                        entry["address"] = (f"{conn.listen_addr[0]}:"
+                                            f"{conn.listen_addr[1]}")
+                    entry["outbound"] = conn.outbound
+                peers.append(entry)
+        return web.json_response({"peers": peers})
 
     async def admin_checkpoint(self, req) -> web.Response:
         try:
@@ -287,10 +311,29 @@ class ApiServer:
         return web.json_response({"recovered_layer": snap["layer"]})
 
     async def metrics(self, req) -> web.Response:
-        from ..utils.metrics import REGISTRY, layer_gauge, verified_gauge
+        from ..consensus.tortoise import FULL
+        from ..utils.metrics import (
+            REGISTRY,
+            applied_gauge,
+            layer_gauge,
+            peers_gauge,
+            sync_state_gauge,
+            tortoise_mode_gauge,
+            verified_gauge,
+        )
 
-        layer_gauge.set(int(self.node.clock.current_layer()))
-        verified_gauge.set(self.node.tortoise.verified)
+        n = self.node
+        layer_gauge.set(int(n.clock.current_layer()))
+        verified_gauge.set(n.tortoise.verified)
+        applied_gauge.set(layerstore.last_applied(n.state))
+        peers_gauge.set(len(n.server.peers()) if n.server else 0)
+        tortoise_mode_gauge.set(1 if n.tortoise.mode == FULL else 0)
+        if n.syncer is not None:
+            from ..p2p.sync import SyncState
+
+            sync_state_gauge.set({SyncState.NOT_SYNCED: 0,
+                                  SyncState.GOSSIP: 1,
+                                  SyncState.SYNCED: 2}[n.syncer.state])
         return web.Response(text=REGISTRY.expose(),
                             content_type="text/plain")
 

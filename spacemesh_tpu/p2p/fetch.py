@@ -88,6 +88,11 @@ class Fetch:
         if s > 0:
             self._peer_score[peer] = s - 1
 
+    def failure_score(self, peer: bytes) -> int:
+        """Accumulated failure score — HIGHER is WORSE; peers at or above
+        bad_peer_threshold are dropped from selection."""
+        return self._peer_score.get(peer, 0)
+
     def peers(self) -> list[bytes]:
         """Connected peers, best score first, chronically bad ones dropped
         from selection entirely."""

@@ -1,0 +1,79 @@
+"""Bootstrapper: generate epoch fallback documents from a node's state.
+
+Reference cmd/bootstrapper (generator.go): an operator-run tool that
+produces the per-epoch JSON the bootstrap updater consumes — a fallback
+beacon and/or active set for epochs where the live protocols might not
+deliver (network halts, emergency restarts). Entropy for a synthesized
+beacon comes from the epoch's ATX id set (the reference uses a bitcoin
+block hash; any operator-auditable public entropy works — pass
+--entropy-hex to override).
+
+  python -m spacemesh_tpu.tools.bootstrapper --state S.db --epoch N \
+      [--out fallback.json] [--beacon] [--activeset] [--entropy-hex H]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+
+def generate(db, epoch: int, *, with_beacon: bool, with_activeset: bool,
+             entropy: bytes = b"") -> dict:
+    from ..core.hashing import sum256
+    from ..storage import atxs as atxstore
+    from ..storage import misc as miscstore
+
+    doc: dict = {"epoch": epoch}
+    ids = sorted(atxstore.ids_in_epoch(db, epoch - 1))  # targeting `epoch`
+    if with_beacon:
+        stored = miscstore.get_beacon(db, epoch)
+        if stored is not None:
+            beacon = stored
+        else:
+            beacon = sum256(b"fallback-beacon", entropy, *ids)[:4]
+        doc["beacon"] = beacon.hex()
+    if with_activeset:
+        doc["activeset"] = [i.hex() for i in ids]
+    return doc
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="spacemesh_tpu.tools.bootstrapper")
+    p.add_argument("--state", required=True, help="path to state.db")
+    p.add_argument("--epoch", type=int, required=True)
+    p.add_argument("--out", help="write/merge the doc into this JSON file")
+    p.add_argument("--beacon", action="store_true")
+    p.add_argument("--activeset", action="store_true")
+    p.add_argument("--entropy-hex", default="",
+                   help="public entropy for a synthesized beacon")
+    a = p.parse_args(argv)
+    if not (a.beacon or a.activeset):
+        p.error("pick at least one of --beacon / --activeset")
+
+    from ..storage import db as dbmod
+
+    db = dbmod.open_state(a.state)
+    try:
+        doc = generate(db, a.epoch, with_beacon=a.beacon,
+                       with_activeset=a.activeset,
+                       entropy=bytes.fromhex(a.entropy_hex))
+    finally:
+        db.close()
+
+    if a.out:
+        path = Path(a.out)
+        docs = []
+        if path.exists():
+            existing = json.loads(path.read_text())
+            docs = existing if isinstance(existing, list) else [existing]
+        docs = [d for d in docs if d.get("epoch") != a.epoch] + [doc]
+        path.write_text(json.dumps(docs, indent=1))
+    print(json.dumps(doc))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,87 @@
+"""merge-nodes: fold one node's smeshing identities into another node.
+
+Reference cmd/merge-nodes: an operator combining two smeshers into one
+multi-identity node moves the FROM node's identity keys and POST data
+directories into the TO node's data dir; the node then smeshes for all
+identities (smeshing.num_identities picks how many to load/create, and
+existing key files are always loaded).
+
+  python -m spacemesh_tpu.tools.merge_nodes --from-dir A --to-dir B
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import shutil
+import sys
+from pathlib import Path
+
+
+def merge(from_dir: Path, to_dir: Path) -> dict:
+    moved_keys, moved_post, skipped = [], [], []
+    to_keys = to_dir / "identities"
+    to_keys.mkdir(parents=True, exist_ok=True)
+    existing = {p.read_text().strip() for p in to_keys.glob("*.key")}
+
+    src_keys = sorted((from_dir / "identities").glob("*.key"))
+    if not src_keys:
+        raise SystemExit(f"no identity keys under {from_dir}/identities")
+    next_idx = len(list(to_keys.glob("*.key")))
+    for key_file in src_keys:
+        seed = key_file.read_text().strip()
+        if seed in existing:
+            skipped.append(key_file.name)
+            continue
+        # never overwrite: existing names may be non-contiguous (deleted
+        # keys, partial merges) — an overwritten identity key is an
+        # irrecoverable loss
+        dest = to_keys / f"local_{next_idx:02d}.key"
+        while dest.exists():
+            next_idx += 1
+            dest = to_keys / f"local_{next_idx:02d}.key"
+        shutil.copy2(key_file, dest)
+        dest.chmod(0o600)
+        moved_keys.append(dest.name)
+        next_idx += 1
+        # MOVE semantics (reference cmd/merge-nodes): the source must not
+        # keep a usable copy — two nodes smeshing the same identity is
+        # self-equivocation and gets the identity slashed
+        key_file.rename(key_file.with_suffix(".key.merged"))
+
+    src_post = from_dir / "post"
+    if src_post.is_dir():
+        dst_post = to_dir / "post"
+        dst_post.mkdir(parents=True, exist_ok=True)
+        for d in sorted(src_post.iterdir()):
+            if not d.is_dir():
+                continue
+            target = dst_post / d.name
+            if target.exists():
+                skipped.append(f"post/{d.name}")
+                continue
+            shutil.move(str(d), str(target))  # move, not copy (see keys)
+            moved_post.append(d.name)
+
+    return {"keys_merged": moved_keys, "post_dirs_merged": moved_post,
+            "skipped": skipped,
+            "total_identities": len(list(to_keys.glob("*.key")))}
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="spacemesh_tpu.tools.merge_nodes")
+    p.add_argument("--from-dir", required=True,
+                   help="data dir whose identities move")
+    p.add_argument("--to-dir", required=True,
+                   help="data dir that will host them")
+    a = p.parse_args(argv)
+    result = merge(Path(a.from_dir), Path(a.to_dir))
+    print(json.dumps(result))
+    print(f"note: set smeshing.num_identities>="
+          f"{result['total_identities']} on the target node",
+          file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

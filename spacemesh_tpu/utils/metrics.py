@@ -135,3 +135,9 @@ post_init_seconds = REGISTRY.histogram("post_init_seconds",
 proofs_generated = REGISTRY.counter("post_proofs_generated", "proofs made")
 proofs_verified = REGISTRY.counter("post_proofs_verified",
                                    "proofs verified (label=result)")
+peers_gauge = REGISTRY.gauge("p2p_connected_peers", "connected peers")
+sync_state_gauge = REGISTRY.gauge(
+    "sync_state", "0 notSynced, 1 gossipSync, 2 synced")
+tortoise_mode_gauge = REGISTRY.gauge(
+    "tortoise_mode", "0 verifying, 1 full (reference tortoise/metrics.go)")
+applied_gauge = REGISTRY.gauge("mesh_last_applied_layer", "applied frontier")

@@ -1,0 +1,142 @@
+"""Operator tools (bootstrapper, merge-nodes) + codec fuzzing.
+
+Codec fuzz mirrors the reference's gofuzz seeds over SCALE codecs: random
+and mutated bytes must raise DecodeError/ValueError, never crash, and
+every wire type must round-trip exactly.
+"""
+
+import json
+import random
+
+from spacemesh_tpu.core import codec
+from spacemesh_tpu.core.hashing import sum256
+from spacemesh_tpu.core.signing import EdSigner
+from spacemesh_tpu.storage import db as dbmod
+from spacemesh_tpu.tools import bootstrapper, merge_nodes
+
+
+def test_bootstrapper_generates_epoch_doc(tmp_path):
+    from spacemesh_tpu.storage import misc as miscstore
+
+    db = dbmod.open_state(str(tmp_path / "state.db"))
+    miscstore.set_beacon(db, 7, b"\xaa\xbb\xcc\xdd")
+    db.close()
+
+    out = tmp_path / "fallback.json"
+    rc = bootstrapper.main(["--state", str(tmp_path / "state.db"),
+                            "--epoch", "7", "--beacon", "--activeset",
+                            "--out", str(out)])
+    assert rc == 0
+    docs = json.loads(out.read_text())
+    assert docs[0]["epoch"] == 7
+    assert docs[0]["beacon"] == "aabbccdd"  # stored beacon wins
+    # the doc feeds straight into the updater
+    from spacemesh_tpu.node.bootstrap import BootstrapUpdater
+
+    got = []
+    upd = BootstrapUpdater(str(out), on_beacon=lambda e, b: got.append((e, b)))
+    assert upd.poll_once() == 1
+    assert got == [(7, b"\xaa\xbb\xcc\xdd")]
+
+
+def test_merge_nodes_moves_identities(tmp_path):
+    a, b = tmp_path / "a", tmp_path / "b"
+    for d, n in ((a, 2), (b, 1)):
+        (d / "identities").mkdir(parents=True)
+        for i in range(n):
+            name = "local.key" if i == 0 else f"local_{i:02d}.key"
+            (d / "identities" / name).write_text(
+                EdSigner().private_bytes().hex())
+        (d / "post" / f"id{d.name}").mkdir(parents=True)
+        (d / "post" / f"id{d.name}" / "postdata_metadata.json").write_text("{}")
+
+    result = merge_nodes.merge(a, b)
+    assert result["total_identities"] == 3
+    assert len(result["keys_merged"]) == 2
+    assert result["post_dirs_merged"] == ["ida"]
+    # MOVE semantics: the source must not retain usable keys/data (two
+    # nodes smeshing one identity would self-equivocate) — the source
+    # keys are renamed away and the post dirs moved
+    assert list((a / "identities").glob("*.key")) == []
+    assert len(list((a / "identities").glob("*.key.merged"))) == 2
+    assert not (a / "post" / "ida").exists()
+    # and existing target keys are never overwritten
+    assert (b / "identities" / "local.key").exists()
+
+
+WIRE_TYPES = None
+
+
+def _wire_samples():
+    """One valid instance per registered wire type (encode side)."""
+    from spacemesh_tpu.consensus.beacon import (
+        BeaconProposal, FirstVotes, FollowVotes, WeakCoinMsg)
+    from spacemesh_tpu.consensus.hare import CompactHareMessage, HareMessage
+    from spacemesh_tpu.core.types import (
+        ActivationTxV2, MarriageCert, MerkleProof, NIPost, Post,
+        PostMetadataWire, SubPostV2)
+
+    h = sum256(b"fuzz")
+    nipost = NIPost(membership=MerkleProof(leaf_index=1, nodes=[h]),
+                    post=Post(nonce=3, indices=[1, 5, 9], pow_nonce=7),
+                    post_metadata=PostMetadataWire(challenge=h,
+                                                   labels_per_unit=64))
+    return [
+        HareMessage(layer=4, iteration=0, round=2, values=[h],
+                    eligibility_proof=bytes(80), eligibility_count=2,
+                    atx_id=h, node_id=h, cert_msgs=[b"x"],
+                    signature=bytes(64)),
+        CompactHareMessage(layer=4, iteration=1, round=3,
+                           compact_ids=[h[:4]], root=h,
+                           eligibility_proof=bytes(80),
+                           eligibility_count=2, atx_id=h, node_id=h,
+                           cert_msgs=[], signature=bytes(64)),
+        BeaconProposal(epoch=2, atx_id=h, node_id=h, vrf_proof=bytes(80)),
+        FirstVotes(epoch=2, valid=[h], late=[], atx_id=h, node_id=h,
+                   signature=bytes(64)),
+        FollowVotes(epoch=2, round=1, votes_for=[h], atx_id=h, node_id=h,
+                    signature=bytes(64)),
+        WeakCoinMsg(epoch=2, round=1, atx_id=h, node_id=h,
+                    vrf_proof=bytes(80)),
+        ActivationTxV2(publish_epoch=1, pos_atx=h, coinbase=bytes(24),
+                       marriages=[MarriageCert(partner_id=h,
+                                               signature=bytes(64))],
+                       subposts=[SubPostV2(node_id=h, prev_atx=h,
+                                           num_units=1, vrf_nonce=9,
+                                           nipost=nipost)],
+                       node_id=h, signature=bytes(64)),
+    ]
+
+
+def test_wire_roundtrips():
+    for sample in _wire_samples():
+        cls = type(sample)
+        assert cls.from_bytes(sample.to_bytes()) == sample, cls.__name__
+
+
+def test_fuzz_decoders_never_crash():
+    """Random + truncated + bit-flipped inputs: DecodeError/ValueError
+    only — a malformed gossip blob must never take the node down."""
+    rng = random.Random(1234)
+    samples = _wire_samples()
+    classes = [type(s) for s in samples]
+    blobs = [s.to_bytes() for s in samples]
+    trials = 0
+    for _ in range(300):
+        kind = rng.randrange(3)
+        if kind == 0:       # pure noise
+            data = bytes(rng.getrandbits(8) for _ in range(rng.randrange(200)))
+        elif kind == 1:     # truncation of a valid blob
+            base = rng.choice(blobs)
+            data = base[:rng.randrange(len(base))]
+        else:               # bit flip in a valid blob
+            base = bytearray(rng.choice(blobs))
+            base[rng.randrange(len(base))] ^= 1 << rng.randrange(8)
+            data = bytes(base)
+        for cls in classes:
+            trials += 1
+            try:
+                cls.from_bytes(data)
+            except (codec.DecodeError, ValueError, OverflowError):
+                pass  # the ONLY acceptable failures
+    assert trials > 1000
