@@ -29,6 +29,9 @@ def main(argv=None) -> int:
                    "the TCP transport)")
     p.add_argument("--bootnode", action="append", default=[],
                    help="bootstrap peer host:port (repeatable)")
+    p.add_argument("--profile", metavar="OUT.pstats",
+                   help="profile the node and dump cProfile stats on exit "
+                        "(the reference's pprof analogue, node.go:2121)")
     a = p.parse_args(argv)
 
     from .app import App
@@ -88,11 +91,23 @@ def main(argv=None) -> int:
                 await app.api.stop()  # stop accepting before the DB closes
             app.close()
 
+    profiler = None
+    if a.profile:
+        import cProfile
+
+        profiler = cProfile.Profile()
+        profiler.enable()
     try:
         asyncio.run(go())
     except KeyboardInterrupt:
         print("interrupted", file=sys.stderr)
         return 130
+    finally:
+        if profiler is not None:
+            profiler.disable()
+            profiler.dump_stats(a.profile)
+            print(json.dumps({"event": "ProfileWritten",
+                              "path": a.profile}), flush=True)
     return 0
 
 

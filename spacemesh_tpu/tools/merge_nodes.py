@@ -31,8 +31,13 @@ def merge(from_dir: Path, to_dir: Path) -> dict:
     for key_file in src_keys:
         seed = key_file.read_text().strip()
         if seed in existing:
+            # the target already holds this identity (e.g. an interrupted
+            # earlier merge): still NEUTRALIZE the source copy — leaving
+            # it usable means two nodes smeshing one identity
+            key_file.rename(key_file.with_suffix(".key.merged"))
             skipped.append(key_file.name)
             continue
+        existing.add(seed)  # duplicate seeds within from-dir merge once
         # never overwrite: existing names may be non-contiguous (deleted
         # keys, partial merges) — an overwritten identity key is an
         # irrecoverable loss

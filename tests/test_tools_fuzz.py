@@ -64,9 +64,6 @@ def test_merge_nodes_moves_identities(tmp_path):
     assert (b / "identities" / "local.key").exists()
 
 
-WIRE_TYPES = None
-
-
 def _wire_samples():
     """One valid instance per registered wire type (encode side)."""
     from spacemesh_tpu.consensus.beacon import (
