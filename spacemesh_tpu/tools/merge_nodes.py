@@ -19,6 +19,9 @@ from pathlib import Path
 
 
 def merge(from_dir: Path, to_dir: Path) -> dict:
+    if from_dir.resolve() == to_dir.resolve():
+        # neutralize-on-skip would otherwise rename EVERY key away
+        raise SystemExit("from-dir and to-dir are the same directory")
     moved_keys, moved_post, skipped = [], [], []
     to_keys = to_dir / "identities"
     to_keys.mkdir(parents=True, exist_ok=True)

@@ -1,0 +1,80 @@
+"""BASELINE config 5 shape: 16 smeshers in ONE node, 16 ATXs per epoch.
+
+VERDICT round-2 item 6 "done" criterion. Tiny POST geometry stands in for
+4 SU each (the kernels' per-lane commitment batching is exercised by
+tests/test_parallel.py on the virtual 8-device mesh; this test proves the
+NODE hosts 16 identities end to end: 16 inits, one shared poet round per
+epoch, 16 proofs, 16 valid ATXs, all signers participating in hare).
+"""
+
+import asyncio
+import time
+
+import pytest
+
+from spacemesh_tpu.node import clock as clock_mod
+from spacemesh_tpu.node.app import App
+from spacemesh_tpu.node.config import load
+from spacemesh_tpu.storage import atxs as atxstore
+from spacemesh_tpu.storage import layers as layerstore
+
+LPE = 3
+LAYER_SEC = 1.2
+N_IDS = 16
+
+
+@pytest.fixture(scope="module")
+def ran(tmp_path_factory):
+    tmp_path = tmp_path_factory.mktemp("sixteen")
+    cfg = load("standalone", overrides={
+        "data_dir": str(tmp_path / "node"),
+        "layer_duration": LAYER_SEC,
+        "layers_per_epoch": LPE,
+        "slots_per_layer": 2,
+        "genesis": {"time": time.time() + 3600},
+        "post": {"labels_per_unit": 256, "scrypt_n": 2, "k1": 64, "k2": 8,
+                 "k3": 4, "min_num_units": 1,
+                 "pow_difficulty": "20" + "ff" * 31},
+        "smeshing": {"start": True, "num_units": 1, "init_batch": 256,
+                     "num_identities": N_IDS},
+        "hare": {"committee_size": 32, "round_duration": 0.15,
+                 "preround_delay": 0.4, "iteration_limit": 2},
+        "beacon": {"proposal_duration": 0.15},
+        "tortoise": {"hdist": 4, "window_size": 50},
+    })
+    app = App(cfg)
+
+    async def go():
+        await app.prepare()   # 16 inits + 16 initial proofs (epoch 0)
+        app.clock = clock_mod.LayerClock(time.time() + 0.3,
+                                         cfg.layer_duration)
+        # one full layer into epoch 2: epoch-1 ATXs (published during
+        # layers 3-5) need the boundary slack on slow machines
+        await asyncio.wait_for(app.run(until_layer=2 * LPE), timeout=300)
+
+    try:
+        asyncio.run(go())
+        yield app
+    finally:
+        app.close()
+
+
+def test_sixteen_atxs_per_epoch(ran):
+    for epoch in (0, 1):
+        published = [s for s in ran.signers
+                     if atxstore.by_node_in_epoch(ran.state, s.node_id,
+                                                  epoch) is not None]
+        assert len(published) == N_IDS, (
+            f"epoch {epoch}: only {len(published)}/{N_IDS} ATXs")
+
+
+def test_all_identities_in_cache_with_weight(ran):
+    for s in ran.signers:
+        view = atxstore.by_node_in_epoch(ran.state, s.node_id, 0)
+        info = ran.cache.get(1, view.id)
+        assert info is not None and info.weight > 0
+        assert info.vrf_public_key == s.node_id
+
+
+def test_consensus_survived_sixteen_way_weight_split(ran):
+    assert layerstore.last_applied(ran.state) >= LPE + 1
