@@ -144,6 +144,46 @@ def test_drop_peer_on_repeated_validation_reject():
     asyncio.run(asyncio.wait_for(go(), 30))
 
 
+def test_reconnects_to_restarted_peer():
+    """A crashed peer that comes back on the SAME address is redialed by
+    the maintainer loop (known-addr redial; reference reconnect/bootstrap
+    retry behavior)."""
+
+    async def go():
+        a, psa, _ = _mk(b"a", min_peers=2)
+        b, psb, _ = _mk(b"b")
+        got = []
+
+        async def hb(peer, data):
+            got.append(data)
+            return True
+
+        psb.register("t9", hb)
+        await a.start()
+        addr_b = await b.start()
+        a._known[(addr_b[0], addr_b[1])] = 0.0  # seed the known-addr table
+        await _wait(lambda: len(a.nodes) >= 1)
+
+        # B "crashes"
+        await b.stop()
+        await _wait(lambda: len(a.nodes) == 0, timeout=10)
+
+        # ...and restarts on the same port
+        b2, psb2, _ = _mk(b"b")
+        psb2.register("t9", hb)
+        b2.listen = f"{addr_b[0]}:{addr_b[1]}"
+        await b2.start()
+        # A's maintainer redials the known address
+        await _wait(lambda: len(a.nodes) >= 1, timeout=15)
+        await psa.publish("t9", b"hello-again")
+        await _wait(lambda: got)
+        assert got == [b"hello-again"]
+        await a.stop()
+        await b2.stop()
+
+    asyncio.run(asyncio.wait_for(go(), 40))
+
+
 def test_peer_exchange_discovers_third_node():
     """C bootstraps only to B but learns A's address and dials it."""
 
