@@ -213,8 +213,11 @@ class ProtocolDriver:
     def _record_fallback(self, epoch: int, reason: str) -> None:
         log.warning("epoch %d: beacon fallback (%s)", epoch, reason)
         if miscstore.get_beacon(self.db, epoch) is None:
+            # GUESS, not FALLBACK: this is a locally-derived provisional
+            # value, which the protocol (or any network adoption) may
+            # overwrite
             miscstore.set_beacon(self.db, epoch, self._bootstrap(epoch),
-                                 source=miscstore.BEACON_FALLBACK)
+                                 source=miscstore.BEACON_GUESS)
         if self.on_fallback_used:
             self.on_fallback_used(epoch, reason)
         self._ready.setdefault(epoch, asyncio.Event()).set()
@@ -346,7 +349,18 @@ class ProtocolDriver:
             return self._bootstrap(epoch)
         stored = miscstore.get_beacon(self.db, epoch)
         if stored is not None:
-            return stored
+            if (miscstore.beacon_source(self.db, epoch)
+                    != miscstore.BEACON_GUESS):
+                # final, or a NETWORK-adopted fallback (sync majority /
+                # checkpoint / bootstrap file): a late joiner re-running
+                # the protocol solo would overwrite the network's value
+                # with a self-derived one and mark it final
+                # (code-review r3)
+                return stored
+            # stored is OUR OWN timeout-guess (an early get() fell back
+            # to the local bootstrap derivation): the protocol hasn't
+            # actually run — run it and let the decided value overwrite
+            # the provisional one (ADVICE r2)
         if participants is None:
             participants = ([(signer, vrf_signer, atx_id)]
                             if atx_id is not None else [])

@@ -115,6 +115,15 @@ P_FULL_EXCHANGE = "hf/1"   # (layer, iteration, round, node_id) -> full ids
 class ConsensusOutput:
     layer: int
     proposals: list[bytes]       # agreed proposal ids (may be empty)
+    # False when the session hit its iteration limit WITHOUT agreement:
+    # the layer is undecided and belongs to the tortoise, which is a
+    # different thing from hare positively agreeing on "empty"
+    # (reference hare reports no output on failure; layerpatrol hands
+    # the layer to the syncer/tortoise)
+    completed: bool = True
+    # weak coin for the layer: LSB of the lowest preround eligibility
+    # VRF seen (reference hare weakcoin; tortoise healing tie-break)
+    coin: Optional[bool] = None
 
 
 @dataclasses.dataclass
@@ -147,6 +156,7 @@ class HareSession:
         self.seen: dict[tuple, tuple[bytes, bytes]] = {}  # equivocation watch
         self.excluded: set[bytes] = set()  # equivocators: zero weight
         self.layer_start: float | None = None  # set when the driver runs
+        self.coin_vrf: Optional[bytes] = None  # lowest preround VRF output
 
     # --- timing (grade windows) ------------------------------------
 
@@ -196,6 +206,13 @@ class HareSession:
         w = msg.eligibility_count
         if msg.round == PREROUND:
             self.preround_sets[msg.node_id] = (w, msg.values)
+            # weak coin: lowest preround VRF output's LSB (reference
+            # hare weakcoin — unforgeable, shared by every listener)
+            from ..core.signing import vrf_output
+
+            out = vrf_output(msg.eligibility_proof)
+            if self.coin_vrf is None or out < self.coin_vrf:
+                self.coin_vrf = out
         elif msg.round == PROPOSE:
             # leader = lowest VRF output among eligible proposers
             # (reference hare3 leader rule; ADVICE r1 — first-arrival was
@@ -621,8 +638,11 @@ class Hare:
                     session.output = list(values)
                     break
 
-        out = ConsensusOutput(layer=layer,
-                              proposals=session.output or [])
+        out = ConsensusOutput(
+            layer=layer, proposals=session.output or [],
+            completed=session.output is not None,
+            coin=(bool(session.coin_vrf[-1] & 1)
+                  if session.coin_vrf is not None else None))
         await self.on_output(out)
         del self.sessions[layer]
         return out

@@ -132,14 +132,6 @@ class Mesh:
                 self.latest_applied = layer
         layerstore.set_processed(self.db, layer)
 
-    def revert_to(self, layer: int) -> None:
-        """Roll the applied frontier back to ``layer`` (fork recovery):
-        state, layer rows, AND the in-memory frontier — callers must not
-        touch the executor directly or process_hare_output's frontier
-        check goes stale."""
-        self.executor.revert(layer)
-        self.latest_applied = min(self.latest_applied, max(layer, 0))
-
     def process_layer(self, layer: int) -> None:
         """Tortoise-driven path: tally votes, apply validity updates,
         revert + reapply on opinion change (reference mesh.go:302)."""
@@ -158,21 +150,59 @@ class Mesh:
                     min_changed = upd.layer
         if min_changed is not None:
             self._reapply_from(min_changed)
+        # advance the applied frontier through tortoise-DECIDED layers:
+        # a layer whose hare never concluded stalls the hare fast path
+        # forever; once the tortoise verifies it (margins/healing), the
+        # mesh must apply it (reference mesh.go:302 ProcessLayer applies
+        # up to the verified frontier)
+        nxt = self.latest_applied + 1
+        while nxt <= self.tortoise.verified:
+            bid = self._block_to_apply(nxt)
+            if bid == EMPTY:
+                self.executor.execute_empty(nxt)
+            else:
+                block = self._executable(bid)
+                if block is None:
+                    break  # content/txs not fetched yet: retry next pass
+                self.executor.execute(block)
+            layerstore.set_processed(self.db, nxt)
+            self.latest_applied = nxt
+            nxt += 1
 
     def _block_to_apply(self, layer: int) -> bytes:
         valid = self.tortoise.valid_blocks(layer)
         return valid[0] if valid else EMPTY
 
+    def _executable(self, bid: bytes) -> Optional[Block]:
+        """The block, if its content AND all its txs are local. Executing
+        with missing txs silently diverges the state root (Executor
+        skips unknown txs); callers must defer instead — the sync path
+        refetches and retries (code-review r3)."""
+        block = blockstore.get(self.db, bid)
+        if block is None:
+            return None
+        for tx_id in block.tx_ids:
+            if self.executor.cstate.get(tx_id) is None:
+                return None
+        return block
+
     def _reapply_from(self, layer: int) -> None:
         self.executor.revert(layer - 1)
-        for lyr in range(layer, self.latest_applied + 1):
+        target = self.latest_applied
+        self.latest_applied = layer - 1
+        for lyr in range(layer, target + 1):
             bid = self._block_to_apply(lyr)
             if bid == EMPTY:
                 self.executor.execute_empty(lyr)
             else:
-                block = blockstore.get(self.db, bid)
-                if block is not None:
-                    self.executor.execute(block)
+                block = self._executable(bid)
+                if block is None:
+                    # content/txs not local yet: stop here — the frontier
+                    # reflects what is actually applied; the next sync
+                    # pass fetches and resumes
+                    return
+                self.executor.execute(block)
             # revert dropped the layer rows; the re-executed layers are
             # processed again (keeps the processed frontier monotone)
             layerstore.set_processed(self.db, lyr)
+            self.latest_applied = lyr

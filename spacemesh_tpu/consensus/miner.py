@@ -41,6 +41,13 @@ from .tortoise import Tortoise
 MAX_TXS_PER_PROPOSAL = 700
 
 
+class _BadBeacon(str):
+    """Truthy sentinel: ballot ingested but its beacon mismatches ours."""
+
+
+BAD_BEACON = _BadBeacon("bad-beacon")
+
+
 def active_set_root(atx_ids: list[bytes]) -> bytes:
     return sum256(*sorted(atx_ids)) if atx_ids else bytes(32)
 
@@ -145,7 +152,9 @@ class ProposalHandler:
     async def ingest_ballot(self, ballot) -> bool:
         """Full ballot validation + store + tortoise feed. ONE path for
         gossip proposals and synced ballots — sync must not be a weaker
-        copy of the gossip checks."""
+        copy of the gossip checks. Returns False (rejected), True
+        (ingested), or BAD_BEACON (ingested, truthy, but the ballot's
+        beacon mismatches ours — its proposal must not feed hare)."""
         if not self.verifier.verify(Domain.BALLOT, ballot.node_id,
                                     ballot.signed_bytes(), ballot.signature):
             return False
@@ -153,7 +162,25 @@ class ProposalHandler:
         info = self.cache.get(epoch, ballot.atx_id)
         if info is None or info.node_id != ballot.node_id:
             return False
-        beacon = await self.beacon_getter(epoch)
+        # eligibility verifies against the ballot's DECLARED beacon (its
+        # own EpochData, or its ref ballot's) — reference
+        # proposals/handler + miner/oracle semantics. A beacon MISMATCH
+        # with our epoch beacon doesn't reject the ballot: it is
+        # ingested with bad_beacon=True and its tortoise votes are
+        # delayed (tortoise.go BadBeaconVoteDelayLayers), so the
+        # majority chain's ballots survive a local beacon divergence
+        # while a grinding adversary can't steer margins immediately.
+        local_beacon = await self.beacon_getter(epoch)
+        declared = None
+        if ballot.epoch_data is not None:
+            declared = ballot.epoch_data.beacon
+        else:
+            ref = ballotstore.get(self.db, ballot.ref_ballot)
+            if ref is not None and ref.epoch_data is not None \
+                    and ref.node_id == ballot.node_id:
+                declared = ref.epoch_data.beacon
+        beacon = declared if declared is not None else local_beacon
+        bad_beacon = declared is not None and declared != local_beacon
         for el in ballot.eligibilities:
             if not self.oracle.validate_slot(beacon, epoch, ballot.atx_id,
                                              ballot.layer, el.j, el.sig):
@@ -171,8 +198,9 @@ class ProposalHandler:
             ballotstore.add(self.db, ballot)
         num_slots = self.oracle.num_slots(epoch, ballot.atx_id)
         unit = info.weight // max(num_slots, 1)
-        self.tortoise.on_ballot(ballot, unit * len(ballot.eligibilities))
-        return True
+        self.tortoise.on_ballot(ballot, unit * len(ballot.eligibilities),
+                                bad_beacon=bad_beacon)
+        return True if not bad_beacon else BAD_BEACON
 
     async def process(self, proposal: Proposal) -> bool:
         ballot = proposal.ballot
@@ -180,7 +208,12 @@ class ProposalHandler:
                                     proposal.signed_bytes(),
                                     proposal.signature):
             return False
-        if not await self.ingest_ballot(ballot):
+        ok = await self.ingest_ballot(ballot)
+        if not ok:
             return False
-        self.store.add(proposal)
+        if ok is not BAD_BEACON:
+            # only good-beacon proposals feed hare's candidate pool —
+            # a ground beacon must not buy hare influence (reference:
+            # hare only counts proposals matching the local beacon)
+            self.store.add(proposal)
         return True

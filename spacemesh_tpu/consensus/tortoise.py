@@ -19,11 +19,26 @@ prescribes (the reference walks ballot graphs in Go; this formulation
 lets numpy/XLA tile the count — BenchmarkTallyVotes territory).
 
 Decision rule per block (reference semantics):
-  margin >  threshold            -> valid      (verifying mode)
-  margin < -threshold            -> invalid
+  margin >  global threshold     -> valid      (verifying mode)
+  margin < -global threshold     -> invalid
   within hdist and hare decided  -> hare's opinion   (hare trust)
-  older than hdist+zdist         -> sign of margin   (full/healing mode)
+  older than hdist+zdist         -> full/healing mode:
+      |margin| > local threshold -> sign of margin (tortoise/full.go)
+      else                       -> weak coin of the latest layer
+                                    (tortoise/tortoise.go:287-306
+                                    getFullVote reasonCoinflip)
   otherwise                      -> undecided (frontier stops)
+
+Thresholds (reference tortoise/threshold.go): the LOCAL threshold is
+one layer's expected weight / 3 (localThresholdFraction); the GLOBAL
+threshold is the expected weight in (target, last] / 3
+(adversarialWeightFraction) + local.
+
+Ballots whose beacon mismatches the epoch beacon vote at zero weight
+until ``bad_beacon_delay`` layers have passed (reference
+tortoise/tortoise.go:198 checkBallotAndVotes + BadBeaconVoteDelayLayers,
+algorithm config) — a grinding adversary can't steer margins with
+wrong-beacon ballots inside the confidence window.
 
 Support votes for blocks not yet known are kept PENDING and resolved when
 the block arrives (round-1 advisor fix: they must not silently count as
@@ -56,6 +71,7 @@ class BallotInfo:
     supports: dict[int, set[bytes]]
     abstains: set[int]
     malicious: bool = False
+    bad_beacon: bool = False
 
 
 @dataclasses.dataclass
@@ -68,11 +84,16 @@ class Update:
 class Tortoise:
     def __init__(self, cache: AtxCache, layers_per_epoch: int, hdist: int = 10,
                  window: int = 1000, zdist: int = 8,
+                 bad_beacon_delay: int | None = None,
                  tracer: Optional[Callable[[str], None]] = None):
         self.cache = cache
         self.layers_per_epoch = layers_per_epoch
         self.hdist = hdist
         self.zdist = zdist
+        # reference BadBeaconVoteDelayLayers (tortoise config): how long
+        # wrong-beacon ballots stay muted; defaults to zdist
+        self.bad_beacon_delay = zdist if bad_beacon_delay is None \
+            else bad_beacon_delay
         self.window = window
         self._trace = tracer
         self.verified = 0           # highest fully-decided layer
@@ -81,6 +102,7 @@ class Tortoise:
         # --- array state (the vote matrix) ---
         self._V = np.zeros((256, 256), np.int8)
         self._weights = np.zeros(256, np.int64)
+        self._bad_beacon_row = np.zeros(256, bool)
         self._row_layer = np.zeros(256, np.int32)
         self._col_layer = np.zeros(256, np.int32)
         self._rows = 0
@@ -93,11 +115,24 @@ class Tortoise:
         self._ballot_row: dict[bytes, int] = {}
         self._node_rows: dict[bytes, list[int]] = {}
         self._pending: dict[bytes, set[bytes]] = {}    # block id -> ballots
+        # ballots whose BASE ballot hasn't arrived yet: ingesting them
+        # now would lose the base chain's inherited support and count it
+        # as against (the reference decodes votes against the base and
+        # errors on a missing one, tortoise/state.go decodeVotes) —
+        # queue until the base shows up
+        self._pending_base: dict[bytes, list[tuple]] = {}
         # --- object state ---
         self._ballots: dict[bytes, BallotInfo] = {}
         self._ballots_by_layer: dict[int, list[bytes]] = {}
+        # layers at/below the verified frontier touched by LATE evidence
+        # (a block or ballot votes arriving after verification — fork
+        # healing): tally must re-examine them, the reference emits
+        # validity updates below verified and the mesh reverts
+        # (tortoise results/mesh.go:302)
+        self._dirty: int | None = None
         self._blocks: dict[int, set[bytes]] = {}
         self._hare: dict[int, bytes] = {}
+        self._coin: dict[int, bool] = {}   # layer -> weak coin
         self._validity: dict[bytes, bool] = {}
         self._updates: list[Update] = []
         self._t("init", lpe=layers_per_epoch, hdist=hdist, zdist=zdist,
@@ -118,6 +153,8 @@ class Tortoise:
         self._V = np.vstack([self._V, np.zeros_like(self._V)])
         self._weights = np.resize(self._weights, cap)
         self._weights[self._rows:] = 0
+        self._bad_beacon_row = np.resize(self._bad_beacon_row, cap)
+        self._bad_beacon_row[self._rows:] = False
         self._row_layer = np.resize(self._row_layer, cap)
         self._row_layer[self._rows:] = 0
         for lyr, arr in self._abstain.items():
@@ -140,10 +177,16 @@ class Tortoise:
 
     # --- inputs --------------------------------------------------------
 
+    def _mark_dirty(self, layer: int) -> None:
+        if layer <= self.verified:
+            self._dirty = layer if self._dirty is None \
+                else min(self._dirty, layer)
+
     def on_block(self, layer: int, block_id: bytes) -> None:
         if block_id in self._col_of:
             return
         self._t("block", layer=layer, id=block_id)
+        self._mark_dirty(layer)
         self._blocks.setdefault(layer, set()).add(block_id)
         if self._cols == self._V.shape[1]:
             self._grow_cols()
@@ -176,6 +219,13 @@ class Tortoise:
         self._t("hare", layer=layer, id=block_id)
         self._hare[layer] = block_id
 
+    def on_weak_coin(self, layer: int, coin: bool) -> None:
+        """Per-layer weak coin from hare's preround VRFs (reference
+        tortoise/tortoise.go:303 layer.coinflip; the coin of the LATEST
+        layer breaks zero-margin ties during healing)."""
+        self._t("coin", layer=layer, coin=coin)
+        self._coin[layer] = coin
+
     def on_malfeasance(self, node_id: bytes) -> None:
         self._t("malfeasance", id=node_id)
         self.cache.set_malicious(node_id)
@@ -185,17 +235,54 @@ class Tortoise:
             if info.node_id == node_id:
                 info.malicious = True
 
-    def on_ballot(self, ballot: Ballot, weight: int) -> None:
+    def on_ballot(self, ballot: Ballot, weight: int,
+                  bad_beacon: bool = False) -> None:
         """Resolve the ballot's opinion against its base and store it."""
         self._ingest(ballot.id, ballot.layer, ballot.node_id,
-                     ballot.opinion, weight)
+                     ballot.opinion, weight, bad_beacon=bad_beacon)
 
     def _ingest(self, bid: bytes, layer: int, node_id: bytes,
-                opinion: Opinion, weight: int) -> None:
-        if bid in self._ballots:
+                opinion: Opinion, weight: int,
+                bad_beacon: bool = False) -> None:
+        if not self._ingest_one(bid, layer, node_id, opinion, weight,
+                                bad_beacon):
             return
+        # resolve ballots that were waiting for an ingested ballot as
+        # their base — ITERATIVE worklist, one stack frame total: a
+        # reverse-ordered chain as long as the queue cap must not
+        # recurse (code-review r3: per-link recursion hit Python's
+        # limit on ~1000-deep backfills)
+        work = self._pending_base.pop(bid, [])
+        while work:
+            args = work.pop()
+            if self._ingest_one(*args[:5], bad_beacon=args[5]):
+                winfo = self._ballots.get(args[0])
+                if winfo is not None and args[1] > self.verified:
+                    # a resolved waiter's whole inherited opinion is new
+                    # weight on old layers: late-mark it all
+                    for lyr in winfo.supports:
+                        self._mark_dirty(lyr)
+                    for lyr in winfo.abstains:
+                        self._mark_dirty(lyr)
+                work.extend(self._pending_base.pop(args[0], []))
+
+    def _ingest_one(self, bid: bytes, layer: int, node_id: bytes,
+                    opinion: Opinion, weight: int,
+                    bad_beacon: bool = False) -> bool:
+        """Ingest ONE ballot; True if it landed (False: duplicate or
+        queued behind an unknown base)."""
+        if bid in self._ballots:
+            return False
+        if opinion.base != EMPTY and opinion.base not in self._ballots:
+            # base not here yet (sync/gossip reordering): queue — capped
+            # so unknown-base spam can't grow memory
+            waiters = self._pending_base.setdefault(opinion.base, [])
+            if len(waiters) < 64 and len(self._pending_base) < 4096:
+                waiters.append((bid, layer, node_id, opinion, weight,
+                                bad_beacon))
+            return False
         self._t("ballot", id=bid, layer=layer, node=node_id,
-                weight=weight, base=opinion.base,
+                weight=weight, base=opinion.base, bad=bad_beacon,
                 support=[b.hex() for b in opinion.support],
                 against=[b.hex() for b in opinion.against],
                 abstain=list(opinion.abstain))
@@ -235,7 +322,8 @@ class Tortoise:
         malicious = self.cache.is_malicious(node_id)
         info = BallotInfo(id=bid, layer=layer, weight=weight,
                           node_id=node_id, supports=supports,
-                          abstains=abstains, malicious=malicious)
+                          abstains=abstains, malicious=malicious,
+                          bad_beacon=bad_beacon)
         self._ballots[bid] = info
         self._ballots_by_layer.setdefault(layer, []).append(bid)
 
@@ -248,6 +336,7 @@ class Tortoise:
         self._ballot_row[bid] = row
         self._node_rows.setdefault(node_id, []).append(row)
         self._weights[row] = 0 if malicious else weight
+        self._bad_beacon_row[row] = bad_beacon
         self._row_layer[row] = layer
         c = self._cols
         if c:
@@ -265,19 +354,59 @@ class Tortoise:
                     self._V[row, col] = 1
         for b in pend:
             self._pending.setdefault(b, set()).add(bid)
+        # late votes on already-verified layers force a re-tally there.
+        # A ballot arriving through the NORMAL flow only changes old
+        # margins via its explicit exception lists (inherited supports
+        # repeat its base's already-counted direction), so only the
+        # deltas are dirty-marked; a LATE ballot (backfilled below the
+        # frontier, or resolved from the unknown-base queue) contributes
+        # its whole inherited opinion as new weight — mark it all
+        # (code-review r3: marking inherited supports unconditionally
+        # made every tally rescan the full window)
+        if layer <= self.verified:
+            for lyr in supports:
+                self._mark_dirty(lyr)
+            for lyr in abstains:
+                self._mark_dirty(lyr)
+        else:
+            for b in opinion.support + opinion.against:
+                col = self._col_of.get(b)
+                if col is not None:
+                    self._mark_dirty(int(self._col_layer[col]))
+            for lyr in opinion.abstain:
+                self._mark_dirty(lyr)
+        return True
 
     # --- counting ------------------------------------------------------
 
-    def _threshold(self, target_layer: int, last: int) -> int:
-        """Margin needed: a fraction of the ballot weight expected between
-        the target and the tip (reference tortoise/threshold.go)."""
-        epoch = target_layer // self.layers_per_epoch
-        w = self.cache.epoch_weight(epoch)
+    def _local_threshold(self, last: int) -> int:
+        """One layer's expected weight / 3 (reference
+        tortoise/threshold.go localThresholdFraction;
+        tortoise.go:311-316 updateLast recomputes it per epoch)."""
+        w = self.cache.epoch_weight(last // self.layers_per_epoch)
         if w == 0:
             return 1
-        span = max(last - target_layer, 1)
-        per_layer = w // self.layers_per_epoch or 1
-        return max(per_layer * min(span, self.window) // 3, 1)
+        return max(w // self.layers_per_epoch // 3, 1)
+
+    def _threshold(self, target_layer: int, last: int) -> int:
+        """GLOBAL threshold: expected ballot weight in (target, last] / 3
+        (adversarialWeightFraction) + the local threshold (reference
+        tortoise/threshold.go computeGlobalThreshold; the window caps the
+        span like computeExpectedWeightInWindow). Summed per EPOCH, not
+        per layer — O(epochs-in-span) (code-review r3: a per-layer loop
+        made catch-up tallies O(layers*window))."""
+        span = min(max(last - target_layer, 1), self.window)
+        lpe = self.layers_per_epoch
+        lo, hi = target_layer + 1, target_layer + span  # inclusive range
+        total = 0
+        for epoch in range(lo // lpe, hi // lpe + 1):
+            n_layers = (min(hi, (epoch + 1) * lpe - 1)
+                        - max(lo, epoch * lpe) + 1)
+            if n_layers > 0:
+                total += self.cache.epoch_weight(epoch) // lpe * n_layers
+        if total == 0:
+            return 1
+        return max(total // 3, 1) + self._local_threshold(last)
 
     def _margins(self, layer: int, last: int) -> tuple[list[bytes], np.ndarray]:
         """Margins for every block in ``layer``: one masked mat-vec."""
@@ -286,17 +415,28 @@ class Tortoise:
             return [], np.zeros(0, np.int64)
         n = self._rows
         active = (self._row_layer[:n] > layer) & (self._row_layer[:n] <= last)
-        w = np.where(active, self._weights[:n], 0)
+        # wrong-beacon ballots stay muted until bad_beacon_delay layers
+        # past their own layer (reference BadBeaconVoteDelayLayers)
+        muted = self._bad_beacon_row[:n] & \
+            (last - self._row_layer[:n] <= self.bad_beacon_delay)
+        w = np.where(active & ~muted, self._weights[:n], 0)
         margins = w @ self._V[:n, cols].astype(np.int64)
         return [self._col_block[c] for c in cols], margins
 
     def tally_votes(self, last: int) -> None:
-        """Advance the verified frontier up to ``last`` - 1."""
+        """Advance the verified frontier up to ``last`` - 1; re-examine
+        verified layers marked dirty by late evidence (fork healing)."""
         self.processed = max(self.processed, last)
         self._t("tally", last=last)
-        frontier = self.verified
+        old_verified = self.verified
+        start = old_verified + 1
+        if self._dirty is not None:
+            start = min(start, self._dirty)
+            self._dirty = None
+        frontier = start - 1
+        flipped_below = False  # validity changed at/below old verified
         healed = False
-        for layer in range(self.verified + 1, last):
+        for layer in range(start, last):
             decided_all = True
             t = self._threshold(layer, last)
             heal = last - layer > self.hdist + self.zdist
@@ -310,9 +450,31 @@ class Tortoise:
                 elif last - layer < self.hdist and layer in self._hare:
                     decided = self._hare[layer] == b
                 elif heal:
-                    # full-mode healing: past the confidence window, the
-                    # sign of the global count decides (tortoise/full.go)
-                    decided = margin > 0
+                    # full-mode healing: past the confidence window the
+                    # count decides (tortoise/full.go); a margin inside
+                    # the local threshold is a genuine tie — break it
+                    # with the weak coin of the LATEST layer so every
+                    # node falls on the same side (tortoise.go:287-306
+                    # getFullVote reasonCoinflip)
+                    lt = self._local_threshold(last)
+                    if margin > lt:
+                        decided = True
+                    elif margin < -lt:
+                        decided = False
+                    else:
+                        # latest recorded coin at-or-before last-1: in a
+                        # quiescent net (no hare running) the newest
+                        # shared coin still converges all nodes, where
+                        # strict last-1 would deadlock the frontier
+                        coin = self._coin.get(last - 1)
+                        if coin is None and self._coin:
+                            past = [x for x in self._coin if x <= last - 1]
+                            if past:
+                                coin = self._coin[max(past)]
+                        if coin is None:
+                            decided_all = False
+                            continue
+                        decided = coin
                     healed = True
                 else:
                     decided_all = False
@@ -320,6 +482,8 @@ class Tortoise:
                 if self._validity.get(b) != decided:
                     self._validity[b] = decided
                     self._updates.append(Update(layer, b, decided))
+                    if layer <= self.verified:
+                        flipped_below = True
             if not blocks:
                 # empty layer: decided by hare's "empty", by distance, or
                 # by healing
@@ -330,6 +494,12 @@ class Tortoise:
             if decided_all:
                 frontier = layer
             else:
+                if layer <= old_verified:
+                    # dirty re-tally stopped short of the old frontier:
+                    # keep the remaining region marked or the late
+                    # evidence above this layer is silently forgotten
+                    # (code-review r3)
+                    self._dirty = layer
                 break
         if healed and self.mode != FULL:
             self.mode = FULL
@@ -337,7 +507,13 @@ class Tortoise:
         elif not healed and self.mode != VERIFYING and last - frontier <= self.hdist:
             self.mode = VERIFYING
             self._t("mode", mode=VERIFYING)
-        if frontier != self.verified:
+        if frontier > self.verified or (frontier < self.verified
+                                        and flipped_below):
+            # regression is real only when a validity actually flipped
+            # in the re-examined region; a dirty re-tally that merely
+            # found an old layer momentarily undecidable (e.g. no coin
+            # recorded yet) must not drag the frontier back
+            # (code-review r3)
             self.verified = frontier
             self._t("verified", layer=frontier)
         self._evict()
@@ -372,6 +548,9 @@ class Tortoise:
         self._V = V
         self._weights[:len(keep_rows)] = self._weights[keep_rows]
         self._weights[len(keep_rows):] = 0
+        self._bad_beacon_row[:len(keep_rows)] = \
+            self._bad_beacon_row[keep_rows]
+        self._bad_beacon_row[len(keep_rows):] = False
         self._row_layer[:len(keep_rows)] = self._row_layer[keep_rows]
         self._row_layer[len(keep_rows):] = 0
         self._col_layer[:len(keep_cols)] = self._col_layer[keep_cols]
@@ -392,9 +571,15 @@ class Tortoise:
                 self._node_rows.setdefault(info.node_id, []).append(i)
         for lyr in [x for x in self._abstain if x < low]:
             del self._abstain[lyr]
+        for lyr in [x for x in self._coin if x < low]:
+            del self._coin[lyr]
         # pending votes whose waiters were all evicted can never resolve
         self._pending = {blk: live for blk, ws in self._pending.items()
                          if (live := {b for b in ws if b in self._ballots})}
+        # queued unknown-base ballots older than the window are dead
+        self._pending_base = {
+            base: live for base, ws in self._pending_base.items()
+            if (live := [w for w in ws if w[1] >= low])}
         for lyr, arr in list(self._abstain.items()):
             new = np.zeros(self._V.shape[0], bool)
             for i, r in enumerate(keep_rows):
@@ -495,7 +680,25 @@ class Tortoise:
                     continue
                 num = oracle.num_slots(epoch, ballot.atx_id)
                 unit = info.weight // max(num, 1)
-                t.on_ballot(ballot, unit * len(ballot.eligibilities))
+                # re-derive the bad-beacon flag from storage: the
+                # ballot's declared beacon (own EpochData or its ref
+                # ballot's) vs the stored epoch beacon
+                declared = None
+                if ballot.epoch_data is not None:
+                    declared = ballot.epoch_data.beacon
+                else:
+                    ref = ballotstore.get(db, ballot.ref_ballot)
+                    if ref is not None and ref.epoch_data is not None \
+                            and ref.node_id == ballot.node_id:
+                        # same owner check as the live ingest path
+                        # (miner.ingest_ballot) — recover must not flag
+                        # ballots the live path left unflagged
+                        declared = ref.epoch_data.beacon
+                local = miscstore.get_beacon(db, epoch)
+                bad = (declared is not None and local is not None
+                       and declared != local)
+                t.on_ballot(ballot, unit * len(ballot.eligibilities),
+                            bad_beacon=bad)
         t.processed = processed
         t.verified = max(
             min(layerstore.last_applied(db), processed) - 1, 0)
@@ -527,6 +730,8 @@ def replay_trace(lines, cache: AtxCache | None = None,
             t.on_block(ev["layer"], bytes.fromhex(ev["id"]))
         elif kind == "hare":
             t.on_hare_output(ev["layer"], bytes.fromhex(ev["id"]))
+        elif kind == "coin":
+            t.on_weak_coin(ev["layer"], bool(ev["coin"]))
         elif kind == "malfeasance":
             t.on_malfeasance(bytes.fromhex(ev["id"]))
         elif kind == "ballot":
@@ -536,7 +741,8 @@ def replay_trace(lines, cache: AtxCache | None = None,
                 against=[bytes.fromhex(x) for x in ev["against"]],
                 abstain=list(ev["abstain"]))
             t._ingest(bytes.fromhex(ev["id"]), ev["layer"],
-                      bytes.fromhex(ev["node"]), op, ev["weight"])
+                      bytes.fromhex(ev["node"]), op, ev["weight"],
+                      bad_beacon=bool(ev.get("bad", False)))
         elif kind == "tally":
             t.tally_votes(ev["last"])
     if t is None:

@@ -45,6 +45,7 @@ class Domain(enum.IntEnum):
     MALFEASANCE = 7
     TX = 8               # this framework's tx envelope (vm/vm.py)
     CERTIFY = 9
+    TRANSPORT = 10       # p2p channel-binding signature (p2p/noise.py)
 
 
 # --- ed25519 identity signatures -----------------------------------------

@@ -46,6 +46,7 @@ class App:
                  pubsub: PubSub | None = None,
                  time_source=time.time):
         self.cfg = cfg
+        self.time_source = time_source
         self.data = Path(cfg.data_dir)
         self.data.mkdir(parents=True, exist_ok=True)
         prefix = cfg.genesis.genesis_id
@@ -117,6 +118,7 @@ class App:
             rounds_number=cfg.beacon.rounds_number,
             grace_period=cfg.beacon.grace_period,
             kappa=cfg.beacon.kappa, theta=cfg.beacon.theta,
+            wall=self.time_source,
             on_fallback_used=lambda epoch, reason: self.events.emit(
                 events_mod.BeaconFallback(epoch=epoch, reason=reason)))
         self.post_params = ProofParams(
@@ -212,7 +214,8 @@ class App:
             layers_per_epoch=cfg.layers_per_epoch,
             beacon_of=self.beacon.get, atx_for=self._atx_of,
             proposals_for=self.proposal_store.ids_in_layer,
-            on_output=self._on_hare_output, compact=cfg.hare.compact)
+            on_output=self._on_hare_output, compact=cfg.hare.compact,
+            wall=self.time_source)
         if cfg.poet_servers:
             # external poet daemons (reference activation/poet.go client;
             # multi-poet best-by-ticks, nipost.go getBestProof)
@@ -578,21 +581,39 @@ class App:
                 if data.certified != bytes(32) and \
                         data.certified not in candidates:
                     candidates.insert(0, data.certified)
+            async def txs_ready(block) -> bool:
+                # never execute a block whose txs are still missing —
+                # a divergent state root is silent; defer the layer
+                # so the next sync pass retries the txs
+                missing = [t for t in block.tx_ids
+                           if not txstore_mod.has_tx(self.state, t)]
+                if missing:
+                    got = await self.fetch.get_hashes(
+                        fetch_mod.HINT_TX, missing)
+                    return all(got.values())
+                return True
+
             for cand in candidates:
                 if await adopt_certificate(layer, cand):
                     block = bs.get(self.state, cand)
                     if block is None:
                         continue
-                    # never execute a block whose txs are still missing —
-                    # a divergent state root is silent; defer the layer
-                    # so the next sync pass retries the txs
-                    missing = [t for t in block.tx_ids
-                               if not txstore_mod.has_tx(self.state, t)]
-                    if missing:
-                        got = await self.fetch.get_hashes(
-                            fetch_mod.HINT_TX, missing)
-                        if not all(got.values()):
-                            return
+                    if not await txs_ready(block):
+                        return
+                    self.mesh.process_hare_output(block, layer)
+                    return
+            # no validatable certificate: fall back to TORTOISE validity
+            # (reference syncer/state_syncer.go processLayers applies
+            # tortoise opinions when certificates are absent) — a block
+            # the network applied without certifying, e.g. hare output
+            # minted at a partition-merge instant, still propagates via
+            # the votes of later ballots
+            self.mesh.process_layer(int(self.clock.current_layer()))
+            for vb in self.mesh.tortoise.valid_blocks(layer):
+                block = bs.get(self.state, vb)
+                if block is not None:
+                    if not await txs_ready(block):
+                        return
                     self.mesh.process_hare_output(block, layer)
                     return
             self.mesh.process_hare_output(None, layer)
@@ -669,7 +690,7 @@ class App:
 
         cfg = self.cfg.p2p
         self.host = Host(
-            node_id=self.signer.node_id,
+            signer=self.signer,
             genesis_id=self.cfg.genesis.genesis_id,
             listen=cfg.listen or "127.0.0.1:0",
             bootstrap=cfg.bootnodes,
@@ -699,11 +720,15 @@ class App:
             self.host = None
 
     def _on_fork(self, divergent_layer: int) -> None:
-        """Fork finder hit (reference syncer/find_fork.go): the network's
-        aggregated mesh hash diverges from ours at ``divergent_layer`` —
-        roll the applied state back so the next sync pass refetches and
-        reprocesses from the divergence point."""
-        self.mesh.revert_to(max(divergent_layer - 1, 0))
+        """Fork finder hit (reference syncer/find_fork.go): a peer's
+        aggregated mesh hash diverges from ours at ``divergent_layer``
+        and its chain data has been ingested. Arbitration belongs to the
+        TORTOISE: tally with everything known; if the vote weight favors
+        the other chain, the mesh reverts + reapplies the flipped layers
+        (reference mesh.go:302 ProcessLayer reverts on opinion change).
+        No blind rollback — a peer without ballot weight behind its
+        chain cannot move our applied state."""
+        self.mesh.process_layer(int(self.clock.current_layer()))
 
     # --- handlers ------------------------------------------------------
 
@@ -739,6 +764,17 @@ class App:
         return validity == TxValidity.VALID
 
     async def _on_hare_output(self, out: hare_mod.ConsensusOutput) -> None:
+        if out.coin is not None:
+            self.tortoise.on_weak_coin(out.layer, out.coin)
+        if not out.completed:
+            # hare FAILED (iteration limit, no agreement): the layer is
+            # undecided and belongs to the tortoise — recording a
+            # positive "empty" decision here would poison every vote
+            # within hdist (reference: no hare output; layerpatrol
+            # leaves the layer to the syncer/tortoise)
+            self.events.emit(events_mod.LayerUpdate(layer=out.layer,
+                                                    status="hare_failed"))
+            return
         block = self.generator.process_hare_output(out)
         self.events.emit(events_mod.LayerUpdate(layer=out.layer,
                                                 status="hare_done"))

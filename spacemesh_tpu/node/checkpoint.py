@@ -120,7 +120,10 @@ def recover(db: Database, snapshot: dict, *,
                     atx2.identity_atx_id(sp.node_id).hex(), 0)
                 for sp in atx2.subposts})
         for epoch, beacon in snapshot.get("beacons", {}).items():
-            miscstore.set_beacon(db, int(epoch), bytes.fromhex(beacon))
+            # checkpoint-derived: supersedable, like the 0002 migration's
+            # default for pre-existing rows (ADVICE r2)
+            miscstore.set_beacon(db, int(epoch), bytes.fromhex(beacon),
+                                 source=miscstore.BEACON_FALLBACK)
         for row in own:
             db.exec(
                 "INSERT OR IGNORE INTO atxs (id, node_id, publish_epoch,"

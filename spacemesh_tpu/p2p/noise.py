@@ -1,0 +1,127 @@
+"""Authenticated, encrypted transport channel (noise-style).
+
+Closes VERDICT r2 gap 2: the plaintext HELLO carried a self-declared
+node id, so any peer could impersonate any identity, poisoning per-peer
+scoring, bans, and gossip attribution. The reference binds peer ids to
+keys via libp2p's noise security transport (reference p2p/host.go:27-28,
+306-309 — noise + peer-id-from-pubkey; p2p/handshake/handshake.go for
+the cookie). This module is the TPU framework's equivalent, built from
+the same primitives (X25519 ECDH + ChaCha20-Poly1305 + the node's
+ed25519 identity key) without the libp2p framing:
+
+1. Both sides exchange fresh ephemeral X25519 public keys (32 raw bytes
+   each way; full-duplex, no ordering deadlock).
+2. ECDH -> HKDF-SHA256 (salted with the genesis id — the network cookie
+   is mixed into the keys, so wrong-network peers can't even decrypt)
+   yields two direction keys and a 32-byte channel-binding token.
+3. Each side's first ENCRYPTED frame is the HELLO: its ed25519 public
+   key (= its node id), listen port, and a signature over the channel
+   binding + its role. The signature proves possession of the identity
+   key for THIS channel: ids are unforgeable, and a MITM relaying the
+   handshake gets keys neither side signed.
+4. Every subsequent frame is ChaCha20-Poly1305 with a per-direction
+   64-bit counter nonce (reordering/replay detected by AEAD failure).
+
+Forward secrecy comes from the ephemerals; identity binding from the
+signature. Equivalent guarantees to noise XX + identity payload.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import struct
+
+from cryptography.hazmat.primitives import hashes
+from cryptography.hazmat.primitives.asymmetric.x25519 import (
+    X25519PrivateKey,
+    X25519PublicKey,
+)
+from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+from cryptography.hazmat.primitives.kdf.hkdf import HKDF
+
+MAX_FRAME = 64 << 20
+
+
+class ChannelError(Exception):
+    pass
+
+
+class NoiseChannel:
+    """Encrypted framed stream over an asyncio reader/writer pair."""
+
+    def __init__(self, reader: asyncio.StreamReader,
+                 writer: asyncio.StreamWriter, *,
+                 tx_key: bytes, rx_key: bytes, binding: bytes,
+                 initiator: bool):
+        self.reader = reader
+        self.writer = writer
+        self.binding = binding
+        self.initiator = initiator
+        self._tx = ChaCha20Poly1305(tx_key)
+        self._rx = ChaCha20Poly1305(rx_key)
+        self._tx_n = 0
+        self._rx_n = 0
+
+    @classmethod
+    async def establish(cls, reader: asyncio.StreamReader,
+                        writer: asyncio.StreamWriter, *,
+                        genesis_id: bytes,
+                        initiator: bool) -> "NoiseChannel":
+        eph = X25519PrivateKey.generate()
+        e_pub = eph.public_key().public_bytes_raw()
+        writer.write(e_pub)
+        await writer.drain()
+        peer_e = await reader.readexactly(32)
+        try:
+            shared = eph.exchange(X25519PublicKey.from_public_bytes(peer_e))
+        except ValueError as e:  # low-order / invalid point
+            raise ChannelError(f"bad ephemeral key: {e}") from None
+        e_i, e_r = (e_pub, peer_e) if initiator else (peer_e, e_pub)
+        okm = HKDF(algorithm=hashes.SHA256(), length=96, salt=genesis_id,
+                   info=b"smh/noise/1" + e_i + e_r).derive(shared)
+        k_i2r, k_r2i, binding = okm[:32], okm[32:64], okm[64:]
+        tx_key, rx_key = (k_i2r, k_r2i) if initiator else (k_r2i, k_i2r)
+        return cls(reader, writer, tx_key=tx_key, rx_key=rx_key,
+                   binding=binding, initiator=initiator)
+
+    def _nonce(self, counter: int) -> bytes:
+        return struct.pack("<Q", counter) + bytes(4)
+
+    def encrypt_frame(self, frame_type: int, payload: bytes) -> bytes:
+        ct = self._tx.encrypt(self._nonce(self._tx_n),
+                              bytes([frame_type]) + payload, b"")
+        self._tx_n += 1
+        return struct.pack("<I", len(ct)) + ct
+
+    async def send(self, frame_type: int, payload: bytes) -> None:
+        self.writer.write(self.encrypt_frame(frame_type, payload))
+        await self.writer.drain()
+
+    async def recv(self) -> tuple[int, bytes]:
+        head = await self.reader.readexactly(4)
+        (length,) = struct.unpack("<I", head)
+        if not 17 <= length <= MAX_FRAME:  # 1 type byte + 16 tag minimum
+            raise ChannelError(f"bad frame length {length}")
+        ct = await self.reader.readexactly(length)
+        try:
+            pt = self._rx.decrypt(self._nonce(self._rx_n), ct, b"")
+        except Exception:  # InvalidTag — tampered/replayed/wrong-key
+            raise ChannelError("frame authentication failed") from None
+        self._rx_n += 1
+        return pt[0], pt[1:]
+
+    def sign_binding(self, signer, role_initiator: bool) -> bytes:
+        """Channel-binding signature: proves the identity key holder is
+        live on THIS channel in THIS role (role byte stops reflection)."""
+        from ..core.signing import Domain
+
+        return signer.sign(Domain.TRANSPORT,
+                           self.binding + (b"i" if role_initiator else b"r"))
+
+    def verify_binding(self, verifier, node_id: bytes, sig: bytes,
+                       role_initiator: bool) -> bool:
+        from ..core.signing import Domain
+
+        return verifier.verify(
+            Domain.TRANSPORT, node_id,
+            self.binding + (b"i" if role_initiator else b"r"), sig)

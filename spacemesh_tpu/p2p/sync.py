@@ -24,6 +24,7 @@ from .fetch import (
     HINT_POET,
     HINT_TX,
     LayerData,
+    P_LAYER,
 )
 
 
@@ -77,6 +78,7 @@ class Syncer:
         await self._sync_malfeasance()
         # 2) per-layer data up to the tip
         start = self.processed_layer() + 1
+        deferred = False
         for layer in range(start, tip + 1):
             if self._stop:
                 return False
@@ -90,6 +92,7 @@ class Syncer:
                 data.certified != bytes(32)
                 or getattr(data, "cert_candidates", []))
             if recent and not has_cert:
+                deferred = True
                 break
             if data is not None:
                 # beacon first: ballot eligibility and certificate shares
@@ -107,7 +110,11 @@ class Syncer:
                 await self.fetch.get_hashes(HINT_BALLOT, data.ballots)
             await self.process_layer(layer, data)
         behind = self.current_layer() - self.processed_layer()
-        if behind <= 1:
+        # a recent-layer deferral means we are as caught up as the
+        # network allows (peers have no certificate yet either): still
+        # SYNCED, or in a quiescent net the node would sit at behind==2
+        # forever in gossipSync and the fork check below would never run
+        if behind <= 1 or (deferred and behind <= 3):
             self.state = SyncState.SYNCED
         elif behind <= 2:
             self.state = SyncState.GOSSIP
@@ -138,10 +145,14 @@ class Syncer:
             await self.fetch.get_hashes(HINT_MALFEASANCE, sorted(ids))
 
     async def _check_fork(self) -> bool:
-        """Compare aggregated layer hashes with a peer at the frontier;
-        on mismatch bisect to the FIRST divergent layer and hand it to
-        on_fork (reference syncer/find_fork.go). Returns True if a fork
-        was found and a rollback was requested."""
+        """Compare aggregated layer hashes with peers at the frontier;
+        on mismatch bisect to the FIRST divergent layer, FETCH the
+        dissenting chain's blocks/ballots, and hand the layer to
+        on_fork for arbitration (reference syncer/find_fork.go). Fork
+        CHOICE is not made here: the tortoise's vote weight decides —
+        which also kills the rollback-DoS vector (ADVICE r2): a lying
+        peer can waste fetch bandwidth but cannot move applied state
+        without real ballot weight behind its chain."""
         import struct
 
         from .server import RequestError
@@ -187,22 +198,20 @@ class Syncer:
         if local is None:
             return False
 
-        # corroboration first: rolling back applied state is expensive and
-        # a rollback loop is a DoS — only act when the RESPONDING MAJORITY
-        # disagrees with us, and score down a lone dissenter instead
         frontier_hashes = [(p, await peer_hash(p, frontier)) for p in peers]
         answered = [(p, h) for p, h in frontier_hashes if h is not None]
         if not answered:
             return False
         disagree = [(p, h) for p, h in answered if h != local]
-        if len(disagree) * 2 <= len(answered):
-            for p, _ in disagree:  # minority dissenter: likely lying
-                self.fetch.report_failure(p)
-            return False
-        for peer, _ in disagree:
+        acted = False
+        for peer, h in disagree:
+            # stability re-confirm: a transient lie or a peer racing its
+            # own apply must not trigger the (bounded) refetch work
+            if await peer_hash(peer, frontier) != h:
+                continue
             # bisect [1, frontier] for the first layer where we diverge;
             # a peer that stops answering mid-bisect yields NO divergence
-            # point — never roll back on a guess
+            # point — never act on a guess
             lo, hi = 1, frontier
             aborted = False
             while lo < hi:
@@ -218,9 +227,44 @@ class Syncer:
                     hi = mid
             if aborted:
                 continue
+            # ingest the dissenting chain's data over the divergent span
+            # (bounded per pass) so the tortoise can weigh it: the
+            # dissenter's own layer opinion first, then the union view
+            await self._ingest_span(peer, lo, frontier)
             self.on_fork(lo)
-            return True
-        return False
+            acted = True
+        return acted
+
+    async def _ingest_span(self, peer, lo: int, hi: int,
+                           span_cap: int = 32) -> None:
+        """Fetch blocks + ballots for layers [lo, hi] — the dissenting
+        peer's view plus the usual cross-peer union — through the same
+        validated ingestion path sync uses. Failures are tolerated: the
+        next pass retries."""
+        import struct
+
+        from .server import RequestError
+
+        for layer in range(lo, min(hi, lo + span_cap) + 1):
+            datas = []
+            try:
+                resp = await self.fetch.server.request(
+                    peer, P_LAYER, struct.pack("<I", layer))
+                datas.append(LayerData.from_bytes(resp))
+            except Exception:  # noqa: BLE001 — dissenter may be gone
+                pass
+            union = await self.fetch.get_layer_data(layer)
+            if union is not None:
+                datas.append(union)
+            blocks: list[bytes] = []
+            ballots: list[bytes] = []
+            for d in datas:
+                blocks += [b for b in d.blocks if b not in blocks]
+                ballots += [b for b in d.ballots if b not in ballots]
+            if blocks:
+                await self.fetch.get_hashes(HINT_BLOCK, blocks)
+            if ballots:
+                await self.fetch.get_hashes(HINT_BALLOT, ballots)
 
     async def _sync_beacon(self, epoch: int) -> None:
         """Adopt peers' beacon for the epoch (late joiners never ran the

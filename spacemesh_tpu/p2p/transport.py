@@ -6,9 +6,15 @@ TCP listener plus outbound dials and slots in as BOTH the pubsub hub
 existing protocol component runs unchanged over a real network.
 
 Reference parity (behavior, not mechanism — the reference rides libp2p):
-- network-cookie handshake: both sides open with a HELLO carrying the
-  20-byte genesis id (+ optional cookie); mismatch closes the connection
-  (reference p2p/handshake/handshake.go — splits testnets from mainnet).
+- noise security: every connection runs an X25519+ChaCha20-Poly1305
+  channel (p2p/noise.py) and the peer's node id is its ed25519 key,
+  PROVEN by a channel-binding signature in the encrypted HELLO — ids
+  are unforgeable (reference p2p/host.go:27-28, 306-309: libp2p noise +
+  key-derived peer ids).
+- network-cookie handshake: the 20-byte genesis id salts the channel
+  keys AND rides in the HELLO; mismatch fails decryption / closes the
+  connection (reference p2p/handshake/handshake.go — splits testnets
+  from mainnet).
 - gossip: flood-publish with content-id dedup and relay-on-accept; a
   validation reject penalizes the sending peer and repeated rejects drop
   it (reference pubsub.go:168 DropPeerOnValidationReject, gossipsub
@@ -32,6 +38,7 @@ import time
 from typing import Optional
 
 from ..core.hashing import sum256
+from .noise import ChannelError, NoiseChannel
 
 MSG_HELLO = 0
 MSG_GOSSIP = 1
@@ -60,9 +67,11 @@ class _Conn:
 
     def __init__(self, reader: asyncio.StreamReader,
                  writer: asyncio.StreamWriter, node_id: bytes,
-                 listen_addr: Optional[tuple[str, int]], outbound: bool):
+                 listen_addr: Optional[tuple[str, int]], outbound: bool,
+                 channel: NoiseChannel | None = None):
         self.reader = reader
         self.writer = writer
+        self.channel = channel
         self.node_id = node_id
         self.listen_addr = listen_addr
         self.outbound = outbound
@@ -80,8 +89,10 @@ class _Conn:
         if self.send_queue.qsize() >= SEND_QUEUE_CAP:
             self.close()  # peer is not draining; don't buffer unboundedly
             raise ConnectionError("send queue overflow")
+        # encrypt at enqueue: the queue is FIFO and the writer drains it
+        # in order, so nonce order matches wire order
         self.send_queue.put_nowait(
-            struct.pack("<IB", len(payload) + 1, frame_type) + payload)
+            self.channel.encrypt_frame(frame_type, payload))
 
     async def write_loop(self) -> None:
         try:
@@ -105,32 +116,30 @@ class _Conn:
             pass
 
 
-async def _read_frame(reader: asyncio.StreamReader) -> tuple[int, bytes]:
-    head = await reader.readexactly(4)
-    (length,) = struct.unpack("<I", head)
-    if not 1 <= length <= MAX_FRAME:
-        raise HandshakeError(f"bad frame length {length}")
-    body = await reader.readexactly(length)
-    return body[0], body[1:]
-
-
 class Host:
     """One node's transport endpoint: listener + dials + gossip + req/resp.
 
     Usage:
-        host = Host(node_id=..., genesis_id=..., listen="127.0.0.1:0",
-                    bootstrap=["127.0.0.1:7513"])
+        host = Host(signer=EdSigner(...), genesis_id=...,
+                    listen="127.0.0.1:0", bootstrap=["127.0.0.1:7513"])
         await host.start()
         host.join_pubsub(pubsub)   # pubsub hub seam
         host.join(server)          # req/resp net seam (Server._net)
+
+    The node id IS the signer's ed25519 public key: the handshake proves
+    possession of the key, so ids can't be spoofed.
     """
 
-    def __init__(self, *, node_id: bytes, genesis_id: bytes,
+    def __init__(self, *, signer, genesis_id: bytes,
                  listen: str = "127.0.0.1:0", bootstrap: list[str] = (),
                  min_peers: int = 3, max_peers: int = 32,
                  reject_limit: int = 16, ban_seconds: float = 60.0,
                  request_timeout: float = 10.0):
-        self.node_id = node_id
+        from ..core.signing import EdVerifier
+
+        self.signer = signer
+        self.node_id = signer.node_id
+        self.verifier = EdVerifier(prefix=signer.prefix)
         self.genesis_id = genesis_id
         self.listen = listen
         self.bootstrap = list(bootstrap)
@@ -226,18 +235,25 @@ class Host:
     # ------------------------------------------------------------------
     # connections
 
-    def _hello_payload(self) -> bytes:
+    def _hello_payload(self, channel: NoiseChannel) -> bytes:
         port = self.address[1] if self.address else 0
+        sig = channel.sign_binding(self.signer, channel.initiator)
         return (struct.pack("<B", len(self.genesis_id)) + self.genesis_id
-                + self.node_id + struct.pack("<H", port))
+                + self.node_id + struct.pack("<H", port) + sig)
 
     @staticmethod
-    def _parse_hello(payload: bytes) -> tuple[bytes, bytes, int]:
+    def _parse_hello(payload: bytes) -> tuple[bytes, bytes, int, bytes]:
+        # length-check before slicing: a truncated HELLO from an
+        # untrusted peer must surface as HandshakeError, not IndexError
+        # (ADVICE r2: unhandled parse errors leaked the socket)
+        if len(payload) < 1 or len(payload) < 1 + payload[0] + 34 + 64:
+            raise HandshakeError("malformed HELLO")
         glen = payload[0]
         genesis = payload[1:1 + glen]
         node_id = payload[1 + glen:1 + glen + 32]
         (port,) = struct.unpack_from("<H", payload, 1 + glen + 32)
-        return genesis, node_id, port
+        sig = payload[1 + glen + 34:1 + glen + 34 + 64]
+        return genesis, node_id, port, sig
 
     async def _dial(self, addr: tuple[str, int]) -> None:
         try:
@@ -248,16 +264,14 @@ class Host:
         try:
             await self._handshake(reader, writer, outbound=True,
                                   dialed_addr=addr)
-        except (HandshakeError, OSError, asyncio.IncompleteReadError,
-                asyncio.TimeoutError):
+        except Exception:  # noqa: BLE001 — any peer garbage: close the fd
             writer.close()
 
     async def _accept(self, reader: asyncio.StreamReader,
                       writer: asyncio.StreamWriter) -> None:
         try:
             await self._handshake(reader, writer, outbound=False)
-        except (HandshakeError, OSError, asyncio.IncompleteReadError,
-                asyncio.TimeoutError):
+        except Exception:  # noqa: BLE001 — any peer garbage: close the fd
             writer.close()
 
     async def _handshake(self, reader, writer, *, outbound: bool,
@@ -267,15 +281,22 @@ class Host:
 
     async def _do_handshake(self, reader, writer, *, outbound: bool,
                             dialed_addr=None) -> None:
-        writer.write(struct.pack("<IB", len(self._hello_payload()) + 1,
-                                 MSG_HELLO) + self._hello_payload())
-        await writer.drain()
-        ftype, payload = await _read_frame(reader)
+        # 1) ephemeral key exchange -> encrypted channel (wrong-genesis
+        # peers derive different keys and fail at the first frame)
+        channel = await NoiseChannel.establish(
+            reader, writer, genesis_id=self.genesis_id, initiator=outbound)
+        # 2) encrypted HELLO: identity + listen port + channel-binding
+        # signature proving possession of the ed25519 key
+        await channel.send(MSG_HELLO, self._hello_payload(channel))
+        ftype, payload = await channel.recv()
         if ftype != MSG_HELLO:
             raise HandshakeError("expected HELLO")
-        genesis, peer_id, peer_port = self._parse_hello(payload)
+        genesis, peer_id, peer_port, sig = self._parse_hello(payload)
         if genesis != self.genesis_id:
             raise HandshakeError("genesis mismatch")  # network cookie
+        if not channel.verify_binding(self.verifier, peer_id, sig,
+                                      role_initiator=not outbound):
+            raise HandshakeError("identity signature invalid")
         if peer_id == self.node_id:
             raise HandshakeError("self-dial")
         if self._banned.get(peer_id, 0) > time.monotonic():
@@ -286,7 +307,8 @@ class Host:
         peer_host = writer.get_extra_info("peername")[0]
         listen_addr = dialed_addr or ((peer_host, peer_port)
                                       if peer_port else None)
-        conn = _Conn(reader, writer, peer_id, listen_addr, outbound)
+        conn = _Conn(reader, writer, peer_id, listen_addr, outbound,
+                     channel=channel)
 
         # one connection per peer pair: on simultaneous dial, the dial
         # initiated by the LOWER node id survives
@@ -336,7 +358,7 @@ class Host:
     async def _read_loop(self, conn: _Conn) -> None:
         try:
             while not conn.closed.is_set():
-                ftype, payload = await _read_frame(conn.reader)
+                ftype, payload = await conn.channel.recv()
                 if ftype == MSG_GOSSIP:
                     # bounded like the send side: a gossip flood faster
                     # than local validation drains must not grow memory —
@@ -353,7 +375,11 @@ class Host:
                 elif ftype == MSG_PEERS:
                     self._handle_peers(payload)
         except (OSError, ConnectionError, asyncio.IncompleteReadError,
-                HandshakeError):
+                HandshakeError, ChannelError, struct.error, ValueError,
+                IndexError, UnicodeDecodeError):
+            # the last four: truncated MSG_RESP/MSG_PEERS payloads from a
+            # hostile peer — drop the connection, never kill the task
+            # with an unhandled error (ADVICE r2)
             pass
         finally:
             self._drop(conn)
@@ -417,10 +443,16 @@ class Host:
                 self._drop(conn)
 
     async def _handle_req(self, conn: _Conn, payload: bytes) -> None:
-        (req_id,) = struct.unpack_from("<Q", payload)
-        plen = payload[8]
-        proto = payload[9:9 + plen].decode()
-        data = payload[9 + plen:]
+        try:
+            (req_id,) = struct.unpack_from("<Q", payload)
+            plen = payload[8]
+            proto = payload[9:9 + plen].decode()
+            data = payload[9 + plen:]
+        except (struct.error, IndexError, UnicodeDecodeError):
+            # runs as its own task: a truncated request must not become
+            # an unhandled task exception (ADVICE r2)
+            self._penalize(conn)
+            return
         status, resp = 0, b""
         try:
             if self._server is None:

@@ -13,7 +13,15 @@ from .db import Database
 
 
 BEACON_PROTOCOL = 0  # decided by running the beacon protocol (final)
-BEACON_FALLBACK = 1  # adopted from sync/bootstrap (supersedable)
+BEACON_FALLBACK = 1  # adopted from sync/bootstrap/checkpoint (supersedable)
+BEACON_GUESS = 2     # OUR OWN timeout-guess (an early get() fell back to
+                     # the local bootstrap derivation before the protocol
+                     # ran) — supersedable by anything, and the ONLY
+                     # source run_epoch may overwrite by running the
+                     # protocol: a network-adopted FALLBACK value can be
+                     # bit-identical to the local derivation, so
+                     # provenance must be recorded, not inferred
+                     # (code-review r3)
 
 
 def set_beacon(db: Database, epoch: int, beacon: bytes,

@@ -1,0 +1,134 @@
+"""Virtual-clock asyncio event loop for deterministic time-driven tests.
+
+The reference injects fake clocks everywhere (clockwork in
+timesync/clock_test.go and throughout the Go test suite — SURVEY.md
+§4.3) so consensus tests are machine-load independent. asyncio needs the
+equivalent at the LOOP level: every `asyncio.sleep`, `wait_for`, and
+`call_later` resolves against `loop.time()`, so virtualizing that one
+clock virtualizes the whole timing model.
+
+Mechanics: `loop.time()` returns virtual time, and the selector is
+wrapped so that whenever the loop would block waiting for a timer with
+no ready IO, the virtual clock JUMPS to the timer's deadline instead of
+sleeping. Logical ordering of every callback is exactly preserved; wall
+time spent is proportional to work done, not to configured durations.
+A 14-layer consensus scenario with 2 s layers runs in however long the
+hashing takes, identically on an idle or a loaded machine.
+
+Two interactions with external reality:
+- Executor threads (`asyncio.to_thread`, `run_in_executor`): virtual
+  time FREEZES while any executor future is outstanding — otherwise the
+  clock would leap over consensus deadlines (or a wait_for timeout)
+  while a POST init is still crunching in a worker thread. The loop
+  polls real IO briefly instead; the thread's completion callback wakes
+  it via the self-pipe.
+- No timers at all: the loop is waiting on pure external IO (a
+  subprocess pipe, a real socket) — fall back to a short real wait
+  instead of spinning.
+
+Components must read time from the loop for this to work: `App`
+accepts `time_source` and wires it through to LayerClock, hare, and
+beacon, so tests pass `time_source=loop.time`.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+START = 1_700_000_000.0  # arbitrary fixed epoch so layer math looks real
+
+
+class VirtualClockLoop(asyncio.SelectorEventLoop):
+    """SelectorEventLoop whose clock jumps over idle waits."""
+
+    def __init__(self, start: float = START):
+        super().__init__()
+        self._vtime = start
+        self._busy_threads = 0
+        self._io_streak = 0
+        # CRITICAL: asyncio fires a timer when `when < time() + resolution`.
+        # The default resolution (1 ns) is BELOW one float64 ulp at
+        # unix-epoch magnitudes (~4.8e-7 at 1.7e9), so `time() + 1e-9`
+        # rounds back to time() and a timer scheduled exactly AT the
+        # current virtual instant never fires — the loop spins forever
+        # with timeout=0. Resolution must exceed the clock's ulp.
+        self._clock_resolution = 1e-6
+        orig_select = self._selector.select
+
+        def select(timeout):
+            events = orig_select(0)
+            if not events:
+                self._io_streak = 0
+                if self._busy_threads > 0:
+                    # real work in flight: do NOT advance virtual time —
+                    # wait for the thread's wake-up on the self-pipe
+                    events = orig_select(0.002)
+                elif timeout is None:
+                    # no timers scheduled at all: waiting on external IO
+                    events = orig_select(0.005)
+                elif timeout > 0:
+                    # the 1 µs overshoot matters: _run_once fires timers
+                    # strictly below time()+clock_resolution (~1 ns), and
+                    # at unix-epoch magnitudes (1.7e9) one float64 ulp is
+                    # ~4.8e-7 — landing EXACTLY on the deadline rounds the
+                    # comparison into a never-firing busy spin
+                    self._vtime += timeout + 1e-6
+            else:
+                # timer-starvation guard: an fd that stays ready without
+                # its callback making progress (e.g. a half-closed
+                # socket) would freeze virtual time forever — after a
+                # long all-IO streak, trickle time forward so timers
+                # can't starve. 1 ms/iteration bounds the skew a LEGIT
+                # burst (a large transfer) can accumulate.
+                self._io_streak += 1
+                if self._io_streak > 256 and timeout is not None \
+                        and timeout > 0:
+                    self._vtime += 0.001
+            return events
+
+        self._selector.select = select
+
+    def time(self) -> float:
+        return self._vtime
+
+    def advance(self, dt: float) -> None:
+        """Manual jump (rarely needed: idle waits auto-advance)."""
+        self._vtime += dt
+
+    def run_in_executor(self, executor, func, *args):
+        fut = super().run_in_executor(executor, func, *args)
+        self._busy_threads += 1
+
+        def _done(_):
+            self._busy_threads -= 1
+
+        fut.add_done_callback(_done)
+        return fut
+
+
+async def cancel_all_tasks() -> None:
+    """Cancel every task but the caller and await them (teardown helper —
+    must run INSIDE the loop so gather binds to it)."""
+    tasks = [t for t in asyncio.all_tasks()
+             if t is not asyncio.current_task()]
+    for t in tasks:
+        t.cancel()
+    if tasks:
+        await asyncio.gather(*tasks, return_exceptions=True)
+
+
+def run_virtual(coro, *, start: float = START, timeout: float | None = None):
+    """asyncio.run() on a VirtualClockLoop. ``timeout`` is VIRTUAL time."""
+    loop = VirtualClockLoop(start=start)
+    try:
+        if timeout is not None:
+            coro = asyncio.wait_for(coro, timeout)
+        return loop.run_until_complete(coro)
+    finally:
+        try:
+            loop.run_until_complete(cancel_all_tasks())
+            loop.run_until_complete(loop.shutdown_asyncgens())
+            loop.run_until_complete(loop.shutdown_default_executor())
+        finally:
+            asyncio.set_event_loop(None)
+            loop.close()

@@ -303,11 +303,15 @@ class VM:
             # template + state must be committed too: two states differing
             # only in spawned template or template args (e.g. vault owner)
             # must not share a root (ADVICE r1)
+            # variable-length fields are length-prefixed: template/state
+            # boundary shifts must change the root (ADVICE r2)
+            template = acct.template or b""
+            state = acct.state or b""
             root = sum256(root, addr,
                           acct.balance.to_bytes(8, "little"),
                           acct.next_nonce.to_bytes(8, "little"),
-                          acct.template or b"",
-                          acct.state or b"")
+                          len(template).to_bytes(4, "little"), template,
+                          len(state).to_bytes(4, "little"), state)
         return root
 
     def revert(self, to_layer: int) -> None:

@@ -1,7 +1,6 @@
 """API surface over a live standalone node."""
 
 import asyncio
-import time
 
 import pytest
 from aiohttp import ClientSession
@@ -10,9 +9,10 @@ from spacemesh_tpu.node import clock as clock_mod
 from spacemesh_tpu.node.app import App
 from spacemesh_tpu.node.config import load
 from spacemesh_tpu.vm import sdk
+from spacemesh_tpu.utils.vclock import VirtualClockLoop, cancel_all_tasks
 
 LPE = 3
-LAYER_SEC = 0.7
+LAYER_SEC = 2.0  # virtual seconds (VirtualClockLoop)
 
 
 @pytest.fixture(scope="module")
@@ -23,24 +23,26 @@ def api_env(tmp_path_factory):
         "layer_duration": LAYER_SEC,
         "layers_per_epoch": LPE,
         "slots_per_layer": 2,
-        "genesis": {"time": time.time() + 3600},
+        "genesis": {"time": 0.0},  # rebased to virtual time below
         "post": {"labels_per_unit": 256, "scrypt_n": 2, "k1": 64, "k2": 8,
                  "k3": 4, "min_num_units": 1,
                  "pow_difficulty": "20" + "ff" * 31},
         "smeshing": {"start": True, "num_units": 1, "init_batch": 128},
-        "hare": {"committee_size": 20, "round_duration": 0.06,
-                 "preround_delay": 0.2, "iteration_limit": 2},
-        "beacon": {"proposal_duration": 0.05},
+        "hare": {"committee_size": 20, "round_duration": 0.2,
+                 "preround_delay": 0.5, "iteration_limit": 2},
+        "beacon": {"proposal_duration": 0.2},
         "tortoise": {"hdist": 4, "window_size": 50},
     })
-    app = App(cfg)
+    loop = VirtualClockLoop()
+    app = App(cfg, time_source=loop.time)
     results = {}
 
     async def go():
         await app.prepare()
         port = await app.start_api()
-        app.clock = clock_mod.LayerClock(time.time() + 0.3, LAYER_SEC)
-        run = asyncio.create_task(app.run(until_layer=2 * LPE))
+        app.clock = clock_mod.LayerClock(loop.time() + 1.0, LAYER_SEC,
+                                         time_source=loop.time)
+        run = asyncio.create_task(app.run(until_layer=4 * LPE))
         base = f"http://127.0.0.1:{port}"
         async with ClientSession() as s:
             # let a couple of layers pass
@@ -51,7 +53,7 @@ def api_env(tmp_path_factory):
             results["smesher"] = await (await s.get(f"{base}/v1/smesher/status")).json()
             # wait for the first reward so the account can pay the tx fee
             coinbase = sdk.wallet_address(app.signer.public_key)
-            for _ in range(40):
+            for _ in range(60):
                 acct = await (await s.get(
                     f"{base}/v1/account/{coinbase.encode()}")).json()
                 if acct["balance"] > 0:
@@ -77,7 +79,10 @@ def api_env(tmp_path_factory):
         await run
         await app.api.stop()
 
-    asyncio.run(asyncio.wait_for(go(), timeout=120))
+    try:
+        loop.run_until_complete(asyncio.wait_for(go(), 10_000))
+    finally:
+        loop.run_until_complete(cancel_all_tasks())
     return app, results
 
 

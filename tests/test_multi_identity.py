@@ -10,7 +10,6 @@ node-side seam end to end.
 """
 
 import asyncio
-import time
 
 import pytest
 
@@ -20,9 +19,10 @@ from spacemesh_tpu.node.config import load
 from spacemesh_tpu.storage import atxs as atxstore
 from spacemesh_tpu.storage import blocks as blockstore
 from spacemesh_tpu.storage import layers as layerstore
+from spacemesh_tpu.utils.vclock import VirtualClockLoop, cancel_all_tasks
 
 LPE = 3
-LAYER_SEC = 0.9
+LAYER_SEC = 2.0  # virtual seconds (VirtualClockLoop)
 N_IDS = 4
 
 
@@ -32,15 +32,15 @@ def _config(tmp_path):
         "layer_duration": LAYER_SEC,
         "layers_per_epoch": LPE,
         "slots_per_layer": 2,
-        "genesis": {"time": time.time() + 3600},
+        "genesis": {"time": 0.0},  # replaced with virtual time in the run
         "post": {"labels_per_unit": 256, "scrypt_n": 2, "k1": 64, "k2": 8,
                  "k3": 4, "min_num_units": 1,
                  "pow_difficulty": "20" + "ff" * 31},
         "smeshing": {"start": True, "num_units": 1, "init_batch": 128,
                      "num_identities": N_IDS, "external_worker": True},
-        "hare": {"committee_size": 40, "round_duration": 0.1,
-                 "preround_delay": 0.3, "iteration_limit": 2},
-        "beacon": {"proposal_duration": 0.1},
+        "hare": {"committee_size": 40, "round_duration": 0.2,
+                 "preround_delay": 0.5, "iteration_limit": 2},
+        "beacon": {"proposal_duration": 0.2},
         "tortoise": {"hdist": 4, "window_size": 50},
     })
 
@@ -49,17 +49,21 @@ def _config(tmp_path):
 def ran(tmp_path_factory):
     tmp_path = tmp_path_factory.mktemp("multiid")
     cfg = _config(tmp_path)
-    app = App(cfg)
+    loop = VirtualClockLoop()
+    app = App(cfg, time_source=loop.time)
 
     async def go():
         await app.prepare()
-        app.clock = clock_mod.LayerClock(time.time() + 0.3, cfg.layer_duration)
-        await asyncio.wait_for(app.run(until_layer=2 * LPE + 1), timeout=240)
+        app.clock = clock_mod.LayerClock(loop.time() + 1.0,
+                                         cfg.layer_duration,
+                                         time_source=loop.time)
+        await asyncio.wait_for(app.run(until_layer=2 * LPE + 1), 10_000)
 
     try:
-        asyncio.run(go())
+        loop.run_until_complete(go())
         yield app
     finally:
+        loop.run_until_complete(cancel_all_tasks())
         app.close()
 
 

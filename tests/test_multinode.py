@@ -7,7 +7,6 @@ must converge on A's ATXs, blocks, and applied state.
 """
 
 import asyncio
-import time
 
 import pytest
 
@@ -20,15 +19,16 @@ from spacemesh_tpu.p2p.server import LoopbackNet
 from spacemesh_tpu.storage import atxs as atxstore
 from spacemesh_tpu.storage import blocks as blockstore
 from spacemesh_tpu.storage import layers as layerstore
+from spacemesh_tpu.utils.vclock import VirtualClockLoop, cancel_all_tasks
 
 LPE = 3
-LAYER_SEC = 0.8
+LAYER_SEC = 2.0  # virtual seconds (VirtualClockLoop) — costs no wall time
 
 
 # ONE genesis timestamp for the whole network: genesis_id (the signature
 # prefix and golden ATX) derives from it, so per-node values would put the
 # nodes on different networks entirely.
-GENESIS_PLACEHOLDER = float(int(time.time()) + 3600)
+GENESIS_PLACEHOLDER = 1_700_000_600.0  # fixed: virtual time is deterministic
 
 
 def _config(tmp_path, name, smesh):
@@ -42,9 +42,9 @@ def _config(tmp_path, name, smesh):
                  "k3": 4, "min_num_units": 1,
                  "pow_difficulty": "20" + "ff" * 31},
         "smeshing": {"start": smesh, "num_units": 1, "init_batch": 128},
-        "hare": {"committee_size": 20, "round_duration": 0.1,
-                 "preround_delay": 0.35, "iteration_limit": 2},
-        "beacon": {"proposal_duration": 0.1},
+        "hare": {"committee_size": 20, "round_duration": 0.2,
+                 "preround_delay": 0.5, "iteration_limit": 2},
+        "beacon": {"proposal_duration": 0.2},
         "tortoise": {"hdist": 4, "window_size": 50},
     })
 
@@ -52,6 +52,7 @@ def _config(tmp_path, name, smesh):
 @pytest.fixture(scope="module")
 def network(tmp_path_factory):
     tmp = tmp_path_factory.mktemp("multinode")
+    loop = VirtualClockLoop()
     hub = LoopbackHub()
     net = LoopbackNet()
 
@@ -60,7 +61,7 @@ def network(tmp_path_factory):
         signer = EdSigner(prefix=cfg.genesis.genesis_id)
         ps = PubSub(node_name=signer.node_id)
         hub.join(ps)
-        app = App(cfg, signer=signer, pubsub=ps)
+        app = App(cfg, signer=signer, pubsub=ps, time_source=loop.time)
         app.connect_network(net)
         return app
 
@@ -70,23 +71,25 @@ def network(tmp_path_factory):
 
     async def go():
         await a.prepare()
-        genesis = time.time() + 0.3
+        genesis = loop.time() + 1.0
         for app in (a, b):
-            app.clock = clock_mod.LayerClock(genesis, LAYER_SEC)
+            app.clock = clock_mod.LayerClock(genesis, LAYER_SEC,
+                                             time_source=loop.time)
         until = 2 * LPE + 1
         task_a = asyncio.create_task(a.run(until_layer=until))
         task_b = asyncio.create_task(b.run(until_layer=until))
         # C joins after one full epoch has passed
         await asyncio.sleep(LAYER_SEC * (LPE + 1))
         c = make("c", smesh=False)
-        c.clock = clock_mod.LayerClock(genesis, LAYER_SEC)
+        c.clock = clock_mod.LayerClock(genesis, LAYER_SEC,
+                                       time_source=loop.time)
         c_holder["app"] = c
         synced = await c.syncer.synchronize()
         await asyncio.gather(task_a, task_b)
         # final catch-up after A/B stopped: loop until C reaches A's
-        # applied frontier (bounded; absorbs full-suite load jitter)
-        deadline = time.time() + 45
-        while time.time() < deadline:
+        # applied frontier (virtual-time bounded)
+        deadline = loop.time() + 300
+        while loop.time() < deadline:
             await c.syncer.synchronize()
             if layerstore.last_applied(c.state) >= \
                     layerstore.last_applied(a.state) - 1:
@@ -94,7 +97,10 @@ def network(tmp_path_factory):
             await asyncio.sleep(0.2)
         return synced
 
-    asyncio.run(asyncio.wait_for(go(), timeout=180))
+    try:
+        loop.run_until_complete(asyncio.wait_for(go(), 10_000))
+    finally:
+        loop.run_until_complete(cancel_all_tasks())
     return a, b, c_holder["app"]
 
 

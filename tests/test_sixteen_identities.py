@@ -8,7 +8,6 @@ epoch, 16 proofs, 16 valid ATXs, all signers participating in hare).
 """
 
 import asyncio
-import time
 
 import pytest
 
@@ -17,9 +16,10 @@ from spacemesh_tpu.node.app import App
 from spacemesh_tpu.node.config import load
 from spacemesh_tpu.storage import atxs as atxstore
 from spacemesh_tpu.storage import layers as layerstore
+from spacemesh_tpu.utils.vclock import VirtualClockLoop, cancel_all_tasks
 
 LPE = 3
-LAYER_SEC = 1.2
+LAYER_SEC = 2.0  # virtual seconds (VirtualClockLoop)
 N_IDS = 16
 
 
@@ -31,31 +31,32 @@ def ran(tmp_path_factory):
         "layer_duration": LAYER_SEC,
         "layers_per_epoch": LPE,
         "slots_per_layer": 2,
-        "genesis": {"time": time.time() + 3600},
+        "genesis": {"time": 0.0},  # replaced with virtual time below
         "post": {"labels_per_unit": 256, "scrypt_n": 2, "k1": 64, "k2": 8,
                  "k3": 4, "min_num_units": 1,
                  "pow_difficulty": "20" + "ff" * 31},
         "smeshing": {"start": True, "num_units": 1, "init_batch": 256,
                      "num_identities": N_IDS},
-        "hare": {"committee_size": 32, "round_duration": 0.15,
-                 "preround_delay": 0.4, "iteration_limit": 2},
-        "beacon": {"proposal_duration": 0.15},
+        "hare": {"committee_size": 32, "round_duration": 0.2,
+                 "preround_delay": 0.5, "iteration_limit": 2},
+        "beacon": {"proposal_duration": 0.2},
         "tortoise": {"hdist": 4, "window_size": 50},
     })
-    app = App(cfg)
+    loop = VirtualClockLoop()
+    app = App(cfg, time_source=loop.time)
 
     async def go():
         await app.prepare()   # 16 inits + 16 initial proofs (epoch 0)
-        app.clock = clock_mod.LayerClock(time.time() + 0.3,
-                                         cfg.layer_duration)
-        # one full layer into epoch 2: epoch-1 ATXs (published during
-        # layers 3-5) need the boundary slack on slow machines
-        await asyncio.wait_for(app.run(until_layer=2 * LPE), timeout=300)
+        app.clock = clock_mod.LayerClock(loop.time() + 1.0,
+                                         cfg.layer_duration,
+                                         time_source=loop.time)
+        await asyncio.wait_for(app.run(until_layer=2 * LPE), 10_000)
 
     try:
-        asyncio.run(go())
+        loop.run_until_complete(go())
         yield app
     finally:
+        loop.run_until_complete(cancel_all_tasks())
         app.close()
 
 

@@ -109,25 +109,93 @@ def test_supported_blocks_verify():
 
 
 def test_healing_decides_stuck_layer_by_sign():
-    """A layer whose margin never clears the threshold (and has no hare
-    output) must still settle once it falls past hdist+zdist."""
+    """A layer whose margin never clears the GLOBAL threshold (and has
+    no hare output) settles once it falls past hdist+zdist, by count
+    sign — provided the margin clears the LOCAL threshold (reference
+    tortoise/full.go + threshold.go local/global split)."""
     t = Tortoise(_cache(weight=10_000), LPE, hdist=2, zdist=1, window=100)
     b1 = _blk(1)
     t.on_block(1, b1)
-    # two light ballots for, one against: margin positive but tiny
-    # relative to the epoch-weight threshold
+    # support above the local threshold (10000/LPE/3) but below the
+    # global one (which includes the whole window's expected weight)
+    lt = t._local_threshold(8)
     t.on_ballot(_ballot(_bid(0), 2, Opinion(
-        base=EMPTY, support=[b1], against=[], abstain=[]), b"aa"), weight=3)
+        base=EMPTY, support=[b1], against=[], abstain=[]), b"aa"),
+        weight=lt + 5)
     t.on_ballot(_ballot(_bid(1), 3, Opinion(
-        base=EMPTY, support=[b1], against=[], abstain=[]), b"bb"), weight=3)
+        base=EMPTY, support=[b1], against=[], abstain=[]), b"bb"),
+        weight=lt + 5)
     t.on_ballot(_ballot(_bid(2), 3, Opinion(
-        base=EMPTY, support=[], against=[b1], abstain=[]), b"cc"), weight=2)
+        base=EMPTY, support=[], against=[b1], abstain=[]), b"cc"),
+        weight=lt)
     t.tally_votes(4)
     assert t.verified == 0  # within the confidence window: stuck
     t.tally_votes(8)        # 8 - 1 > hdist + zdist -> heal by sign
     assert t.verified >= 1
     assert t.is_valid(b1)
     assert t.mode == FULL
+
+
+def test_healing_zero_margin_decided_by_weak_coin():
+    """A genuinely tied layer (|margin| <= local threshold) is decided
+    by the weak coin of the latest layer, so every node lands on the
+    same side (reference tortoise/tortoise.go:287-306 getFullVote
+    reasonCoinflip). Without a recorded coin the layer stays stuck."""
+    def mk(coin):
+        t = Tortoise(_cache(weight=10_000), LPE, hdist=2, zdist=1,
+                     window=100)
+        b1 = _blk(1)
+        t.on_block(1, b1)
+        # equal support and against: margin exactly zero
+        t.on_ballot(_ballot(_bid(0), 2, Opinion(
+            base=EMPTY, support=[b1], against=[], abstain=[]), b"aa"),
+            weight=7)
+        t.on_ballot(_ballot(_bid(1), 3, Opinion(
+            base=EMPTY, support=[], against=[b1], abstain=[]), b"bb"),
+            weight=7)
+        if coin is not None:
+            t.on_weak_coin(7, coin)
+        t.tally_votes(8)
+        return t, b1
+
+    t, b1 = mk(None)
+    assert t.verified == 0  # no coin: cannot settle the tie
+
+    t, b1 = mk(True)
+    assert t.verified >= 1
+    assert t.is_valid(b1)   # coin says support
+
+    t, b1 = mk(False)
+    assert t.verified >= 1
+    assert not t.is_valid(b1)  # coin says against
+
+
+def test_bad_beacon_ballots_muted_until_delay():
+    """Ballots with a wrong beacon vote at zero weight until
+    bad_beacon_delay layers past their own layer (reference
+    tortoise.go BadBeaconVoteDelayLayers): a grinding adversary can't
+    swing margins inside the confidence window, but the votes DO count
+    eventually (self-healing keeps working on whatever weight exists)."""
+    t = Tortoise(_cache(weight=100), LPE, hdist=3, zdist=2, window=100,
+                 bad_beacon_delay=4)
+    good = _blk(1)
+    t.on_block(1, good)
+    t.on_hare_output(1, good)
+    # heavy support arrives ONLY from bad-beacon ballots
+    for i, layer in enumerate(range(2, 6)):
+        op = Opinion(base=EMPTY, support=[good], against=[], abstain=[])
+        t.on_ballot(_ballot(_bid(i), layer, op, node=b"%02d" % i),
+                    weight=500, bad_beacon=True)
+    t.tally_votes(6)
+    # margins muted: only hare trust within hdist can hold the opinion,
+    # the 2000-weight support does not cross any threshold
+    blocks, margins = t._margins(1, 6)
+    assert list(margins) == [0]
+    # ...until the delay passes: layers 2..5 are all > 4 layers behind
+    # the new tip, so the weight counts again
+    t.tally_votes(10)
+    blocks, margins = t._margins(1, 10)
+    assert list(margins) == [2000]
 
 
 def test_pending_support_resolved_when_block_arrives():
