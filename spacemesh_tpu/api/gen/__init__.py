@@ -1,0 +1,5 @@
+"""Generated protobuf modules (protoc --python_out of ../protos/*.proto).
+
+Regenerate with:  protoc -I spacemesh_tpu/api/protos \
+    --python_out spacemesh_tpu/api/gen spacemesh_tpu/api/protos/*.proto
+"""

@@ -251,7 +251,9 @@ class Builder:
         # phase 2: POST proof over the poet statement
         ch = post_challenge(proof.root, challenge)
         post_proof, meta = await asyncio.to_thread(self.post_client.proof, ch)
-        info = self.post_client.info()
+        # off-loop: remote clients (JSON-RPC or the gRPC Register stream)
+        # block on IO and must never run on the event loop itself
+        info = await asyncio.to_thread(self.post_client.info)
 
         atx = ActivationTx(
             publish_epoch=publish_epoch,

@@ -223,7 +223,7 @@ async def build_merged_atx(*, primary: EdSigner, partners: list[EdSigner],
         client = post_clients[s.node_id]
         proof, meta = await asyncio.to_thread(
             client.proof, post_challenge(result.proof.root, ch))
-        info = client.info()
+        info = await asyncio.to_thread(client.info)
         subposts.append(SubPostV2(
             node_id=s.node_id, prev_atx=prev_id,
             num_units=info.num_units, vrf_nonce=info.vrf_nonce,

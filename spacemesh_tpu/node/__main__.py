@@ -89,6 +89,7 @@ def main(argv=None) -> int:
                 await app.stop_network()
             if api_started:
                 await app.api.stop()  # stop accepting before the DB closes
+            await app.stop_grpc_api()  # may have started via worker_grpc
             app.close()
 
     profiler = None

@@ -810,7 +810,23 @@ class App:
             self.events.emit(events_mod.PostEvent(node_id=s.node_id,
                                                   kind="init_complete"))
         clients = {}
-        if cfg.smeshing.external_worker:
+        if cfg.smeshing.external_worker and cfg.smeshing.worker_grpc:
+            # reference topology: node hosts PostService, worker dials in
+            # and Registers each identity (post_service.go:91, supervisor
+            # passes the node address like post_supervisor.go does)
+            from ..post.supervisor import PostSupervisor
+
+            port = await self.start_grpc_api()
+            self.post_supervisor = PostSupervisor(
+                post_base, params=self.post_params,
+                node_address=f"127.0.0.1:{port}")
+            await asyncio.to_thread(self.post_supervisor.start)
+            svc = self.grpc_api.post_service
+            await svc.wait_registered([s.node_id for s in self.signers],
+                                      timeout=120.0)
+            for s in self.signers:
+                clients[s.node_id] = svc.client(s.node_id)
+        elif cfg.smeshing.external_worker:
             from ..post.supervisor import PostSupervisor
             from ..post.remote import RemotePostClient
 
@@ -907,6 +923,25 @@ class App:
 
         self.api = ApiServer(self, listen=self.cfg.api.private_listener)
         return await self.api.start()
+
+    async def start_grpc_api(self) -> int:
+        """Start the gRPC listener: spacemesh.v1 services incl. the
+        PostService Register seam (reference api/grpcserver/grpc.go; the
+        reference splits listeners by audience, config.go:31-57 — here one
+        listener serves all, the split is config policy not protocol)."""
+        from ..api.rpc import GrpcApiServer
+
+        if getattr(self, "grpc_api", None) is None:
+            self.grpc_api = GrpcApiServer(
+                self, listen=self.cfg.api.post_listener,
+                post_query_interval=max(self.cfg.layer_duration / 20, 0.1))
+            self.grpc_port = await self.grpc_api.start()
+        return self.grpc_port
+
+    async def stop_grpc_api(self) -> None:
+        if getattr(self, "grpc_api", None) is not None:
+            await self.grpc_api.stop()
+            self.grpc_api = None
 
     async def run(self, until_layer: int | None = None) -> None:
         """The main layer loop (callers wanting the API call start_api()

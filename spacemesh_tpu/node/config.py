@@ -57,6 +57,9 @@ class SmeshingConfig:
                                  # node_identities.go multi-smesher)
     external_worker: bool = False  # prove via the out-of-proc POST worker
                                    # (PostSupervisor + RemotePostClient)
+    worker_grpc: bool = False      # reference topology: worker dials the
+                                   # node's gRPC PostService and Registers
+                                   # (api/grpcserver/post_service.go:91)
 
 
 @dataclasses.dataclass

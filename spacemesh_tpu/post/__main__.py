@@ -108,8 +108,15 @@ def cmd_benchmark(a) -> int:
 
 
 def cmd_serve(a) -> int:
-    """Serve every identity under --data-dir to the node over TCP
-    (the out-of-process worker; reference post-service + gRPC seam)."""
+    """Serve every identity under --data-dir to the node.
+
+    Two transports behind the same PostService registry:
+    * default: listen on --listen, node dials us (JSON-RPC framing)
+    * --node-address: DIAL the node's gRPC PostService and Register each
+      identity over a bidirectional stream — the reference topology
+      (reference api/grpcserver/post_service.go:91; the Rust post-service
+      is spawned with the node's address the same way).
+    """
     import asyncio
 
     from .prover import ProofParams
@@ -119,7 +126,22 @@ def cmd_serve(a) -> int:
                          pow_difficulty=bytes.fromhex(a.pow_difficulty))
     service = discover_identities(a.data_dir, params=params)
 
-    async def go():
+    async def go_grpc():
+        from .grpc_worker import GrpcWorker
+
+        worker = GrpcWorker(service, a.node_address)
+        await worker.start()
+        print(json.dumps({"event": "Registering",
+                          "node_address": a.node_address,
+                          "identities": [n.hex() for n in
+                                         service.registered()]}),
+              flush=True)
+        try:
+            await asyncio.Event().wait()  # until killed
+        finally:
+            await worker.stop()
+
+    async def go_listen():
         server = WorkerServer(service, listen=a.listen)
         host, port = await server.start()
         print(json.dumps({"event": "Serving", "host": host, "port": port,
@@ -132,7 +154,7 @@ def cmd_serve(a) -> int:
             await server.stop()
 
     try:
-        asyncio.run(go())
+        asyncio.run(go_grpc() if a.node_address else go_listen())
     except KeyboardInterrupt:
         pass
     return 0
@@ -182,6 +204,9 @@ def main(argv=None) -> int:
     ps.add_argument("--data-dir", required=True,
                     help="base dir holding per-identity POST data dirs")
     ps.add_argument("--listen", default="127.0.0.1:0")
+    ps.add_argument("--node-address", default=None,
+                    help="dial the node's gRPC PostService at host:port "
+                    "instead of listening (reference topology)")
     ps.add_argument("--k1", type=int, default=26)
     ps.add_argument("--k2", type=int, default=37)
     ps.add_argument("--k3", type=int, default=37)

@@ -21,9 +21,13 @@ from pathlib import Path
 class PostSupervisor:
     def __init__(self, base_dir: str | Path, listen: str = "127.0.0.1:0",
                  restart_backoff: float = 1.0, env: dict | None = None,
-                 params=None):
+                 params=None, node_address: str | None = None):
         self.base_dir = str(base_dir)
         self.listen = listen
+        # gRPC mode (reference topology): worker dials the node's
+        # PostService instead of listening (activation/post_supervisor.go
+        # passes --address the same way)
+        self.node_address = node_address
         self.restart_backoff = restart_backoff
         self.env = env
         self.params = params  # ProofParams for the worker's provers
@@ -41,8 +45,9 @@ class PostSupervisor:
         if not self._ready.wait(timeout):
             self.stop()
             raise TimeoutError("post worker did not come up")
-        assert self.address is not None
-        return self.address
+        if self.node_address is None:
+            assert self.address is not None
+        return self.address  # None in gRPC dial mode (worker has no port)
 
     def _spawn(self) -> subprocess.Popen:
         env = dict(os.environ if self.env is None else self.env)
@@ -54,6 +59,8 @@ class PostSupervisor:
             listen = f"{self.address[0]}:{self.address[1]}"
         cmd = [sys.executable, "-u", "-m", "spacemesh_tpu.post", "serve",
                "--data-dir", self.base_dir, "--listen", listen]
+        if self.node_address is not None:
+            cmd += ["--node-address", self.node_address]
         if self.params is not None:
             cmd += ["--k1", str(self.params.k1), "--k2", str(self.params.k2),
                     "--k3", str(self.params.k3),
@@ -79,6 +86,8 @@ class PostSupervisor:
                     continue
                 if ev.get("event") == "Serving":
                     self.address = (ev["host"], ev["port"])
+                    self._ready.set()
+                elif ev.get("event") == "Registering":
                     self._ready.set()
             self._proc.wait()
             if self._stopped.is_set():

@@ -1,0 +1,264 @@
+"""gRPC API: the PostService Register seam + query services.
+
+The seam test mirrors the reference's post_service_test.go: a post
+service dials the node's gRPC listener, Registers its identity over the
+bidirectional stream, and the node drives metadata + proof generation
+through it (reference api/grpcserver/post_service.go:91,
+post_client.go:37-146).  Runs on a real asyncio loop — gRPC owns real
+sockets and a poller thread, so no virtual clock here.
+"""
+
+import asyncio
+import hashlib
+
+import grpc
+import pytest
+
+from spacemesh_tpu.api.gen import core_pb2 as cpb
+from spacemesh_tpu.api.gen import post_pb2 as ppb
+from spacemesh_tpu.api.rpc import POST_REGISTER, GrpcApiServer
+from spacemesh_tpu.post import initializer, verifier
+from spacemesh_tpu.post.grpc_worker import GrpcWorker
+from spacemesh_tpu.post.prover import ProofParams
+from spacemesh_tpu.post.service import PostClient, PostService
+
+NODE_ID = hashlib.sha256(b"grpc-test-node").digest()
+COMMITMENT = hashlib.sha256(b"grpc-test-commitment").digest()
+PARAMS = ProofParams(k1=64, k2=8, k3=4,
+                     pow_difficulty=b"\x20" + b"\xff" * 31)
+
+
+@pytest.fixture(scope="module")
+def post_dir(tmp_path_factory):
+    d = tmp_path_factory.mktemp("grpcpost") / NODE_ID.hex()[:16]
+    initializer.initialize(
+        d, node_id=NODE_ID, commitment=COMMITMENT, num_units=1,
+        labels_per_unit=256, scrypt_n=2, batch_size=128)
+    return d
+
+
+def _service(post_dir) -> PostService:
+    svc = PostService()
+    svc.register(NODE_ID, PostClient(post_dir, PARAMS))
+    return svc
+
+
+async def _start_pair(post_dir):
+    server = GrpcApiServer(app=None, listen="127.0.0.1:0",
+                           post_query_interval=0.05)
+    port = await server.start()
+    worker = GrpcWorker(_service(post_dir), f"127.0.0.1:{port}",
+                        reconnect_backoff=0.2)
+    await worker.start()
+    await worker.wait_connected(timeout=10)
+    await server.post_service.wait_registered([NODE_ID], timeout=10)
+    return server, worker
+
+
+def test_register_info_proof_roundtrip(post_dir):
+    async def go():
+        server, worker = await _start_pair(post_dir)
+        try:
+            client = server.post_service.client(NODE_ID)
+            info = await asyncio.to_thread(client.info)
+            assert info.node_id == NODE_ID
+            assert info.commitment == COMMITMENT
+            assert info.num_units == 1
+            assert info.labels_per_unit == 256
+
+            challenge = hashlib.sha256(b"grpc-challenge").digest()
+            proof, _meta = await asyncio.to_thread(client.proof, challenge)
+            assert len(proof.indices) == PARAMS.k2
+            ok = verifier.verify(verifier.VerifyItem(
+                proof=proof, challenge=challenge, node_id=NODE_ID,
+                commitment=COMMITMENT, scrypt_n=2, total_labels=256), PARAMS)
+            assert ok, "proof over the gRPC seam failed verification"
+        finally:
+            await worker.stop()
+            await server.stop()
+
+    asyncio.run(go())
+
+
+def test_duplicate_identity_rejected(post_dir):
+    """A second Register for an already-streamed identity is refused
+    (reference post_service.go setConnection errors on duplicates)."""
+
+    async def go():
+        server, worker = await _start_pair(post_dir)
+        try:
+            async with grpc.aio.insecure_channel(
+                    f"127.0.0.1:{server.actual_port}") as channel:
+                stub = channel.stream_stream(
+                    POST_REGISTER,
+                    request_serializer=ppb.ServiceResponse.SerializeToString,
+                    response_deserializer=ppb.NodeRequest.FromString)
+                call = stub()
+                req = await call.read()  # metadata request
+                assert req.WhichOneof("kind") == "metadata"
+                await call.write(ppb.ServiceResponse(
+                    metadata=ppb.MetadataResponse(meta=ppb.Metadata(
+                        node_id=NODE_ID, commitment_atx_id=COMMITMENT,
+                        num_units=1, labels_per_unit=256))))
+                with pytest.raises(grpc.aio.AioRpcError) as e:
+                    await call.read()
+                assert e.value.code() == grpc.StatusCode.ALREADY_EXISTS
+        finally:
+            await worker.stop()
+            await server.stop()
+
+    asyncio.run(go())
+
+
+def test_worker_reconnects_after_node_restart(post_dir):
+    """The worker's dial loop re-Registers when the node comes back
+    (the reference post-service reconnects the same way)."""
+
+    async def go():
+        server, worker = await _start_pair(post_dir)
+        port = server.actual_port
+        try:
+            await server.stop()
+            await asyncio.sleep(0.3)
+            assert NODE_ID not in [*server.post_service.clients]
+            server2 = GrpcApiServer(app=None, listen=f"127.0.0.1:{port}",
+                                    post_query_interval=0.05)
+            await server2.start()
+            try:
+                await server2.post_service.wait_registered([NODE_ID],
+                                                           timeout=15)
+                client = server2.post_service.client(NODE_ID)
+                info = await asyncio.to_thread(client.info)
+                assert info.node_id == NODE_ID
+            finally:
+                await server2.stop()
+        finally:
+            await worker.stop()
+
+    asyncio.run(go())
+
+
+def test_subprocess_worker_registers_via_supervisor(post_dir):
+    """End-to-end over a REAL subprocess: the supervisor spawns
+    `spacemesh_tpu.post serve --node-address` and the worker dials in
+    (reference activation/post_supervisor.go + post service)."""
+    from spacemesh_tpu.post.supervisor import PostSupervisor
+
+    async def go():
+        server = GrpcApiServer(app=None, listen="127.0.0.1:0",
+                               post_query_interval=0.05)
+        port = await server.start()
+        sup = PostSupervisor(post_dir.parent, params=PARAMS,
+                             node_address=f"127.0.0.1:{port}",
+                             restart_backoff=0.2)
+        try:
+            await asyncio.to_thread(sup.start, 120)
+            await server.post_service.wait_registered([NODE_ID], timeout=60)
+            client = server.post_service.client(NODE_ID)
+            info = await asyncio.to_thread(client.info)
+            assert info.commitment == COMMITMENT
+        finally:
+            sup.stop()
+            await server.stop()
+
+    asyncio.run(go())
+
+
+def test_node_smeshes_through_grpc_worker(tmp_path):
+    """Full node seam e2e: smeshing with worker_grpc=True spawns the
+    worker subprocess, which dials the node's PostService and Registers;
+    the first ATX (epoch 0) is proven through the Register stream
+    (reference node + post-service deployment shape)."""
+    from spacemesh_tpu.api.rpc import GrpcPostClient
+    from spacemesh_tpu.node.app import App
+    from spacemesh_tpu.node.config import load
+    from spacemesh_tpu.storage import atxs as atxstore
+
+    cfg = load("standalone", overrides={
+        "data_dir": str(tmp_path / "node"),
+        "post": {"labels_per_unit": 256, "scrypt_n": 2, "k1": 64, "k2": 8,
+                 "k3": 4, "min_num_units": 1,
+                 "pow_difficulty": "20" + "ff" * 31},
+        "smeshing": {"start": True, "num_units": 1, "init_batch": 128,
+                     "external_worker": True, "worker_grpc": True},
+    })
+    app = App(cfg)
+
+    async def go():
+        try:
+            await asyncio.wait_for(app.prepare(), 300)
+            assert app.grpc_api.post_service.registered() == \
+                [s.node_id for s in app.signers]
+            for b in app.atx_builders:
+                assert isinstance(b.post_client, GrpcPostClient)
+            atx = atxstore.by_node_in_epoch(
+                app.state, app.signer.node_id, 0)
+            assert atx is not None, "no ATX proven through the gRPC worker"
+            assert atx.num_units == 1
+        finally:
+            await app.stop_grpc_api()
+            app.close()
+
+    asyncio.run(go())
+
+
+def test_query_services_against_live_node(tmp_path):
+    """Node/Mesh/GlobalState gRPC services answer over the wire."""
+    from spacemesh_tpu.node.app import App
+    from spacemesh_tpu.node.config import load
+
+    cfg = load("standalone", overrides={
+        "data_dir": str(tmp_path / "node"),
+        "smeshing": {"start": False},
+    })
+    app = App(cfg)
+
+    async def go():
+        port = await app.start_grpc_api()
+        try:
+            async with grpc.aio.insecure_channel(f"127.0.0.1:{port}") as ch:
+                echo = ch.unary_unary(
+                    "/spacemesh.v1.NodeService/Echo",
+                    request_serializer=cpb.EchoRequest.SerializeToString,
+                    response_deserializer=cpb.EchoResponse.FromString)
+                assert (await echo(cpb.EchoRequest(msg="hi"))).msg == "hi"
+
+                status = ch.unary_unary(
+                    "/spacemesh.v1.NodeService/Status",
+                    request_serializer=cpb.StatusRequest.SerializeToString,
+                    response_deserializer=cpb.StatusResponse.FromString)
+                st = await status(cpb.StatusRequest())
+                assert st.status.is_synced
+
+                gt = ch.unary_unary(
+                    "/spacemesh.v1.MeshService/GenesisTime",
+                    request_serializer=cpb.GenesisTimeRequest.SerializeToString,
+                    response_deserializer=cpb.GenesisTimeResponse.FromString)
+                assert (await gt(cpb.GenesisTimeRequest())).unixtime == \
+                    int(cfg.genesis.time)
+
+                gid = ch.unary_unary(
+                    "/spacemesh.v1.MeshService/GenesisID",
+                    request_serializer=cpb.GenesisIDRequest.SerializeToString,
+                    response_deserializer=cpb.GenesisIDResponse.FromString)
+                assert (await gid(cpb.GenesisIDRequest())).genesis_id == \
+                    cfg.genesis.genesis_id
+
+                acct = ch.unary_unary(
+                    "/spacemesh.v1.GlobalStateService/Account",
+                    request_serializer=cpb.AccountRequest.SerializeToString,
+                    response_deserializer=cpb.AccountResponse.FromString)
+                from spacemesh_tpu.core.types import Address
+                addr = Address(b"\x00" * 24).encode()
+                resp = await acct(cpb.AccountRequest(address=addr))
+                assert resp.account_wrapper.state_current.balance == 0
+
+                bad = acct(cpb.AccountRequest(address="nonsense"))
+                with pytest.raises(grpc.aio.AioRpcError) as e:
+                    await bad
+                assert e.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+        finally:
+            await app.stop_grpc_api()
+            app.close()
+
+    asyncio.run(go())

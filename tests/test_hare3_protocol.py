@@ -1,0 +1,324 @@
+"""Graded hare protocol core: adversarial timing + grading scenarios.
+
+Deterministic, clock-free: each case drives the pure machine round by
+round and injects messages at exact arrival rounds, the way the
+reference's hare3/protocol_test.go drives its protocol struct.  Scenario
+provenance is cited per test.
+"""
+
+import hashlib
+
+from spacemesh_tpu.consensus.hare3 import (
+    COMMIT,
+    GRADE1,
+    GRADE2,
+    GRADE3,
+    GRADE4,
+    GRADE5,
+    HARDLOCK,
+    NOTIFY,
+    PREROUND,
+    PROPOSE,
+    SOFTLOCK,
+    WAIT1,
+    WAIT2,
+    Input,
+    IterRound,
+    Protocol,
+    values_ref,
+)
+
+
+def pid(i: int) -> bytes:
+    return hashlib.sha256(b"prop%d" % i).digest()
+
+
+def nid(i: int) -> bytes:
+    return hashlib.sha256(b"node%d" % i).digest()
+
+
+def vrf(i: int) -> bytes:
+    return hashlib.sha256(b"vrf%d" % i).digest()
+
+
+def msg(sender, ir, *, values=None, reference=None, count=1, v=None,
+        mhash=None):
+    payload = (b"".join(sorted(values)) if values is not None
+               else reference or b"")
+    return Input(
+        sender=sender, ir=ir, eligibility_count=count,
+        vrf=v if v is not None else hashlib.sha256(sender).digest(),
+        msg_hash=mhash or hashlib.sha256(
+            sender + bytes([ir.iter, ir.round]) + payload).digest(),
+        values=values, reference=reference)
+
+
+class Driver:
+    """Advance a Protocol while injecting messages at chosen rounds."""
+
+    def __init__(self, threshold=3):
+        self.p = Protocol(threshold)
+        self.outputs = []
+
+    def now(self) -> IterRound:
+        return self.p.current
+
+    def tick(self):
+        out = self.p.next()
+        self.outputs.append(out)
+        return out
+
+    def tick_to(self, it, rnd):
+        """Advance until current == (it, rnd); returns last output."""
+        out = None
+        guard = 0
+        while self.p.current != IterRound(it, rnd):
+            out = self.tick()
+            guard += 1
+            assert guard < 64, "round never reached"
+        return out
+
+    def deliver(self, m: Input):
+        return self.p.on_input(m)
+
+
+def run_happy_iteration(d: Driver, senders=4, props=3):
+    """All messages on time, threshold=3 of 4 single-seat senders."""
+    values = [pid(i) for i in range(props)]
+    d.p.on_initial(values)
+    # preround: everyone sends, delivered during preround/softlock
+    out = d.tick()  # emits our preround message
+    assert out.message is not None and out.message.ir.round == PREROUND
+    for i in range(senders):
+        d.deliver(msg(nid(i), IterRound(0, PREROUND), values=values))
+    d.tick_to(0, PROPOSE)
+    out = d.tick()  # propose emission (leader-eligible driver would send)
+    assert sorted(out.message.values) == sorted(values)
+    # leader's propose arrives on time (within 1 round of propose)
+    d.deliver(msg(nid(0), IterRound(0, PROPOSE), values=values))
+    d.tick_to(0, COMMIT)
+    out = d.tick()
+    ref = values_ref(values)
+    assert out.message is not None and out.message.reference == ref
+    for i in range(senders):
+        d.deliver(msg(nid(i), IterRound(0, COMMIT), reference=ref))
+    out = d.tick()  # notify round
+    assert out.message is not None and out.message.reference == ref
+    for i in range(senders):
+        d.deliver(msg(nid(i), IterRound(0, NOTIFY), reference=ref))
+    return values, ref
+
+
+def test_happy_path_result_next_hardlock():
+    """Full clean iteration -> result at the next hardlock
+    (reference protocol_test.go sanity run)."""
+    d = Driver(threshold=3)
+    values, ref = run_happy_iteration(d)
+    out = d.tick()  # hardlock of iteration 1
+    assert out.result is not None
+    assert sorted(out.result) == sorted(values)
+    assert d.p.result == ref
+    # protocol participates one more iteration, then terminates
+    d.tick_to(2, HARDLOCK)
+    out = d.tick()  # executes hardlock of iteration 2
+    assert out.terminated
+
+
+def test_weak_coin_is_smallest_preround_vrf_lsb():
+    """Coin = LSB of the smallest preround VRF, emitted after softlock
+    (reference protocol.go:263-267, coin from preround messages)."""
+    d = Driver(threshold=2)
+    d.p.on_initial([pid(0)])
+    d.tick()
+    lo = bytes(31) + b"\x01"   # smallest, LSB 1
+    hi = b"\xff" * 32
+    d.deliver(msg(nid(0), IterRound(0, PREROUND), values=[pid(0)], v=hi))
+    d.deliver(msg(nid(1), IterRound(0, PREROUND), values=[pid(0)], v=lo))
+    out = d.tick()  # softlock -> coin comes out
+    assert out.coin is True
+
+
+def test_late_preround_gets_lower_grade():
+    """A preround message arriving 3 rounds late reaches grade3 only: it
+    counts for the commit-round g3 subset check but NOT for the propose
+    union at grade4 (reference execution: propose uses grade4,
+    condition (f) uses grade3)."""
+    d = Driver(threshold=1)
+    d.p.on_initial([])
+    d.tick()
+    # on-time preround for p0 arrives during softlock (delay 1)
+    d.deliver(msg(nid(0), IterRound(0, PREROUND), values=[pid(0)]))
+    d.tick()              # executes softlock -> current is propose
+    # late preround for p1 arrives in PROPOSE round: delay 2 -> grade4 still
+    d.deliver(msg(nid(1), IterRound(0, PREROUND), values=[pid(1)]))
+    out = d.tick()        # propose emission reads grade4 tallies
+    assert pid(0) in out.message.values and pid(1) in out.message.values
+    # a third preround arriving in wait1: delay 3 -> grade3, misses propose
+    d.deliver(msg(nid(2), IterRound(0, PREROUND), values=[pid(2)]))
+    g4 = d.p.gossip.threshold_gossip(IterRound(0, PREROUND), GRADE4)
+    g3 = d.p.gossip.threshold_gossip(IterRound(0, PREROUND), GRADE3)
+    assert pid(2) not in g4
+    assert pid(2) in g3
+
+
+def test_late_leader_demoted_to_grade1_not_committed():
+    """Gradecast 3(a): a propose arriving 2 rounds late gets grade1;
+    commit condition (e) requires grade2, so nobody commits to it
+    (reference protocol.go:391-407 + condition (e) at :205-233)."""
+    d = Driver(threshold=3)
+    values = [pid(0)]
+    d.p.on_initial(values)
+    d.tick()
+    for i in range(4):
+        d.deliver(msg(nid(i), IterRound(0, PREROUND), values=values))
+    d.tick_to(0, WAIT1)
+    # leader's propose surfaces in wait1: delay(propose)=1 -> still grade2
+    d.deliver(msg(nid(0), IterRound(0, PROPOSE), values=values))
+    d.tick()  # -> wait2
+    # a second would-be leader surfaces in wait2: delay 2 -> grade1
+    d.deliver(msg(nid(1), IterRound(0, PROPOSE), values=values,
+                  v=bytes(32)))  # best VRF — would win were it graded 2
+    gsets = d.p.gossip.gradecast(IterRound(0, PROPOSE))
+    grades = {g.smallest: g.grade for g in gsets}
+    assert grades[bytes(32)] == GRADE1
+    d.tick()  # -> commit round current
+    out = d.tick()
+    # commit happened (on-time leader's set), proving grade1 was skipped
+    assert out.message is not None
+    assert out.message.reference == values_ref(values)
+
+
+def test_too_late_leader_excluded_entirely():
+    """A propose arriving >2 rounds after the propose round gets no grade
+    at all (reference gradecast: both branches bounded by delay <= 2)."""
+    d = Driver(threshold=3)
+    values = [pid(0)]
+    d.p.on_initial(values)
+    d.tick()
+    for i in range(4):
+        d.deliver(msg(nid(i), IterRound(0, PREROUND), values=values))
+    d.tick_to(0, COMMIT)
+    d.deliver(msg(nid(0), IterRound(0, PROPOSE), values=values))  # delay 3
+    assert d.p.gossip.gradecast(IterRound(0, PROPOSE)) == []
+    out = d.tick()
+    assert out.message is None  # nothing valid to commit to
+
+
+def test_equivocating_leader_grade_boundaries():
+    """Gradecast 2(b)/3(b): a conflicting propose surfacing at delay 3
+    demotes the leader to grade1; at delay 4 the leader keeps grade2
+    (reference protocol.go:391-407)."""
+    for conflict_round, expected_grade in ((WAIT2, None), (COMMIT, GRADE1),
+                                           (NOTIFY, GRADE2)):
+        d = Driver(threshold=3)
+        d.p.on_initial([pid(0)])
+        d.tick()
+        for i in range(4):
+            d.deliver(msg(nid(i), IterRound(0, PREROUND), values=[pid(0)]))
+        d.tick_to(0, PROPOSE)
+        d.deliver(msg(nid(0), IterRound(0, PROPOSE), values=[pid(0)],
+                      mhash=b"a" * 32))
+        d.tick_to(0, conflict_round)
+        _, eq = d.deliver(msg(nid(0), IterRound(0, PROPOSE),
+                              values=[pid(1)], mhash=b"b" * 32))
+        assert eq is not None, "conflict must surface an equivocation proof"
+        gsets = d.p.gossip.gradecast(IterRound(0, PROPOSE))
+        if expected_grade is None:
+            # conflict at delay 2: leader fails both (a)-conditions
+            assert gsets == []
+        else:
+            assert len(gsets) == 1
+            assert gsets[0].grade == expected_grade
+            assert gsets[0].values == [pid(0)]
+
+
+def test_threshgossip_needs_one_honest_vote():
+    """Protocol 3: total >= threshold AND >= 1 non-equivocating vote.
+    An equivocator's weight counts toward the total but cannot carry a
+    value alone (reference thresholdGossip valid>0)."""
+    d = Driver(threshold=2)
+    d.p.on_initial([])
+    d.tick()
+    # equivocator with weight 2 backs p0 twice (conflicting messages)
+    d.deliver(msg(nid(0), IterRound(0, PREROUND), values=[pid(0)],
+                  count=2, mhash=b"x" * 32))
+    d.deliver(msg(nid(0), IterRound(0, PREROUND), values=[pid(0)],
+                  count=2, mhash=b"y" * 32))
+    assert d.p.gossip.threshold_gossip(IterRound(0, PREROUND), GRADE5) == []
+    # one honest single-seat vote joins: total 4 (2+2... the kept copy) —
+    # now the value passes because valid > 0
+    d.deliver(msg(nid(1), IterRound(0, PREROUND), values=[pid(0)], count=1))
+    assert d.p.gossip.threshold_gossip(
+        IterRound(0, PREROUND), GRADE5) == [pid(0)]
+
+
+def test_equivocation_detected_and_relayed_once():
+    """Graded-gossip case 3: conflicting message -> relay + proof; exact
+    duplicate -> no relay (reference protocol.go:349-376)."""
+    d = Driver(threshold=2)
+    d.p.on_initial([])
+    d.tick()
+    m1 = msg(nid(0), IterRound(0, PREROUND), values=[pid(0)], mhash=b"m" * 32)
+    relay, eq = d.deliver(m1)
+    assert relay and eq is None
+    relay, eq = d.deliver(m1)               # duplicate
+    assert not relay and eq is None
+    m2 = msg(nid(0), IterRound(0, PREROUND), values=[pid(1)], mhash=b"n" * 32)
+    relay, eq = d.deliver(m2)               # conflict
+    assert relay and eq is not None
+    assert eq.sender == nid(0)
+
+
+def test_hardlock_from_prev_commit_threshold():
+    """A grade4 commit threshold from iteration i-1 hard-locks iteration i
+    (reference execution hardlock: thresholdProposals(commit, grade4))."""
+    d = Driver(threshold=3)
+    values = [pid(0)]
+    ref = values_ref(values)
+    d.p.on_initial(values)
+    d.tick()
+    for i in range(4):
+        d.deliver(msg(nid(i), IterRound(0, PREROUND), values=values))
+    d.tick_to(0, PROPOSE)
+    d.deliver(msg(nid(0), IterRound(0, PROPOSE), values=values))
+    d.tick_to(0, COMMIT)
+    for i in range(4):
+        d.deliver(msg(nid(i), IterRound(0, COMMIT), reference=ref))
+    # NO notify threshold: notify messages lost
+    d.tick_to(1, SOFTLOCK)   # past hardlock of iter 1
+    assert d.p.hard_locked
+    assert d.p.locked == ref
+    # iteration 1 commit proposes/commits the locked reference
+    d.tick_to(1, COMMIT)
+    out = d.tick()
+    assert out.message is not None and out.message.reference == ref
+
+
+def test_commit_respects_softlock_condition_h():
+    """If iteration i-1 reached a grade3 commit threshold for ref A, the
+    soft lock forbids committing to a different proposal B in iteration i
+    (reference execution condition (h))."""
+    d = Driver(threshold=2)
+    a, b = [pid(0)], [pid(1)]
+    ref_a = values_ref(a)
+    d.p.on_initial(a)
+    d.tick()
+    for i in range(3):
+        d.deliver(msg(nid(i), IterRound(0, PREROUND),
+                      values=[pid(0), pid(1)]))
+    d.tick_to(0, PROPOSE)
+    d.deliver(msg(nid(0), IterRound(0, PROPOSE), values=a))  # leader: A
+    d.tick_to(0, COMMIT)
+    # commits for A arrive with grade4 (within 2 of commit round)
+    for i in range(2):
+        d.deliver(msg(nid(i), IterRound(0, COMMIT), reference=ref_a))
+    d.tick_to(1, PROPOSE)
+    assert d.p.locked == ref_a  # soft- or hard-locked on A
+    # iteration 1: leader proposes B on time
+    d.deliver(msg(nid(2), IterRound(1, PROPOSE), values=b))
+    d.tick_to(1, COMMIT)
+    out = d.tick()
+    # condition (h): locked ref != B -> no commit to B. Either we commit
+    # to A (hardlock path) or emit nothing.
+    if out.message is not None:
+        assert out.message.reference == ref_a
