@@ -70,6 +70,9 @@ class ApiServer:
         r.add_get("/v1/debug/state", self.debug_state)
         r.add_post("/v1/admin/checkpoint", self.admin_checkpoint)
         r.add_post("/v1/admin/recover", self.admin_recover)
+        r.add_post("/v1/admin/chaos/block", self.admin_chaos_block)
+        r.add_post("/v1/admin/chaos/clear", self.admin_chaos_clear)
+        r.add_post("/v1/admin/chaos/timeskew", self.admin_chaos_timeskew)
         r.add_get("/v1/events", self.events)
         r.add_get("/metrics", self.metrics)
 
@@ -309,6 +312,43 @@ class ApiServer:
             checkpoint_mod.recover_file, self.node.state, path,
             self.node.signer.node_id)
         return web.json_response({"recovered_layer": snap["layer"]})
+
+    # --- chaos fault injection (systest harness; reference
+    # systest/chaos/{partition,timeskew}.go) ---------------------------
+
+    async def admin_chaos_block(self, req) -> web.Response:
+        """Sever + refuse peers by listen address: the partition lever
+        the cluster harness pulls (transport Host.chaos_block)."""
+        host = getattr(self.node, "host", None)
+        if host is None:
+            raise web.HTTPConflict(text="no transport host")
+        try:
+            body = await req.json()
+            addrs = []
+            for spec in body.get("addrs", []):
+                h, _, p = spec.rpartition(":")
+                addrs.append((h, int(p)))
+        except (json.JSONDecodeError, ValueError, TypeError, AttributeError):
+            raise web.HTTPBadRequest(text='expected {"addrs": ["ip:port"]}')
+        host.chaos_block(addrs=addrs)
+        return web.json_response({"blocked": len(addrs)})
+
+    async def admin_chaos_clear(self, req) -> web.Response:
+        host = getattr(self.node, "host", None)
+        if host is None:
+            raise web.HTTPConflict(text="no transport host")
+        host.chaos_clear()
+        return web.json_response({"ok": True})
+
+    async def admin_chaos_timeskew(self, req) -> web.Response:
+        """Shift this node's clock by offset seconds (0 heals)."""
+        try:
+            body = await req.json()
+            offset = float(body["offset"])
+        except (json.JSONDecodeError, KeyError, ValueError, TypeError):
+            raise web.HTTPBadRequest(text='expected {"offset": seconds}')
+        self.node.time_offset = offset
+        return web.json_response({"offset": offset})
 
     async def metrics(self, req) -> web.Response:
         from ..consensus.tortoise import FULL

@@ -1,23 +1,30 @@
 """Hare: per-layer BFT agreement on the proposal set.
 
 Mirrors the reference hare's role and message flow (reference hare4/: a
-per-layer session of VRF-eligible committee members running
-preround -> [propose -> commit -> notify]* and emitting a ConsensusOutput
-of proposal ids consumed by the block generator, hare4/hare.go:708; round
-state machine hare4/protocol.go; equivocation -> malfeasance). The round
-structure here is the classic hare:
+per-layer session of VRF-eligible committee members emitting a
+ConsensusOutput of proposal ids consumed by the block generator,
+hare4/hare.go:708; equivocation -> malfeasance).  Decisions come from the
+PROVEN graded protocol core in ``hare3.py`` (reference hare3/protocol.go,
+reused by hare4): graded-gossip, gradecast and thresh-gossip over the
+8-round iteration
 
-  PREROUND  everyone eligible broadcasts its proposal-id set
-  PROPOSE   the leader (lowest VRF output among round-eligible members)
-            proposes the union of preround sets it saw
-  COMMIT    members that accept the proposal commit to it
-  NOTIFY    threshold weight of commits -> notify; threshold of notifies
-            (or a valid commit certificate) -> output
+  preround | hardlock softlock propose wait1 wait2 commit notify | ...
 
-Weights are eligibility counts; the threshold is > half the committee
-size. Rounds are wall-clock slots within the layer (round_duration), so
-all honest nodes move in lockstep like the reference's 700 ms rounds.
-"""
+Late or equivocating leaders are handled by GRADES (arrival delay vs. the
+propose round, conflict-surfacing delay), not acceptance windows.  On the
+WIRE only the four message rounds exist (preround/propose/commit/notify,
+same encoding as before — commit/notify carry the full value set; the
+protocol's reference hash is the values root).  Rounds are wall-clock
+slots (round_duration) measured from the layer start, so honest nodes
+move in lockstep like the reference's 25 s mainnet rounds; sessions are
+driven concurrently with the layer loop because one session legitimately
+outlives its layer (reference runs per-layer goroutines the same way).
+
+On top of the proven core this implementation keeps NOTIFY commit
+certificates: a NOTIFY must carry observed COMMIT messages proving the
+threshold, so a bare keypair cannot fabricate agreement for gossip
+consumers that missed the commits (a deliberate strengthening; the
+reference relies on thresh-gossip alone)."""
 
 from __future__ import annotations
 
@@ -30,9 +37,16 @@ from ..core.codec import fixed, u8, u16, u32, vec
 from ..core.signing import Domain, EdSigner, EdVerifier
 from ..core.types import EMPTY32
 from ..p2p.pubsub import TOPIC_HARE, PubSub
+from . import hare3
 from .eligibility import Oracle
 
+# wire round tags (unchanged encoding); the protocol's internal 8-round
+# structure maps onto these four message rounds
 PREROUND, PROPOSE, COMMIT, NOTIFY = 0, 1, 2, 3
+
+_WIRE_TO_PROTO = {PREROUND: hare3.PREROUND, PROPOSE: hare3.PROPOSE,
+                  COMMIT: hare3.COMMIT, NOTIFY: hare3.NOTIFY}
+_PROTO_TO_WIRE = {v: k for k, v in _WIRE_TO_PROTO.items()}
 
 
 @codec.register
@@ -142,110 +156,63 @@ class HareSession:
         self.h = hare
         self.layer = layer
         self.my_proposals = sorted(proposals)
-        self.preround_sets: dict[bytes, tuple[int, list[bytes]]] = {}
-        # iteration -> (vrf_output, values) of best PROPOSE; lowest VRF wins
-        self._best_propose: dict[int, tuple[bytes, list[bytes]]] = {}
+        # the proven graded machine makes every decision (hare3.py)
+        self.protocol = hare3.Protocol(hare.committee // 2 + 1)
         self.commits: dict[bytes, tuple[int, tuple]] = {}
         # (iteration, values) -> node_id -> (raw COMMIT, its own seat
         # count) — kept to assemble the NOTIFY commit certificate; the
         # count MUST come from the stored message, not the node's latest
         # commit (per-round VRF counts differ and receivers sum the raws)
         self.commit_raw: dict[tuple, dict[bytes, tuple[bytes, int]]] = {}
-        self.notifies: dict[bytes, tuple[int, tuple]] = {}
         self.output: Optional[list[bytes]] = None
         self.seen: dict[tuple, tuple[bytes, bytes]] = {}  # equivocation watch
-        self.excluded: set[bytes] = set()  # equivocators: zero weight
-        self.layer_start: float | None = None  # set when the driver runs
-        self.coin_vrf: Optional[bytes] = None  # lowest preround VRF output
-
-    # --- timing (grade windows) ------------------------------------
-
-    def _slot_of(self, iteration: int, round_: int) -> int:
-        base = {PREROUND: 0, PROPOSE: 1, COMMIT: 2, NOTIFY: 3}[round_]
-        return 0 if round_ == PREROUND else base + 3 * iteration
-
-    def too_late(self, msg: HareMessage) -> bool:
-        """Acceptance window (the gradecast equivalent): COMMIT/NOTIFY
-        messages count only within a few slots of their own round — a
-        message that surfaces much later must not flip decisions. The
-        window is deliberately wider than one slot: weights are read at
-        fixed instants anyway (late arrivals cannot rewrite a past read,
-        and late NOTIFYs are commit-certificate-backed so counting them
-        in the grace pass is safe), while validation latency must not
-        disqualify honest messages. PREROUND/PROPOSE stay open (their
-        reads are one-shot, and late prerounds only help liveness)."""
-        if self.layer_start is None or msg.round in (PREROUND, PROPOSE):
-            return False
-        slot = self._slot_of(msg.iteration, msg.round)
-        deadline = (self.layer_start + self.h.preround_delay
-                    + (slot + 4) * self.h.round_duration)
-        return self.h.wall() > deadline
+        self.excluded: set[bytes] = set()  # equivocators (reporting dedup)
 
     # --- message handling ------------------------------------------
 
     def on_message(self, msg: HareMessage, raw_signed: bytes | None = None,
-                   raw_full: bytes | None = None) -> None:
-        """``raw_signed``/``raw_full`` override the wire bytes used for
-        the equivocation watch and certificate assembly — compact-mode
-        messages keep their COMPACT encoding (that's what signatures
-        cover and what certificates must carry)."""
-        key = (msg.node_id, msg.iteration, msg.round)
-        prev = self.seen.get(key)
+                   raw_full: bytes | None = None) -> bool:
+        """Feed one validated wire message to the graded protocol; returns
+        the graded-gossip relay decision.  ``raw_signed``/``raw_full``
+        override the wire bytes used for the equivocation report and
+        certificate assembly — compact-mode messages keep their COMPACT
+        encoding (that's what signatures cover and certificates carry)."""
+        from ..core.hashing import sum256
+        from ..core.signing import vrf_output
+
         raw = raw_signed if raw_signed is not None else msg.signed_bytes()
-        if prev is not None and prev[0] != raw:
-            # equivocator: report AND exclude its weight from every round
-            self.excluded.add(msg.node_id)
-            # report with the WIRE bytes the signature actually covers
-            # (compact-mode signatures sign the compact encoding)
+        key = (msg.node_id, msg.iteration, msg.round)
+        prev = self.seen.setdefault(key, (raw, msg.signature))
+        sorted_values = sorted(msg.values)
+        inp = hare3.Input(
+            sender=msg.node_id,
+            ir=hare3.IterRound(msg.iteration, _WIRE_TO_PROTO[msg.round]),
+            eligibility_count=msg.eligibility_count,
+            vrf=vrf_output(msg.eligibility_proof),
+            msg_hash=sum256(raw),
+            values=(sorted_values if msg.round in (PREROUND, PROPOSE)
+                    else None),
+            reference=(values_root(sorted_values)
+                       if msg.round in (COMMIT, NOTIFY) else None))
+        relay, equivocation = self.protocol.on_input(inp)
+        if equivocation is not None and msg.node_id not in self.excluded:
+            self.excluded.add(msg.node_id)  # report once per identity
             self.h._report_equivocation(msg.node_id, prev, raw,
                                         msg.signature)
-            return
-        self.seen[key] = (raw, msg.signature)
-        if msg.node_id in self.excluded or self.too_late(msg):
-            return
-        w = msg.eligibility_count
-        if msg.round == PREROUND:
-            self.preround_sets[msg.node_id] = (w, msg.values)
-            # weak coin: lowest preround VRF output's LSB (reference
-            # hare weakcoin — unforgeable, shared by every listener)
-            from ..core.signing import vrf_output
-
-            out = vrf_output(msg.eligibility_proof)
-            if self.coin_vrf is None or out < self.coin_vrf:
-                self.coin_vrf = out
-        elif msg.round == PROPOSE:
-            # leader = lowest VRF output among eligible proposers
-            # (reference hare3 leader rule; ADVICE r1 — first-arrival was
-            # adversary-steerable via gossip ordering)
-            from ..core.signing import vrf_output
-
-            out = vrf_output(msg.eligibility_proof)
-            best = self._best_propose.get(msg.iteration)
-            if best is None or out < best[0]:
-                self._best_propose[msg.iteration] = (out, sorted(msg.values))
-        elif msg.round == COMMIT:
-            self.commits[msg.node_id] = (w, tuple(msg.values))
+        if not relay:
+            return False
+        if msg.round == COMMIT:
+            # certificate bookkeeping only — weight DECISIONS live in the
+            # graded protocol (hare3.Protocol)
+            w = msg.eligibility_count
+            self.commits[msg.node_id] = (w, tuple(sorted_values))
             self.commit_raw.setdefault(
-                (msg.iteration, tuple(msg.values)), {})[msg.node_id] = \
+                (msg.iteration, tuple(sorted_values)), {})[msg.node_id] = \
                 (raw_full if raw_full is not None else msg.to_bytes(), w)
-        elif msg.round == NOTIFY:
-            self.notifies[msg.node_id] = (w, tuple(msg.values))
-
-    # --- round actions ---------------------------------------------
-
-    def candidates(self) -> list[bytes]:
-        union: set[bytes] = set(self.my_proposals)
-        for node_id, (_, values) in self.preround_sets.items():
-            if node_id not in self.excluded:
-                union.update(values)
-        return sorted(union)
+        return True
 
     def commit_weight(self, values: tuple) -> int:
         return sum(w for n, (w, v) in self.commits.items()
-                   if v == values and n not in self.excluded)
-
-    def notify_weight(self, values: tuple) -> int:
-        return sum(w for n, (w, v) in self.notifies.items()
                    if v == values and n not in self.excluded)
 
     def build_certificate(self, iteration: int, values: tuple,
@@ -350,8 +317,7 @@ class Hare:
                 msg.layer, msg.iteration, values_root(sorted(msg.values)),
                 msg.cert_msgs):
             return False
-        self._dispatch(msg)
-        return True
+        return self._dispatch(msg)
 
     def _remember_valid_commit(self, raw: bytes) -> None:
         self._valid_commits[raw] = None
@@ -360,14 +326,19 @@ class Hare:
                 del self._valid_commits[k]
 
     def _dispatch(self, msg: HareMessage, raw_signed: bytes | None = None,
-                  raw_full: bytes | None = None) -> None:
+                  raw_full: bytes | None = None):
+        """Graded-gossip relay decision: True = relay, None = accept but
+        suppress relay (duplicate / post-equivocation copy) — NEVER False
+        here, because the delivering peer did nothing wrong and must not
+        be penalized for a duplicate (reference protocol.go:349-376)."""
         session = self.sessions.get(msg.layer)
         if session is not None:
-            session.on_message(msg, raw_signed, raw_full)
-        else:
-            buf = self._pending.setdefault(msg.layer, [])
-            if len(buf) < self._pending_cap:
-                buf.append((msg, raw_signed, raw_full))
+            return True if session.on_message(msg, raw_signed, raw_full) \
+                else None
+        buf = self._pending.setdefault(msg.layer, [])
+        if len(buf) < self._pending_cap:
+            buf.append((msg, raw_signed, raw_full))
+        return True  # not judged yet: let it propagate
 
     # --- compaction (reference hare4) -------------------------------
 
@@ -457,8 +428,8 @@ class Hare:
             values=values, eligibility_proof=cm.eligibility_proof,
             eligibility_count=cm.eligibility_count, atx_id=cm.atx_id,
             node_id=cm.node_id, cert_msgs=[], signature=cm.signature)
-        self._dispatch(full, raw_signed=cm.signed_bytes(), raw_full=data)
-        return True
+        return self._dispatch(full, raw_signed=cm.signed_bytes(),
+                              raw_full=data)
 
     async def _validate_cert(self, layer: int, iteration: int,
                              expected_root: bytes,
@@ -519,21 +490,22 @@ class Hare:
 
     async def run_layer(self, layer: int,
                         layer_start: float | None = None) -> ConsensusOutput:
-        """Run the full session for a layer.
+        """Run the full graded session for a layer.
 
-        Rounds are ABSOLUTE wall-clock slots measured from ``layer_start``
-        (reference hare rounds are fixed slots within the layer): slot k
-        ends at layer_start + preround_delay + (k+1) * round_duration, so
-        nodes stay in lockstep however late their session code entered —
-        a node whose proposal build ran long still reads each round's
-        messages at the same instant as its peers.
+        One protocol round per wall-clock slot, ABSOLUTE from
+        ``layer_start`` (reference hare rounds are fixed slots within the
+        layer): tick t fires at layer_start + preround_delay +
+        t*round_duration, so nodes stay in lockstep however late their
+        session code entered.  Sessions legitimately outlive their layer
+        (8 rounds/iteration; the reference's mainnet sessions do too) —
+        the caller runs them concurrently with the layer loop.
         """
         if layer_start is None:
             layer_start = self.wall()
 
-        async def until_slot(k: int) -> None:
+        async def until_tick(t: int) -> None:
             target = (layer_start + self.preround_delay
-                      + (k + 1) * self.round_duration)
+                      + t * self.round_duration)
             delay = target - self.wall()
             if delay > 0:
                 await asyncio.sleep(delay)
@@ -547,102 +519,105 @@ class Hare:
             if s is not None
             and (atx := self.atx_for(epoch, s.node_id)) is not None]
         session = HareSession(self, layer, [])
-        session.layer_start = layer_start
         self.sessions[layer] = session
         for msg, rs, rf in self._pending.pop(layer, ()):  # early arrivals
             session.on_message(msg, rs, rf)
         for stale in [x for x in self._pending if x < layer]:
             del self._pending[stale]
 
-        # preround_delay gives proposals time to build + propagate
-        # (reference PreroundDelay); the proposal snapshot happens at the
-        # preround SEND, not at session entry. slot -1 ends exactly at
-        # layer_start + preround_delay.
-        await until_slot(-1)
-        session.my_proposals = sorted(self.proposals_for(layer))
+        # > half the committee seats. Seat counts are weight-derived (the
+        # committee's total seats sum to ~committee_size network-wide), so
+        # the same constant is safe for any network size — a lone smesher
+        # with all the weight holds ~all committee seats itself.
+        threshold = self.committee // 2 + 1
+        protocol = session.protocol
 
-        async def maybe_send(iteration: int, round_: int, values: list[bytes],
-                             cert: list[bytes] | None = None):
-            round_tag = iteration * 4 + round_
+        async def send(om: hare3.OutMessage) -> None:
+            iteration, wire_round = om.ir.iter, _PROTO_TO_WIRE[om.ir.round]
+            if om.values is not None:
+                values = sorted(om.values)
+            else:
+                values = protocol.valid_proposals.get(om.reference)
+                if values is None:
+                    return  # nothing provable to carry on the wire
+            cert: list[bytes] | None = None
+            if wire_round == NOTIFY:
+                # certificate strengthening: prove the commit threshold
+                cert = session.build_certificate(iteration, tuple(values),
+                                                 threshold)
+                if not cert:
+                    return  # we saw the threshold via grading but cannot
+                    # prove it to cert-checking receivers yet
+            round_tag = iteration * 4 + wire_round
             for signer, vrf, atx in participants:
                 el = self.oracle.hare_eligibility(
                     vrf, beacon, layer, round_tag, epoch, atx, self.committee)
                 if el is None:
                     continue
                 proof, count = el
-                full_values = sorted(values)
                 if self.compact:
                     cm = CompactHareMessage(
-                        layer=layer, iteration=iteration, round=round_,
-                        compact_ids=[compact_id(v) for v in full_values],
-                        root=values_root(full_values),
+                        layer=layer, iteration=iteration, round=wire_round,
+                        compact_ids=[compact_id(v) for v in values],
+                        root=values_root(values),
                         eligibility_proof=proof, eligibility_count=count,
                         atx_id=atx, node_id=signer.node_id,
                         cert_msgs=list(cert or []), signature=bytes(64))
                     cm.signature = signer.sign(Domain.HARE,
                                                cm.signed_bytes())
                     self._remember_full(
-                        (layer, iteration, round_, signer.node_id),
-                        full_values)
+                        (layer, iteration, wire_round, signer.node_id),
+                        list(values))
                     await self.pubsub.publish(TOPIC_HARE_COMPACT,
                                               cm.to_bytes())
                     continue
                 msg = HareMessage(
-                    layer=layer, iteration=iteration, round=round_,
-                    values=full_values, eligibility_proof=proof,
+                    layer=layer, iteration=iteration, round=wire_round,
+                    values=list(values), eligibility_proof=proof,
                     eligibility_count=count, atx_id=atx,
                     node_id=signer.node_id, cert_msgs=list(cert or []),
                     signature=bytes(64))
                 msg.signature = signer.sign(Domain.HARE, msg.signed_bytes())
                 await self.pubsub.publish(TOPIC_HARE, msg.to_bytes())
 
-        # > half the committee seats. Seat counts are weight-derived (the
-        # committee's total seats sum to ~committee_size network-wide), so
-        # the same constant is safe for any network size — a lone smesher
-        # with all the weight holds ~all committee seats itself.
-        threshold = self.committee // 2 + 1
+        # preround_delay gives proposals time to build + propagate
+        # (reference PreroundDelay); the proposal snapshot happens at the
+        # preround SEND, not at session entry.
+        await until_tick(0)
+        session.my_proposals = sorted(self.proposals_for(layer))
+        protocol.on_initial(session.my_proposals)
 
-        await maybe_send(0, PREROUND, session.my_proposals)
-        await until_slot(0)
-
-        for it in range(self.iteration_limit):
-            # PROPOSE (leader: lowest VRF output among eligible proposers)
-            await maybe_send(it, PROPOSE, session.candidates())
-            await until_slot(1 + 3 * it)
-            best = session._best_propose.get(it)
-            proposal = best[1] if best else session.candidates()
-            # COMMIT
-            await maybe_send(it, COMMIT, proposal)
-            await until_slot(2 + 3 * it)
-            committed = tuple(sorted(proposal))
-            have = session.commit_weight(committed)
-            # NOTIFY happens if enough commit weight was observed — and it
-            # carries the commit certificate PROVING that threshold
-            if have >= threshold:
-                cert = session.build_certificate(it, committed, threshold)
-                if cert:
-                    await maybe_send(it, NOTIFY, list(committed), cert=cert)
-            await until_slot(3 + 3 * it)
-            if session.notify_weight(committed) >= threshold:
-                session.output = list(committed)
+        result: Optional[list[bytes]] = None
+        emitted: Optional[ConsensusOutput] = None
+        coin: Optional[bool] = None
+        tick = 0
+        while True:
+            out = protocol.next()
+            if out.coin is not None:
+                coin = out.coin
+            if out.result is not None and result is None:
+                result = out.result
+                session.output = list(result)
+                # deliver the moment agreement lands (block generation
+                # must not wait out the helper iteration)
+                emitted = ConsensusOutput(layer=layer, proposals=result,
+                                          completed=True, coin=coin)
+                await self.on_output(emitted)
+            if out.message is not None:
+                await send(out.message)
+            if out.terminated:
+                break  # result emitted + one helper iteration completed
+            if protocol.current.iter >= self.iteration_limit \
+                    and protocol.current.round > hare3.HARDLOCK:
+                # the hardlock of iteration `limit` was the last chance to
+                # surface a result from the final notify round
                 break
+            tick += 1
+            await until_tick(tick)
 
-        if session.output is None:
-            # grace pass: NOTIFYs are certificate-backed, so if threshold
-            # notify weight for ANY value set arrives a beat late, it is
-            # still a safe output — better than wrongly concluding empty
-            # while the rest of the network agreed
-            await until_slot(3 + 3 * (self.iteration_limit - 1) + 1)
-            for values in {v for _, v in session.notifies.values()}:
-                if session.notify_weight(values) >= threshold:
-                    session.output = list(values)
-                    break
-
-        out = ConsensusOutput(
-            layer=layer, proposals=session.output or [],
-            completed=session.output is not None,
-            coin=(bool(session.coin_vrf[-1] & 1)
-                  if session.coin_vrf is not None else None))
-        await self.on_output(out)
+        if emitted is None:
+            emitted = ConsensusOutput(layer=layer, proposals=[],
+                                      completed=False, coin=coin)
+            await self.on_output(emitted)
         del self.sessions[layer]
-        return out
+        return emitted

@@ -44,8 +44,14 @@ from .config import Config
 class App:
     def __init__(self, cfg: Config, *, signer: EdSigner | None = None,
                  pubsub: PubSub | None = None,
-                 time_source=time.time):
+                 time_source=None):
         self.cfg = cfg
+        # mutable skew over real time (chaos timeskew scenarios,
+        # reference systest/chaos/timeskew.go:12); explicit time_source
+        # injection (virtual-clock tests) bypasses it
+        self.time_offset = 0.0
+        if time_source is None:
+            time_source = lambda: time.time() + self.time_offset  # noqa: E731
         self.time_source = time_source
         self.data = Path(cfg.data_dir)
         self.data.mkdir(parents=True, exist_ok=True)
@@ -64,6 +70,7 @@ class App:
         self.golden_atx = sum256(b"golden", prefix)
         self._wire()
         self._tasks: list[asyncio.Task] = []
+        self._hare_tasks: dict[int, asyncio.Task] = {}  # layer -> session
         self.stopped = asyncio.Event()
         self._recover_state()
 
@@ -962,18 +969,41 @@ class App:
             if epoch not in seen_epochs:
                 seen_epochs.add(epoch)
                 asyncio.ensure_future(self._epoch_start(epoch))
-            # proposal building runs concurrently with the session: hare's
-            # preround snapshot waits preround_delay, which covers the
-            # build (VRF slot proofs) + gossip propagation
-            await asyncio.gather(
-                *(m.build(layer) for m in self.miners),
+            # hare sessions run CONCURRENTLY with the layer loop — the
+            # graded protocol's 8-round iterations legitimately outlive a
+            # layer (reference runs per-layer sessions the same way);
+            # proposal building must finish before the preround snapshot,
+            # which preround_delay covers
+            ht = asyncio.ensure_future(
                 self.hare.run_layer(layer, self.clock.time_of(layer)))
+            self._hare_tasks[layer] = ht
+            ht.add_done_callback(self._reap_hare(layer))
+            await asyncio.gather(*(m.build(layer) for m in self.miners))
             self.mesh.process_layer(layer)
-            self.events.emit(events_mod.LayerUpdate(layer=layer,
-                                                    status="applied"))
+            # report the frontier that is ACTUALLY applied — with hare
+            # running concurrently, layer L's block typically lands after
+            # this tick, and the event stream must not claim otherwise
+            self.events.emit(events_mod.LayerUpdate(
+                layer=self.mesh.latest_applied, status="applied"))
             if until_layer is not None and layer >= until_layer:
                 break
+        # drain in-flight sessions so the final layers still get their
+        # hare outputs (callers stopping hard cancel via stop()/close())
+        if self._hare_tasks:
+            await asyncio.gather(*list(self._hare_tasks.values()),
+                                 return_exceptions=True)
+            self.mesh.process_layer(int(self.clock.current_layer()))
         self.stopped.set()
+
+    def _reap_hare(self, layer: int):
+        def _done(task: asyncio.Task) -> None:
+            self._hare_tasks.pop(layer, None)
+            if not task.cancelled() and task.exception() is not None:
+                import logging
+
+                logging.getLogger("hare").error(
+                    "layer %d session failed: %r", layer, task.exception())
+        return _done
 
     async def _epoch_start(self, epoch: int) -> None:
         participants = [
@@ -988,6 +1018,9 @@ class App:
             await self.publish_atx(epoch)  # targets epoch+1
 
     def close(self) -> None:
+        for t in self._hare_tasks.values():
+            t.cancel()
+        self._hare_tasks.clear()
         if self.post_supervisor is not None:
             self.post_supervisor.stop()
         self.state.close()

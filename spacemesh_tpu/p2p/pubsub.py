@@ -29,7 +29,9 @@ TOPIC_MALFEASANCE = "mp1"
 TOPIC_CERTIFY = "bc1"
 TOPIC_POET = "pt1"
 
-Handler = Callable[[bytes, bytes], Awaitable[bool]]  # (peer, data) -> accept
+# (peer, data) -> True: accept + relay; None: accept but do NOT relay
+# (graded-gossip duplicate/suppressed); False: reject (penalize sender).
+Handler = Callable[[bytes, bytes], Awaitable[bool]]
 
 
 class PubSub:
@@ -51,13 +53,19 @@ class PubSub:
         if self._hub is not None:
             await self._hub.broadcast(self, topic, data)
 
-    async def deliver(self, topic: str, peer: bytes, data: bytes) -> bool:
+    async def deliver(self, topic: str, peer: bytes, data: bytes):
+        """Tri-state aggregate over the topic's handlers: False if any
+        rejected, else None if any suppressed relay, else True."""
         ok = True
         for h in self._handlers.get(topic, ()):
             try:
-                ok = await h(peer, data) and ok
+                r = await h(peer, data)
             except Exception:  # noqa: BLE001 — a bad message must not kill the bus
+                r = False
+            if r is False:
                 ok = False
+            elif r is None and ok is True:
+                ok = None
         return ok
 
 

@@ -33,6 +33,7 @@ the dial initiated by the LOWER id survives).
 from __future__ import annotations
 
 import asyncio
+import random
 import struct
 import time
 from typing import Optional
@@ -45,6 +46,9 @@ MSG_GOSSIP = 1
 MSG_REQ = 2
 MSG_RESP = 3
 MSG_PEERS = 4
+MSG_GOSSIP_CTRL = 5   # gossipsub-lite GRAFT/PRUNE/IHAVE/IWANT
+MSG_FIND = 6          # iterative discovery: find peers near a target id
+MSG_FOUND = 7         # reply: (id, addr) entries sorted by XOR distance
 
 MAX_FRAME = 64 << 20
 SEEN_CAP = 1 << 14
@@ -52,6 +56,11 @@ SEEN_CAP = 1 << 14
 
 class HandshakeError(Exception):
     pass
+
+
+def _xor_dist(a: bytes, b: bytes) -> int:
+    """Kademlia XOR metric over 32-byte ids."""
+    return int.from_bytes(a, "big") ^ int.from_bytes(b, "big")
 
 
 SEND_QUEUE_CAP = 4096
@@ -134,8 +143,10 @@ class Host:
                  listen: str = "127.0.0.1:0", bootstrap: list[str] = (),
                  min_peers: int = 3, max_peers: int = 32,
                  reject_limit: int = 16, ban_seconds: float = 60.0,
-                 request_timeout: float = 10.0):
+                 request_timeout: float = 10.0,
+                 gossip_degree: int = 6, gossip_heartbeat: float = 1.0):
         from ..core.signing import EdVerifier
+        from .gossipmesh import GossipMesh
 
         self.signer = signer
         self.node_id = signer.node_id
@@ -156,11 +167,26 @@ class Host:
         self._seen: dict[bytes, None] = {}              # gossip msg-id LRU
         self._req_id = 0
         self._pending: dict[int, asyncio.Future] = {}
+        self._disc_pending: dict[int, asyncio.Future] = {}
+        # chaos fault injection (systest partition tooling; reference
+        # systest/chaos/partition.go does this with iptables — here the
+        # transport refuses the blocked peers itself)
+        self._blocked_addrs: set[tuple] = set()
+        self._blocked_ids: set[bytes] = set()
         self._tasks: list[asyncio.Task] = []
         self._listener: asyncio.AbstractServer | None = None
         self._pubsub = None
         self._server = None
         self._stopping = False
+        # gossipsub-lite mesh (p2p/gossipmesh.py); degree bounds scale
+        # from the configured degree like the reference's D/D_lo/D_hi
+        self.gossip = GossipMesh(
+            degree=gossip_degree,
+            d_lo=max(2, gossip_degree - 2), d_hi=gossip_degree + 2,
+            rng=random.Random(int.from_bytes(self.node_id[:4], "little")))
+        self.gossip_heartbeat = gossip_heartbeat
+        self.stats = {"gossip_tx": 0, "gossip_rx": 0, "gossip_dup": 0,
+                      "ihave_tx": 0, "iwant_served": 0}
 
     # ------------------------------------------------------------------
     # seam plumbing
@@ -214,6 +240,7 @@ class Host:
 
     async def _maintain(self, interval: float = 1.0) -> None:
         """Keep dialing known addresses until min_peers is met."""
+        last_heartbeat = 0.0
         while not self._stopping:
             try:
                 if len(self._conns) < self.min_peers:
@@ -228,9 +255,30 @@ class Host:
                             continue
                         self._known[addr] = now
                         asyncio.ensure_future(self._dial(addr))
+                now = time.monotonic()
+                if now - last_heartbeat >= self.gossip_heartbeat:
+                    last_heartbeat = now
+                    await self._gossip_heartbeat()
             except Exception:  # noqa: BLE001 — keep the maintainer alive
                 pass
-            await asyncio.sleep(interval)
+            await asyncio.sleep(min(interval, self.gossip_heartbeat))
+
+    async def _gossip_heartbeat(self) -> None:
+        """Mesh maintenance + lazy IHAVE (gossipsub heartbeat)."""
+        from .gossipmesh import IHAVE, encode_ctrl
+
+        sends = self.gossip.heartbeat(set(self._conns))
+        for peer, subtype, topic, ids in sends:
+            conn = self._conns.get(peer)
+            if conn is None:
+                continue
+            if subtype == IHAVE:
+                self.stats["ihave_tx"] += 1
+            try:
+                await conn.send(MSG_GOSSIP_CTRL,
+                                encode_ctrl(subtype, topic, ids))
+            except (OSError, ConnectionError):
+                self._drop(conn)
 
     # ------------------------------------------------------------------
     # connections
@@ -255,7 +303,27 @@ class Host:
         sig = payload[1 + glen + 34:1 + glen + 34 + 64]
         return genesis, node_id, port, sig
 
+    # -- chaos fault injection (systest partition scenarios) --
+
+    def chaos_block(self, addrs: list = (), node_ids: list = ()) -> None:
+        """Sever + refuse the given peers (listen addrs and/or ids) until
+        chaos_clear(). The transport-level stand-in for the reference's
+        iptables partition (systest/chaos/partition.go:14)."""
+        self._blocked_addrs.update(tuple(a) for a in addrs)
+        self._blocked_ids.update(node_ids)
+        for pid, conn in list(self._conns.items()):
+            if pid in self._blocked_ids or (
+                    conn.listen_addr
+                    and tuple(conn.listen_addr) in self._blocked_addrs):
+                self._drop(conn)
+
+    def chaos_clear(self) -> None:
+        self._blocked_addrs.clear()
+        self._blocked_ids.clear()
+
     async def _dial(self, addr: tuple[str, int]) -> None:
+        if tuple(addr) in self._blocked_addrs:
+            return
         try:
             reader, writer = await asyncio.wait_for(
                 asyncio.open_connection(addr[0], addr[1]), 5.0)
@@ -301,12 +369,16 @@ class Host:
             raise HandshakeError("self-dial")
         if self._banned.get(peer_id, 0) > time.monotonic():
             raise HandshakeError("peer banned")
+        if peer_id in self._blocked_ids:
+            raise HandshakeError("peer blocked (chaos)")
         if (len(self._conns) >= self.max_peers
                 and peer_id not in self._conns):
             raise HandshakeError("max peers reached")
         peer_host = writer.get_extra_info("peername")[0]
         listen_addr = dialed_addr or ((peer_host, peer_port)
                                       if peer_port else None)
+        if listen_addr and tuple(listen_addr) in self._blocked_addrs:
+            raise HandshakeError("address blocked (chaos)")
         conn = _Conn(reader, writer, peer_id, listen_addr, outbound,
                      channel=channel)
 
@@ -343,6 +415,7 @@ class Host:
         conn.close()
         if self._conns.get(conn.node_id) is conn:
             del self._conns[conn.node_id]
+            self.gossip.drop_peer(conn.node_id)
         if ban:
             self._banned[conn.node_id] = time.monotonic() + self.ban_seconds
         # let the conn's own loops finish, then reap them (peer churn must
@@ -374,6 +447,12 @@ class Host:
                     self._handle_resp(conn, payload)
                 elif ftype == MSG_PEERS:
                     self._handle_peers(payload)
+                elif ftype == MSG_GOSSIP_CTRL:
+                    await self._handle_gossip_ctrl(conn, payload)
+                elif ftype == MSG_FIND:
+                    await self._handle_find(conn, payload)
+                elif ftype == MSG_FOUND:
+                    self._handle_found(conn, payload)
         except (OSError, ConnectionError, asyncio.IncompleteReadError,
                 HandshakeError, ChannelError, struct.error, ValueError,
                 IndexError, UnicodeDecodeError):
@@ -418,25 +497,59 @@ class Host:
         if sum256(topic.encode(), data) != msg_id:
             self._penalize(conn)
             return
+        self.stats["gossip_rx"] += 1
         if not self._mark_seen(msg_id):
+            self.stats["gossip_dup"] += 1
             return
         ok = True
         if self._pubsub is not None:
             ok = await self._pubsub.deliver(topic, conn.node_id, data)
         if ok:
-            await self._relay(payload, exclude=conn.node_id)
-        else:
+            # eager-push along the topic mesh only (gossipsub forwarding);
+            # lazy IHAVE repairs non-mesh peers at the next heartbeat
+            self.gossip.on_message(msg_id, topic, payload)
+            targets = self.gossip.eager_targets(topic, set(self._conns),
+                                                exclude=conn.node_id)
+            await self._relay(payload, targets)
+        elif ok is False:
             self._penalize(conn)
+        # ok is None: accepted but relay-suppressed (graded-gossip dup) —
+        # an honest relayer must not be penalized for delivering it
+
+    async def _handle_gossip_ctrl(self, conn: _Conn, payload: bytes) -> None:
+        """GRAFT/PRUNE/IHAVE/IWANT (gossipsub control plane)."""
+        from .gossipmesh import encode_ctrl
+
+        replies = self.gossip.on_control(conn.node_id, payload,
+                                         seen=lambda mid: mid in self._seen)
+        for subtype, topic, ids in replies:
+            try:
+                if subtype == -1:  # answer IWANT with the full frames
+                    for mid in ids:
+                        frame = self.gossip.cache.get(mid)
+                        if frame is not None:
+                            self.stats["iwant_served"] += 1
+                            self.stats["gossip_tx"] += 1
+                            await conn.send(MSG_GOSSIP, frame)
+                else:
+                    await conn.send(MSG_GOSSIP_CTRL,
+                                    encode_ctrl(subtype, topic, ids))
+            except (OSError, ConnectionError):
+                self._drop(conn)
+                return
 
     def _penalize(self, conn: _Conn) -> None:
         conn.score += 1
         if conn.score >= self.reject_limit:
             self._drop(conn, ban=True)
 
-    async def _relay(self, frame_payload: bytes, exclude: bytes) -> None:
-        for peer_id, conn in list(self._conns.items()):
-            if peer_id == exclude:
+    async def _relay(self, frame_payload: bytes,
+                     targets: set[bytes]) -> None:
+        for peer_id in targets:
+            conn = self._conns.get(peer_id)
+            if conn is None:
                 continue
+            self.stats["gossip_tx"] += 1
             try:
                 await conn.send(MSG_GOSSIP, frame_payload)
             except (OSError, ConnectionError):
@@ -484,6 +597,110 @@ class Host:
 
             fut.set_exception(RequestError(data.decode(errors="replace")))
 
+    # ------------------------------------------------------------------
+    # iterative discovery (Kad-lite; reference p2p/dhtdiscovery/)
+
+    DISC_K = 8       # entries per FIND answer
+    DISC_ALPHA = 3   # parallel queries per lookup round
+
+    async def _handle_find(self, conn: _Conn, payload: bytes) -> None:
+        """FIND(nonce, target): answer the K connected peers closest to
+        target by XOR distance, with their listen addresses (the
+        FIND_NODE of Kademlia, scoped to live connections)."""
+        (nonce,) = struct.unpack_from("<Q", payload)
+        target = payload[8:40]
+        if len(target) != 32:
+            self._penalize(conn)
+            return
+        entries = []
+        for pid, c in self._conns.items():
+            if c.listen_addr is None or pid == conn.node_id:
+                continue
+            entries.append((_xor_dist(pid, target), pid, c.listen_addr))
+        entries.sort(key=lambda e: e[0])
+        blob = struct.pack("<QH", nonce, min(len(entries), self.DISC_K))
+        for _, pid, (ip, port) in entries[:self.DISC_K]:
+            ib = ip.encode()
+            blob += pid + struct.pack("<BH", len(ib), port) + ib
+        try:
+            await conn.send(MSG_FOUND, blob)
+        except (OSError, ConnectionError):
+            self._drop(conn)
+
+    def _handle_found(self, conn: _Conn, payload: bytes) -> None:
+        nonce, count = struct.unpack_from("<QH", payload)
+        off = 10
+        entries = []
+        for _ in range(min(count, self.DISC_K)):
+            pid = payload[off:off + 32]
+            iplen, port = struct.unpack_from("<BH", payload, off + 32)
+            ip = payload[off + 35:off + 35 + iplen].decode()
+            off += 35 + iplen
+            entries.append((pid, (ip, port)))
+        # keyed by (peer, nonce) like _handle_resp: sequential nonces are
+        # guessable, a peer must not be able to answer another peer's
+        # lookup (discovery poisoning)
+        fut = self._disc_pending.pop((conn.node_id, nonce), None)
+        if fut is not None and not fut.done():
+            fut.set_result(entries)
+
+    async def _find(self, peer_id: bytes, target: bytes,
+                    timeout: float = 3.0,
+                    addr: tuple | None = None) -> list[tuple[bytes, tuple]]:
+        conn = self._conns.get(peer_id)
+        if conn is None and addr is not None:
+            # Kademlia iterates by CONTACTING closer nodes: dial first
+            await self._dial(tuple(addr))
+            conn = self._conns.get(peer_id)
+        if conn is None:
+            return []
+        self._req_id += 1
+        nonce = self._req_id
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._disc_pending[(peer_id, nonce)] = fut
+        try:
+            await conn.send(MSG_FIND,
+                            struct.pack("<Q", nonce) + target)
+            return await asyncio.wait_for(fut, timeout)
+        except (OSError, ConnectionError, asyncio.TimeoutError):
+            return []
+        finally:
+            self._disc_pending.pop((peer_id, nonce), None)
+
+    async def discover(self, target: bytes,
+                       max_rounds: int = 5) -> list[tuple[bytes, tuple]]:
+        """Iterative lookup: repeatedly ask the closest known peers for
+        peers closer to ``target`` until no progress (Kademlia's
+        FIND_NODE loop over live connections).  Every address learned is
+        fed to the dial maintainer, so lookups double as discovery
+        beyond the bootstrap list."""
+        shortlist: dict[bytes, tuple] = {
+            pid: c.listen_addr for pid, c in self._conns.items()
+            if c.listen_addr is not None}
+        queried: set[bytes] = set()
+        for _ in range(max_rounds):
+            frontier = sorted(
+                (pid for pid in shortlist if pid not in queried),
+                key=lambda p: _xor_dist(p, target))[:self.DISC_ALPHA]
+            if not frontier:
+                break
+            queried.update(frontier)
+            results = await asyncio.gather(
+                *(self._find(pid, target, addr=shortlist[pid])
+                  for pid in frontier))
+            for entries in results:
+                for pid, addr in entries:
+                    if pid == self.node_id or pid in shortlist:
+                        continue
+                    shortlist[pid] = addr
+                    if len(self._known) < 1024:
+                        self._known.setdefault(tuple(addr), 0.0)
+            # termination: every unqueried candidate exhausted (the walk
+            # must tolerate "farther" hops — a chain topology routes
+            # through nodes whose ids are XOR-farther than the start)
+        return sorted(shortlist.items(),
+                      key=lambda e: _xor_dist(e[0], target))
+
     def _handle_peers(self, payload: bytes) -> None:
         (count,) = struct.unpack_from("<H", payload)
         off = 2
@@ -502,7 +719,9 @@ class Host:
     async def broadcast(self, sender, topic: str, data: bytes) -> None:
         msg_id, frame = self._gossip_frame(topic, data)
         self._mark_seen(msg_id)  # don't re-deliver our own message
-        await self._relay(frame, exclude=self.node_id)
+        self.gossip.on_message(msg_id, topic, frame)
+        await self._relay(frame,
+                          self.gossip.eager_targets(topic, set(self._conns)))
 
     # ------------------------------------------------------------------
     # req/resp net surface (Server._net)

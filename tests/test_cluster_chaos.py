@@ -1,0 +1,80 @@
+"""Cluster harness chaos run: timeskew + kill on one subprocess cluster.
+
+Drives the same ``Cluster`` class the one-command harness
+(`python -m spacemesh_tpu.tools.cluster`) uses; scenario provenance:
+reference systest/chaos/timeskew.go:12, fail.go:31 and the watcher
+pattern of systest/tests/common.go.  The partition scenario is covered
+by the harness CLI and the deterministic vclock suite
+(tests/test_partition.py); running all three here would double the
+suite's wall clock for no new code path.
+"""
+
+import time
+
+import pytest
+
+from spacemesh_tpu.tools.cluster import Cluster
+
+N = 5
+SMESHERS = 2
+LPE = 3
+LAYER_SEC = 1.0
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    c = Cluster(tmp_path_factory.mktemp("chaos"), N, smeshers=SMESHERS,
+                layer_sec=LAYER_SEC, lpe=LPE, spinup=90.0,
+                until_layer=7 * LPE)  # nodes must outlive every assertion
+    c.start()
+    try:
+        c.wait_api(timeout=210)
+        yield c
+    finally:
+        c.stop()
+
+
+def test_timeskew_then_kill_then_converge(cluster):
+    c = cluster
+    c.wait_layer(LPE, timeout=c.spinup + LPE * LAYER_SEC + 120)
+
+    # chaos 1: skew the last node's clock forward three layers
+    skewed = c.nodes[-1]
+    c.timeskew(skewed, 3 * LAYER_SEC)
+    st = skewed.api("/v1/node/status")["status"]
+    assert st["top_layer"] >= LPE + 2, "skewed clock must show ahead"
+    c.wait_layer(2 * LPE, timeout=120)
+    c.timeskew(skewed, 0.0)
+
+    # chaos 2: SIGKILL a different observer mid-run
+    victim = c.nodes[-2]
+    c.kill(victim)
+    assert not victim.alive()
+
+    # the survivors (incl. the formerly-skewed node) must keep applying
+    # layers and agree on state
+    survivors = [n for n in c.nodes if n is not victim]
+    target = 3 * LPE + 1
+    c.wait_layer(target + 1, timeout=180, nodes=survivors)
+    deadline = time.time() + 180
+    ok = False
+    while time.time() < deadline and not ok:
+        try:
+            ok = c.converged(target, nodes=survivors)
+        except OSError:  # a node mid-restart/poll race: retry
+            ok = False
+        time.sleep(LAYER_SEC / 2)
+    assert ok, c.state_hashes(target, nodes=survivors)
+
+
+def test_survivors_exit_clean(cluster):
+    c = cluster
+    victim = c.nodes[-2]
+    deadline = time.time() + c.spinup + 8 * LPE * LAYER_SEC + 240
+    for node in c.nodes:
+        if node is victim:
+            continue
+        while node.alive() and time.time() < deadline:
+            time.sleep(1.0)
+        assert node.proc.poll() == 0, \
+            f"{node.name} rc={node.proc.poll()} (log: {node.log_path})"

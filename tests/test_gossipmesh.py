@@ -1,0 +1,253 @@
+"""Gossipsub-lite: mesh bounds, lazy repair, sub-flood duplication.
+
+Reference p2p/pubsub/pubsub.go:211-311 (gossipsub mesh parameters) —
+the message-complexity test is VERDICT r2 item 7's acceptance: a 16-node
+net shows materially fewer duplicate deliveries than flood would cost.
+Runs on the real clock: mesh formation IS heartbeat-driven.
+"""
+
+import asyncio
+
+import pytest
+
+from spacemesh_tpu.core.signing import EdSigner
+from spacemesh_tpu.p2p.gossipmesh import (
+    GRAFT,
+    IHAVE,
+    IWANT,
+    PRUNE,
+    GossipMesh,
+    MessageCache,
+    decode_ctrl,
+    encode_ctrl,
+)
+from spacemesh_tpu.p2p.pubsub import PubSub
+from spacemesh_tpu.p2p.transport import Host
+
+GEN = b"gossipmesh-genesis!!"
+
+
+# --- unit: control codec + mesh bookkeeping -----------------------------
+
+
+def test_ctrl_roundtrip():
+    ids = [bytes([i]) * 32 for i in range(3)]
+    for subtype in (GRAFT, PRUNE, IHAVE, IWANT):
+        st, topic, got = decode_ctrl(encode_ctrl(subtype, "ax1", ids))
+        assert (st, topic, got) == (subtype, "ax1", ids)
+
+
+def test_ragged_ctrl_rejected():
+    with pytest.raises(ValueError):
+        decode_ctrl(encode_ctrl(IHAVE, "t", [b"x" * 32]) + b"ragged")
+
+
+def test_heartbeat_keeps_mesh_within_bounds():
+    m = GossipMesh(degree=3, d_lo=2, d_hi=4)
+    peers = {bytes([i]) * 32 for i in range(10)}
+    m.on_message(b"m" * 32, "t", b"frame")
+    sends = m.heartbeat(peers)
+    grafts = [p for p, st, _, _ in sends if st == GRAFT]
+    assert 2 <= len(m.mesh["t"]) <= 4
+    assert set(grafts) == m.mesh["t"]
+    # over-subscribe, then heartbeat prunes back to degree
+    m.mesh["t"] = set(list(peers)[:9])
+    sends = m.heartbeat(peers)
+    prunes = [p for p, st, _, _ in sends if st == PRUNE]
+    assert len(m.mesh["t"]) == 3
+    assert len(prunes) == 6
+
+
+def test_graft_over_capacity_answers_prune():
+    m = GossipMesh(degree=2, d_lo=1, d_hi=2)
+    m.mesh["t"] = {b"a" * 32, b"b" * 32}
+    replies = m.on_control(b"c" * 32, encode_ctrl(GRAFT, "t"),
+                           seen=lambda _: True)
+    assert replies == [(PRUNE, "t", [])]
+    assert b"c" * 32 not in m.mesh["t"]
+
+
+def test_iwant_spam_guard():
+    m = GossipMesh()
+    mid = b"i" * 32
+    m.on_message(mid, "t", b"frame")
+    peer = b"p" * 32
+    for _ in range(3):
+        assert m.on_control(peer, encode_ctrl(IWANT, "t", [mid]),
+                            seen=lambda _: True) == [(-1, "t", [mid])]
+    # 4th ask for the same id is refused (GossipRetransmission guard)
+    assert m.on_control(peer, encode_ctrl(IWANT, "t", [mid]),
+                        seen=lambda _: True) == []
+
+
+def test_mcache_window_expires():
+    c = MessageCache(history=2)
+    c.put(b"a" * 32, "t", b"fa")
+    c.shift()
+    assert c.recent_ids("t") == [b"a" * 32]
+    c.shift()  # beyond history
+    assert c.recent_ids("t") == []
+    assert c.get(b"a" * 32) is None
+
+
+# --- integration: real hosts ---------------------------------------------
+
+
+async def _mk_host(genesis, bootstrap=(), heartbeat=0.1, degree=6,
+                   min_peers=1):
+    h = Host(signer=EdSigner(prefix=GEN), genesis_id=genesis,
+             listen="127.0.0.1:0", bootstrap=list(bootstrap),
+             min_peers=min_peers, gossip_heartbeat=heartbeat,
+             gossip_degree=degree)
+    await h.start()
+    return h
+
+
+def _counting_pubsub(name: bytes, got: dict):
+    # deliver_self=True (the production default): publishers handle their
+    # own messages locally, so "every node got every message" includes
+    # each publisher's own
+    ps = PubSub(node_name=name, deliver_self=True)
+
+    async def handler(peer, data):
+        got.setdefault(data, 0)
+        got[data] += 1
+        return True
+
+    ps.register("t1", handler)
+    return ps
+
+
+def test_lazy_ihave_iwant_repairs_non_mesh_peer():
+    """C is connected to A but outside A's mesh; B relays nowhere.  C
+    still converges via IHAVE -> IWANT (the gossipsub repair path)."""
+
+    async def go():
+        a = await _mk_host(GEN[:20])
+        addr_a = f"127.0.0.1:{a.address[1]}"
+        b = await _mk_host(GEN[:20], [addr_a])
+        c = await _mk_host(GEN[:20], [addr_a])
+        got_a, got_b, got_c = {}, {}, {}
+        a.join_pubsub(_counting_pubsub(a.node_id, got_a))
+        b.join_pubsub(_counting_pubsub(b.node_id, got_b))
+        c.join_pubsub(_counting_pubsub(c.node_id, got_c))
+        try:
+            for _ in range(100):
+                if len(a.nodes) == 2:
+                    break
+                await asyncio.sleep(0.05)
+            assert len(a.nodes) == 2, "B and C must both connect to A"
+            # pin A's topic mesh to {B} and freeze its size so the
+            # heartbeat cannot graft C (degree bounds all 1)
+            a.gossip.mesh["t1"] = {b.node_id}
+            a.gossip.degree = a.gossip.d_lo = a.gossip.d_hi = 1
+            payload = b"lazy-repair-payload"
+            await a._pubsub.publish("t1", payload)
+            for _ in range(100):
+                if payload in got_c:
+                    break
+                await asyncio.sleep(0.05)
+            assert got_b.get(payload) == 1, "mesh peer gets it eagerly"
+            assert got_c.get(payload) == 1, \
+                "non-mesh peer must converge via IHAVE/IWANT"
+            assert a.stats["iwant_served"] >= 1
+        finally:
+            for h in (a, b, c):
+                await h.stop()
+
+    asyncio.run(go())
+
+
+def test_iterative_discovery_walks_the_chain():
+    """A-B-C-D chain (each node bootstraps only to its predecessor):
+    A.discover() contacts successively closer peers and ends up
+    CONNECTED to D, which no bootstrap list ever mentioned (reference
+    p2p/dhtdiscovery iterative peer routing)."""
+
+    async def go():
+        a = await _mk_host(GEN[:20])
+        chain = [a]
+        for _ in range(3):
+            prev = chain[-1]
+            h = await _mk_host(GEN[:20],
+                               [f"127.0.0.1:{prev.address[1]}"])
+            chain.append(h)
+        b, c, d = chain[1:]
+        try:
+            for _ in range(100):
+                if all(len(h.nodes) >= 1 for h in chain):
+                    break
+                await asyncio.sleep(0.05)
+            assert d.node_id not in a.nodes, "test needs A !~ D initially"
+            found = await a.discover(d.node_id)
+            ids = [pid for pid, _ in found]
+            assert d.node_id in ids, "iterative lookup must surface D"
+            assert found[0][0] == d.node_id, "D is closest to its own id"
+            # the lookup dialed through the chain: A is now connected to D
+            assert d.node_id in a.nodes
+        finally:
+            for h in chain:
+                await h.stop()
+
+    asyncio.run(go())
+
+
+def test_sixteen_node_mesh_beats_flood_duplication():
+    """16 fully-meshed nodes, degree-4 gossip: total deliveries per
+    message stay well under flood's edge count (VERDICT item 7)."""
+
+    async def go():
+        n = 16
+        hosts = [await _mk_host(GEN[:20], heartbeat=0.15, degree=4,
+                                min_peers=n - 1)]
+        addr0 = f"127.0.0.1:{hosts[0].address[1]}"
+        for _ in range(n - 1):
+            hosts.append(await _mk_host(GEN[:20], [addr0], heartbeat=0.15,
+                                        degree=4, min_peers=n - 1))
+        gots = []
+        for h in hosts:
+            got = {}
+            gots.append(got)
+            h.join_pubsub(_counting_pubsub(h.node_id, got))
+        try:
+            # peer exchange spreads addresses; wait for a well-connected
+            # overlay (>= 8 peers each is plenty connected for the test)
+            for _ in range(300):
+                if all(len(h.nodes) >= 8 for h in hosts):
+                    break
+                await asyncio.sleep(0.05)
+            assert all(len(h.nodes) >= 8 for h in hosts), \
+                [len(h.nodes) for h in hosts]
+            # warmup traffic so every node learns the topic and the
+            # heartbeats build the meshes BEFORE the measured burst (the
+            # first messages on a topic flood by design)
+            for i in range(4):
+                await hosts[i]._pubsub.publish("t1", b"warmup-%d" % i)
+            await asyncio.sleep(1.0)
+            assert all(h.gossip.mesh.get("t1") for h in hosts)
+            for h in hosts:
+                h.stats.update(gossip_tx=0, gossip_rx=0, gossip_dup=0)
+            msgs = [b"msg-%03d" % i for i in range(20)]
+            for i, m in enumerate(msgs):
+                await hosts[i % n]._pubsub.publish("t1", m)
+            deadline = 400  # generous: repair may lag on a loaded machine
+            for _ in range(deadline):
+                if all(all(m in g for m in msgs) for g in gots):
+                    break
+                await asyncio.sleep(0.05)
+            assert all(all(m in g for m in msgs) for g in gots), \
+                "every node must converge on every message"
+            # duplication: copies RECEIVED network-wide per message.
+            # flood over this ~fully-connected overlay costs ~one copy
+            # per edge per message: sum(deg)/2 ≈ n*(n-1)/2 copies. The
+            # degree-bounded mesh keeps it near n*(degree+2)/2.
+            total_rx = sum(h.stats["gossip_rx"] for h in hosts)
+            per_msg = total_rx / len(msgs)
+            edges = sum(len(h.nodes) for h in hosts) / 2
+            assert per_msg < 0.62 * edges, \
+                f"per-msg copies {per_msg:.1f} vs flood bound {edges:.1f}"
+        finally:
+            for h in hosts:
+                await h.stop()
+
+    asyncio.run(go())

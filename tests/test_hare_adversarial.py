@@ -170,8 +170,12 @@ def test_notify_without_certificate_rejected():
     asyncio.run(go())
 
 
-def test_late_commit_ignored():
-    """A COMMIT arriving after its acceptance window must not count."""
+def test_late_commit_gets_no_grade():
+    """A COMMIT that surfaces rounds after its own slot is graded below
+    the thresholds the protocol reads (grade5 for notify emission,
+    grade4/3 for locks) — the graded replacement for the old acceptance
+    window (reference hare3 thresh-gossip: received.Grade(target) gates
+    every tally)."""
     signers = [EdSigner(prefix=GEN) for _ in range(2)]
     cache, atx_ids = _cache_with(signers)
     hub = LoopbackHub()
@@ -179,17 +183,28 @@ def test_late_commit_ignored():
     hare, ps = _mk_hare(hub, cache, atx_ids, signers[0], outs)
     oracle = Oracle(cache, LPE)
 
+    from spacemesh_tpu.consensus import hare3
     from spacemesh_tpu.consensus.hare import HareSession
 
-    session = HareSession(hare, LAYER, [])
-    session.layer_start = hare.wall() - 100.0  # session began long ago
     msg = _sign_msg(signers[1], oracle, atx_ids[signers[1].node_id],
                     round_=COMMIT, values=[sum256(b"v")])
-    assert session.too_late(msg)
+    target = hare3.IterRound(0, hare3.COMMIT)
+
+    # session whose protocol clock is 5 rounds past the commit round:
+    # the message lands with grade < 3 — invisible to every lock read
+    # (softlock needs grade3, hardlock grade4, notify emission grade5)
+    session = HareSession(hare, LAYER, [])
+    for _ in range(11):  # preround..(1,wait1): 5 past commit
+        session.protocol.next()
     session.on_message(msg)
-    assert session.commit_weight(tuple(sorted(msg.values))) == 0
-    # same message in a fresh window counts
+    gi = session.protocol.gossip.state[(target, signers[1].node_id)]
+    assert gi.received.grade(target) < hare3.GRADE3
+    # commit_weight (certificate bookkeeping) still records it — certs
+    # have their own threshold check
+    assert session.commit_weight(tuple(sorted(msg.values))) > 0
+
+    # fresh session: same message in its own round carries full grade
     session2 = HareSession(hare, LAYER, [])
-    session2.layer_start = hare.wall()
     session2.on_message(msg)
-    assert session2.commit_weight(tuple(sorted(msg.values))) > 0
+    gi2 = session2.protocol.gossip.state[(target, signers[1].node_id)]
+    assert gi2.received.grade(target) >= hare3.GRADE5
