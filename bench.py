@@ -81,24 +81,28 @@ def main() -> None:
     log(f"device: {dev} platform={dev.platform}")
 
     cw = jnp.asarray(scrypt.commitment_to_words(commitment))
-    best_rate, best_batch = 0.0, 0
-    for batch in batches:
-        try:
-            idx = np.arange(batch, dtype=np.uint64)
-            lo_, hi_ = scrypt.split_indices(idx)
-            lo, hi = jnp.asarray(lo_), jnp.asarray(hi_)
+
+    def measure(batch: int) -> float:
+        idx = np.arange(batch, dtype=np.uint64)
+        lo_, hi_ = scrypt.split_indices(idx)
+        lo, hi = jnp.asarray(lo_), jnp.asarray(hi_)
+        t0 = time.perf_counter()
+        out = scrypt.scrypt_labels_jit(cw, lo, hi, n=n)
+        out.block_until_ready()
+        log(f"batch={batch}: compile+first run "
+            f"{time.perf_counter() - t0:.1f}s")
+        rate = 0.0
+        for _ in range(reps):
             t0 = time.perf_counter()
             out = scrypt.scrypt_labels_jit(cw, lo, hi, n=n)
             out.block_until_ready()
-            log(f"batch={batch}: compile+first run "
-                f"{time.perf_counter() - t0:.1f}s")
-            rate = 0.0
-            for _ in range(reps):
-                t0 = time.perf_counter()
-                out = scrypt.scrypt_labels_jit(cw, lo, hi, n=n)
-                out.block_until_ready()
-                dt = time.perf_counter() - t0
-                rate = max(rate, batch / dt)
+            rate = max(rate, batch / (time.perf_counter() - t0))
+        return rate
+
+    best_rate, best_batch = 0.0, 0
+    for batch in batches:
+        try:
+            rate = measure(batch)
             log(f"batch={batch}: {rate:,.0f} labels/s")
             if rate > best_rate:
                 best_rate, best_batch = rate, batch
@@ -106,6 +110,24 @@ def main() -> None:
             log(f"batch={batch}: failed ({type(e).__name__}: {e})")
     if best_rate == 0.0:
         raise SystemExit("all batch sizes failed")
+
+    impl = "xla"
+    if not fallback:
+        # race the contiguous-row Pallas ROMix candidate at the winning
+        # batch (docs/ROUND2_NOTES.md analysis; only meaningful on real
+        # TPU — the CPU interpreter executes each DMA in Python)
+        try:
+            os.environ["SPACEMESH_ROMIX"] = "pallas"
+            pallas_rate = measure(best_batch)
+            log(f"pallas romix @ batch={best_batch}: "
+                f"{pallas_rate:,.0f} labels/s")
+            if pallas_rate > best_rate:
+                best_rate, impl = pallas_rate, "pallas"
+        except Exception as e:  # noqa: BLE001 — candidate may not compile
+            log(f"pallas romix failed ({type(e).__name__}: {e})")
+        finally:
+            os.environ.pop("SPACEMESH_ROMIX", None)
+    log(f"winner: {impl} romix")
 
     log(f"CPU baseline: {cpu_count} labels via hashlib.scrypt ...")
     cpu_rate = cpu_labels_per_sec(commitment, n, cpu_count)

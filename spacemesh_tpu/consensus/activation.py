@@ -205,7 +205,17 @@ class Builder:
         challenge = nipost_challenge(prev_id, publish_epoch)
         round_id = str(publish_epoch)
         self._pending = (publish_epoch, prev, prev_id, challenge, round_id)
-        await self.poet.register(round_id, challenge)
+        # cert-gated poets (reference certifier deposits,
+        # activation/certifier.go:246): poet_cert is obtained once from
+        # the certifier (App.start_smeshing, poet_certifier config); the
+        # registration is bound to this identity by a POET-domain
+        # signature over (round_id, challenge)
+        cert = getattr(self, "poet_cert", None)
+        await self.poet.register(
+            round_id, challenge, node_id=node_id,
+            signature=self.signer.sign(Domain.POET,
+                                       round_id.encode() + challenge),
+            cert=cert)
 
     async def build_and_publish(self, publish_epoch: int,
                                 execute_round: bool = False) -> ActivationTx:

@@ -591,33 +591,38 @@ class Hare:
         emitted: Optional[ConsensusOutput] = None
         coin: Optional[bool] = None
         tick = 0
-        while True:
-            out = protocol.next()
-            if out.coin is not None:
-                coin = out.coin
-            if out.result is not None and result is None:
-                result = out.result
-                session.output = list(result)
-                # deliver the moment agreement lands (block generation
-                # must not wait out the helper iteration)
-                emitted = ConsensusOutput(layer=layer, proposals=result,
-                                          completed=True, coin=coin)
-                await self.on_output(emitted)
-            if out.message is not None:
-                await send(out.message)
-            if out.terminated:
-                break  # result emitted + one helper iteration completed
-            if protocol.current.iter >= self.iteration_limit \
-                    and protocol.current.round > hare3.HARDLOCK:
-                # the hardlock of iteration `limit` was the last chance to
-                # surface a result from the final notify round
-                break
-            tick += 1
-            await until_tick(tick)
+        try:
+            while True:
+                out = protocol.next()
+                if out.coin is not None:
+                    coin = out.coin
+                if out.result is not None and result is None:
+                    result = out.result
+                    session.output = list(result)
+                    # deliver the moment agreement lands (block generation
+                    # must not wait out the helper iteration)
+                    emitted = ConsensusOutput(layer=layer, proposals=result,
+                                              completed=True, coin=coin)
+                    await self.on_output(emitted)
+                if out.message is not None:
+                    await send(out.message)
+                if out.terminated:
+                    break  # result emitted + one helper iteration completed
+                if protocol.current.iter >= self.iteration_limit \
+                        and protocol.current.round > hare3.HARDLOCK:
+                    # the hardlock of iteration `limit` was the last chance to
+                    # surface a result from the final notify round
+                    break
+                tick += 1
+                await until_tick(tick)
 
-        if emitted is None:
-            emitted = ConsensusOutput(layer=layer, proposals=[],
-                                      completed=False, coin=coin)
-            await self.on_output(emitted)
-        del self.sessions[layer]
+            if emitted is None:
+                emitted = ConsensusOutput(layer=layer, proposals=[],
+                                          completed=False, coin=coin)
+                await self.on_output(emitted)
+        finally:
+            # exception or cancellation must not leak the session: a dead
+            # session left in self.sessions would keep absorbing gossip
+            # for this layer forever (code-review r3)
+            self.sessions.pop(layer, None)
         return emitted

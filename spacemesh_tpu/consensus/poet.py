@@ -115,14 +115,45 @@ class PoetService:
     """In-proc poet: register(challenge) during the open round, run() at
     round end, results keyed by round id."""
 
-    def __init__(self, poet_id: bytes, ticks: int = 64):
+    def __init__(self, poet_id: bytes, ticks: int = 64,
+                 certifier_pubkey: bytes | None = None,
+                 verifier=None):
         self.poet_id = poet_id
         self.ticks = ticks
+        # when set, registration requires a certificate signed by this
+        # certifier (reference poet deployments gate /submit the same
+        # way; consensus/certifier.py issues them against a POST proof)
+        self.certifier_pubkey = certifier_pubkey
+        self.verifier = verifier
         self._open: dict[str, list[bytes]] = {}
         self._results: dict[str, RoundResult] = {}
         self._lock = asyncio.Lock()
 
-    async def register(self, round_id: str, challenge: bytes) -> None:
+    async def register(self, round_id: str, challenge: bytes,
+                       node_id: bytes | None = None,
+                       signature: bytes | None = None,
+                       cert=None) -> None:
+        """Cert-gated mode requires the registration to be BOUND to the
+        certified identity: a cert for node_id plus node_id's signature
+        over (round_id, challenge) — a stolen/replayed cert without the
+        identity's key registers nothing, and rate limits apply per
+        certified identity (the reference poet's /submit carries the
+        submitter's pubkey + signature the same way)."""
+        if self.certifier_pubkey is not None:
+            from ..core.signing import Domain
+            from .certifier import verify_cert
+
+            if cert is None or node_id is None or signature is None:
+                raise PermissionError(
+                    "registration requires a certificate + identity proof")
+            if cert.node_id != node_id:
+                raise PermissionError("certificate is for another identity")
+            if not verify_cert(cert, self.certifier_pubkey, self.verifier):
+                raise PermissionError("invalid poet certificate")
+            if not self.verifier.verify(
+                    Domain.POET, node_id,
+                    round_id.encode() + challenge, signature):
+                raise PermissionError("registration signature invalid")
         async with self._lock:
             if round_id in self._results:
                 raise ValueError(f"round {round_id} already closed")

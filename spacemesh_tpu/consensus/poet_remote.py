@@ -125,8 +125,18 @@ class PoetServerDaemon:
                         "poet_id": self.service.poet_id.hex(),
                         "ticks": self.service.ticks}
             if method == "register":
+                cert = None
+                if req.get("cert") is not None:
+                    from .certifier import PoetCert
+
+                    cert = PoetCert.from_dict(req["cert"])
                 await self.service.register(
-                    req["round_id"], bytes.fromhex(req["challenge"]))
+                    req["round_id"], bytes.fromhex(req["challenge"]),
+                    node_id=(bytes.fromhex(req["node_id"])
+                             if req.get("node_id") else None),
+                    signature=(bytes.fromhex(req["signature"])
+                               if req.get("signature") else None),
+                    cert=cert)
                 return {"ok": True}
             if method == "execute_round":
                 result = await self.service.execute_round(req["round_id"])
@@ -183,10 +193,19 @@ class RemotePoetClient:
                                 "ticks": d["ticks"]}
         return self._info_cache
 
-    async def register(self, round_id: str, challenge: bytes) -> None:
-        await asyncio.to_thread(
-            self._call, {"method": "register", "round_id": round_id,
-                         "challenge": challenge.hex()})
+    async def register(self, round_id: str, challenge: bytes,
+                       node_id: bytes | None = None,
+                       signature: bytes | None = None,
+                       cert=None) -> None:
+        req = {"method": "register", "round_id": round_id,
+               "challenge": challenge.hex()}
+        if cert is not None:
+            req["cert"] = cert.to_dict()
+        if node_id is not None:
+            req["node_id"] = node_id.hex()
+        if signature is not None:
+            req["signature"] = signature.hex()
+        await asyncio.to_thread(self._call, req)
 
     async def execute_round(self, round_id: str) -> RoundResult:
         d = await asyncio.to_thread(
@@ -214,9 +233,14 @@ class MultiPoet:
         self.poets = poets
         self.poet_id = poets[0].poet_id  # nominal; results carry their own
 
-    async def register(self, round_id: str, challenge: bytes) -> None:
+    async def register(self, round_id: str, challenge: bytes,
+                       node_id: bytes | None = None,
+                       signature: bytes | None = None,
+                       cert=None) -> None:
         results = await asyncio.gather(
-            *(p.register(round_id, challenge) for p in self.poets),
+            *(p.register(round_id, challenge, node_id=node_id,
+                         signature=signature, cert=cert)
+              for p in self.poets),
             return_exceptions=True)
         if all(isinstance(r, Exception) for r in results):
             raise RuntimeError(f"all poets failed: {results[0]}")

@@ -231,9 +231,19 @@ class Tortoise:
         self.cache.set_malicious(node_id)
         for row in self._node_rows.get(node_id, ()):
             self._weights[row] = 0
+        had_ballots = False
         for info in self._ballots.values():
             if info.node_id == node_id:
                 info.malicious = True
+                had_ballots = True
+        if had_ballots:
+            # the zeroed weight may have been load-bearing anywhere below
+            # the frontier (against-votes are implicit, so per-target
+            # marking would under-mark): full re-tally of the retained
+            # window on the next pass. Malfeasance is rare; the tally is
+            # one vectorized mat-vec per layer (reference re-validates
+            # on malfeasance too)
+            self._mark_dirty(max(self.verified - self.window, 0))
 
     def on_ballot(self, ballot: Ballot, weight: int,
                   bad_beacon: bool = False) -> None:

@@ -46,6 +46,7 @@ class Domain(enum.IntEnum):
     TX = 8               # this framework's tx envelope (vm/vm.py)
     CERTIFY = 9
     TRANSPORT = 10       # p2p channel-binding signature (p2p/noise.py)
+    POET_CERT = 11       # poet certifier certificates (consensus/certifier.py)
 
 
 # --- ed25519 identity signatures -----------------------------------------

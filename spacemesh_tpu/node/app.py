@@ -545,6 +545,44 @@ class App:
         self.server.register("ml/1", serve_malicious_ids)
         self.server.register("lh/1", serve_layer_hash)
 
+        # sync2 rangesync: fingerprint-bisection set reconciliation over
+        # per-epoch ATX ids and malfeasance ids (p2p/rangesync.py;
+        # reference sync2/rangesync — there a standalone subsystem, here
+        # one stateless responder on the same req/resp server)
+        from ..p2p import rangesync as rangesync_mod
+
+        # short-TTL cache: one reconciliation issues O(diff*log n)
+        # request frames — rebuilding the set (DB scan + Fenwick) per
+        # frame would make server work O(n) per frame (code-review r3);
+        # a few seconds of staleness only means a second pass picks up
+        # the newest ids
+        rs_cache: dict[str, tuple[float, object]] = {}
+
+        def set_for(name: str):
+            now = time.monotonic()
+            hit = rs_cache.get(name)
+            if hit is not None and hit[0] > now:
+                return hit[1]
+            if name.startswith("atx/"):
+                try:
+                    epoch = int(name[4:])
+                except ValueError:
+                    return None
+                oset = rangesync_mod.OrderedSet(
+                    atxstore.ids_in_epoch(self.state, epoch))
+            elif name == "malfeasance":
+                oset = rangesync_mod.OrderedSet(
+                    miscstore.all_malicious(self.state))
+            else:
+                return None
+            if len(rs_cache) > 64:
+                rs_cache.clear()
+            rs_cache[name] = (now + 5.0, oset)
+            return oset
+
+        self.server.register(rangesync_mod.P_RANGESYNC,
+                             rangesync_mod.RangeSyncResponder(set_for).handle)
+
         async def adopt_certificate(layer: int, block_id: bytes) -> bool:
             """Fetch + VERIFY the full certificate before trusting a
             peer-reported hare output (a majority of layer-data answers
@@ -859,6 +897,31 @@ class App:
                 golden_atx=self.golden_atx, coinbase=coinbase,
                 handler=self.atx_handler,
                 num_units=cfg.smeshing.num_units))
+        if cfg.poet_certifier:
+            await self._certify_identities(cfg.poet_certifier)
+
+    async def _certify_identities(self, addr_spec: str) -> None:
+        """Obtain one poet certificate per identity from the configured
+        certifier (reference activation/certifier.go:246 Certify): prove
+        the POST once over a canonical per-identity challenge, submit,
+        store the cert on the builder for every poet registration."""
+        from ..consensus.certifier import CertifierClient
+
+        host, _, port = addr_spec.rpartition(":")
+        certifier = CertifierClient((host or "127.0.0.1", int(port)))
+        for b in self.atx_builders:
+            node_id = b.signer.node_id
+            challenge = sum256(b"poet-cert-challenge", node_id)
+            proof, _meta = await asyncio.to_thread(b.post_client.proof,
+                                                   challenge)
+            info = await asyncio.to_thread(b.post_client.info)
+            b.poet_cert = await asyncio.to_thread(
+                certifier.certificate, proof=proof, challenge=challenge,
+                node_id=node_id, commitment=info.commitment,
+                num_units=info.num_units,
+                labels_per_unit=info.labels_per_unit)
+            self.events.emit(events_mod.PostEvent(
+                node_id=node_id, kind="certified"))
 
     @property
     def atx_builder(self):

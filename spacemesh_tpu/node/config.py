@@ -128,6 +128,9 @@ class Config:
     p2p: P2PConfig = dataclasses.field(default_factory=P2PConfig)
     api: APIConfig = dataclasses.field(default_factory=APIConfig)
     poet_servers: list[str] = dataclasses.field(default_factory=list)
+    poet_certifier: str = ""     # host:port of a certifier daemon; when
+                                 # set, identities obtain a poet cert at
+                                 # smeshing start (consensus/certifier.py)
     poet_cycle_gap: float = 43200.0        # 12 h
     standalone: bool = False
     bootstrap_source: str = ""             # file path or URL of epoch

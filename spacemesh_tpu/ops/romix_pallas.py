@@ -1,0 +1,166 @@
+"""Pallas ROMix variant: contiguous-row (N, T, 32) V + async-copy gathers.
+
+The race candidate recorded in docs/ROUND2_NOTES.md ("Pallas ROMix:
+analysis"): the XLA path (ops/scrypt.py romix_r1) stores V as (N, 32, B)
+and gathers a (32, B) slab per iteration with a per-lane random row —
+one fused XLA gather.  This kernel flips the layout to (N, T, 32) so ONE
+LANE'S ROW IS 128 CONTIGUOUS BYTES, then:
+
+* phase 1 (fill): V rows stream VMEM->HBM with double-buffered async
+  copies — the write of row i overlaps the BlockMix that produces row
+  i+1;
+* phase 2 (mix): per-lane gathers are explicit 128-byte DMAs, all T
+  in flight together before the single wait-loop (the iteration's
+  BlockMix depends on the gathered rows, so cross-iteration overlap is
+  impossible — the overlap is across LANES within an iteration).
+
+Which candidate wins is an empirical question the round-2 analysis could
+not settle without hardware (per-lane DMA latency vs. XLA's gather); the
+flag `SPACEMESH_ROMIX=pallas` (or romix_impl="pallas") races them on the
+same test vectors.  Interpret mode verifies bit-exactness on CPU.
+
+Reference workload: activation/post.go:27-61 (labels per unit),
+config/mainnet.go:184-190 (N=8192, r=1, p=1).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+try:  # pltpu resolves on TPU builds; interpret mode works without it
+    from jax.experimental.pallas import tpu as pltpu
+except Exception:  # pragma: no cover - non-TPU jaxlib
+    pltpu = None
+
+LANE_TILE = 128
+
+
+def _rotl(x, n: int):
+    return (x << jnp.uint32(n)) | (x >> jnp.uint32(32 - n))
+
+
+def _quarter(x, a: int, b: int, c: int, d: int):
+    x[b] = x[b] ^ _rotl(x[a] + x[d], 7)
+    x[c] = x[c] ^ _rotl(x[b] + x[a], 9)
+    x[d] = x[d] ^ _rotl(x[c] + x[b], 13)
+    x[a] = x[a] ^ _rotl(x[d] + x[c], 18)
+
+
+def _salsa20_8_rows(block):
+    """Salsa20/8 over (T, 16) u32 (lanes MAJOR — rows are labels)."""
+    x = [block[:, i] for i in range(16)]
+    for _ in range(4):
+        _quarter(x, 0, 4, 8, 12)
+        _quarter(x, 5, 9, 13, 1)
+        _quarter(x, 10, 14, 2, 6)
+        _quarter(x, 15, 3, 7, 11)
+        _quarter(x, 0, 1, 2, 3)
+        _quarter(x, 5, 6, 7, 4)
+        _quarter(x, 10, 11, 8, 9)
+        _quarter(x, 15, 12, 13, 14)
+    return jnp.stack([x[i] for i in range(16)], axis=1) + block
+
+
+def _blockmix_rows(x):
+    """scrypt BlockMix r=1 over (T, 32) u32, lanes major."""
+    y0 = _salsa20_8_rows(x[:, 0:16] ^ x[:, 16:32])
+    y1 = _salsa20_8_rows(x[:, 16:32] ^ y0)
+    return jnp.concatenate([y0, y1], axis=1)
+
+
+def _romix_kernel(x_ref, o_ref, v_ref, fill_buf, gather_buf, jsm,
+                  fill_sem, jsem, gsem, *, n: int, tile: int):
+    # ---- phase 1: fill V[i] = x_i, double-buffered writes ----
+    def fill(i, x):
+        slot = i % 2
+
+        @pl.when(i >= 2)
+        def _():
+            # retire the copy that used this slot two iterations ago
+            # (same shape/size, so the reconstructed handle's wait
+            # matches the outstanding transfer)
+            pltpu.make_async_copy(fill_buf.at[slot], v_ref.at[0],
+                                  fill_sem.at[slot]).wait()
+
+        fill_buf[slot] = x
+        pltpu.make_async_copy(fill_buf.at[slot], v_ref.at[i],
+                              fill_sem.at[slot]).start()
+        return _blockmix_rows(x)
+
+    x = lax.fori_loop(0, n, fill, x_ref[...])
+    # drain the last two in-flight writes
+    for slot in (0, 1):
+        pltpu.make_async_copy(fill_buf.at[slot], v_ref.at[0],
+                              fill_sem.at[slot]).wait()
+
+    # ---- phase 2: x = BlockMix(x ^ V[Integerify(x)]), per-lane DMAs ----
+    def mix(_, x):
+        # Integerify indices must become SMEM scalars: stage the word-16
+        # column through a DMA (vector stores to SMEM don't lower)
+        fill_buf[0] = x  # reuse slot 0 as the staging source
+        stage = pltpu.make_async_copy(
+            fill_buf.at[0, :, 16:17], jsm, jsem)
+        stage.start()
+        stage.wait()
+
+        def start_lane(lane, _):
+            row = (jsm[lane, 0] % jnp.uint32(n)).astype(jnp.int32)
+            pltpu.make_async_copy(v_ref.at[row, lane],
+                                  gather_buf.at[lane], gsem).start()
+            return 0
+
+        lax.fori_loop(0, tile, start_lane, 0)
+
+        def wait_lane(lane, _):
+            pltpu.make_async_copy(v_ref.at[0, 0], gather_buf.at[0],
+                                  gsem).wait()
+            return 0
+
+        lax.fori_loop(0, tile, wait_lane, 0)
+        return _blockmix_rows(x ^ gather_buf[...])
+
+    o_ref[...] = lax.fori_loop(0, n, mix, x)
+
+
+def romix_pallas(x, *, n: int, lane_tile: int = LANE_TILE,
+                 interpret: bool = False):
+    """Drop-in for ops.scrypt.romix_r1: x is (32, B) u32; returns same.
+
+    B must be a multiple of ``lane_tile``.
+    """
+    if pltpu is None and not interpret:
+        raise RuntimeError("pltpu unavailable: TPU build required "
+                           "(use interpret=True on CPU)")
+    b = x.shape[1]
+    if b % lane_tile:
+        raise ValueError(f"batch {b} not a multiple of tile {lane_tile}")
+    xt = x.T  # (B, 32) lanes major: one lane's row is contiguous
+
+    scratch = [
+        pl.ANY((n, lane_tile, 32), jnp.uint32),       # V (HBM)
+        pltpu.VMEM((2, lane_tile, 32), jnp.uint32),   # fill double-buffer
+        pltpu.VMEM((lane_tile, 32), jnp.uint32),      # gathered rows
+        pltpu.SMEM((lane_tile, 1), jnp.uint32),       # per-lane j
+        pltpu.SemaphoreType.DMA((2,)),
+        pltpu.SemaphoreType.DMA(()),
+        pltpu.SemaphoreType.DMA(()),
+    ]
+    out = pl.pallas_call(
+        functools.partial(_romix_kernel, n=n, tile=lane_tile),
+        grid=(b // lane_tile,),
+        in_specs=[pl.BlockSpec((lane_tile, 32), lambda g: (g, 0))],
+        out_specs=pl.BlockSpec((lane_tile, 32), lambda g: (g, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, 32), jnp.uint32),
+        scratch_shapes=scratch,
+        interpret=interpret,
+    )(xt)
+    return out.T
+
+
+_romix_pallas_jit = jax.jit(
+    romix_pallas, static_argnames=("n", "lane_tile", "interpret"))

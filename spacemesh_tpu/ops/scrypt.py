@@ -153,7 +153,23 @@ def _stage_expand(commitment_words, idx_lo, idx_hi):
     return inner_mid, outer_mid, _pbkdf2_first(inner_mid, outer_mid, idx_lo, idx_hi)
 
 
-_stage_romix = jax.jit(romix_r1, static_argnames=("n",))
+_stage_romix_xla = jax.jit(romix_r1, static_argnames=("n",))
+
+
+def _stage_romix(blk, *, n: int):
+    """ROMix stage dispatch: the XLA gather path by default; the Pallas
+    contiguous-row + async-copy variant behind SPACEMESH_ROMIX=pallas
+    (the round-2 race candidate — ops/romix_pallas.py; falls back when
+    the batch doesn't tile)."""
+    import os
+
+    if os.environ.get("SPACEMESH_ROMIX") == "pallas":
+        from .romix_pallas import LANE_TILE, _romix_pallas_jit
+
+        if blk.shape[1] % LANE_TILE == 0:
+            interpret = jax.default_backend() != "tpu"
+            return _romix_pallas_jit(blk, n=n, interpret=interpret)
+    return _stage_romix_xla(blk, n=n)
 
 
 @jax.jit

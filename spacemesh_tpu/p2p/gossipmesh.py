@@ -58,11 +58,16 @@ class MessageCache:
             return
         self._frames[msg_id] = (topic, frame)
         self._window[0].append((msg_id, topic))
-        if len(self._frames) > self.max_msgs:
-            # age out whole rounds first, then hard-trim
-            while len(self._window) > 1 and len(self._frames) > self.max_msgs:
-                for mid, _ in self._window.pop():
-                    self._frames.pop(mid, None)
+        # age out whole rounds first...
+        while len(self._window) > 1 and len(self._frames) > self.max_msgs:
+            for mid, _ in self._window.pop():
+                self._frames.pop(mid, None)
+        # ...then hard-trim the current round: a burst bigger than the
+        # cache within ONE heartbeat must not balloon memory (frames can
+        # be large; ids stay droppable — IWANT for them just misses)
+        while len(self._frames) > self.max_msgs and self._window[0]:
+            mid, _ = self._window[0].pop(0)
+            self._frames.pop(mid, None)
 
     def get(self, msg_id: bytes) -> bytes | None:
         entry = self._frames.get(msg_id)

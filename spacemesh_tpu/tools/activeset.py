@@ -1,0 +1,51 @@
+"""activeset: query the ATXs published in an epoch from a state db.
+
+Mirrors the reference tool (reference cmd/activeset/activeset.go: ids +
+total weight for a publish epoch, read straight from state.sql).
+
+  python -m spacemesh_tpu.tools.activeset 3 ./node/state.db
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="spacemesh_tpu.tools.activeset")
+    p.add_argument("publish_epoch", type=int)
+    p.add_argument("db_path")
+    a = p.parse_args(argv)
+
+    from ..storage import atxs as atxstore
+    from ..storage import db as dbmod
+
+    db = dbmod.open_state(a.db_path)
+    try:
+        ids = atxstore.ids_in_epoch(db, a.publish_epoch)
+        total_weight = 0
+        entries = []
+        for atx_id in ids:
+            atx = atxstore.get(db, atx_id)
+            height = atxstore.tick_height(db, atx_id) or 0
+            prev_height = 0
+            if atx is not None and atx.prev_atx:
+                prev_height = atxstore.tick_height(db, atx.prev_atx) or 0
+            weight = (atx.num_units if atx else 0) * \
+                max(height - prev_height, 0)
+            total_weight += weight
+            entries.append({"id": atx_id.hex(),
+                            "node_id": atx.node_id.hex() if atx else None,
+                            "num_units": atx.num_units if atx else 0,
+                            "weight": weight})
+        print(json.dumps({"epoch": a.publish_epoch, "count": len(ids),
+                          "total_weight": total_weight, "atxs": entries}))
+    finally:
+        db.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

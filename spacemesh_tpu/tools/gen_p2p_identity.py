@@ -1,0 +1,52 @@
+"""gen-p2p-identity: mint a node identity key file.
+
+Mirrors the reference tool (reference cmd/gen-p2p-identity): generates
+an ed25519 identity and writes it where the node looks for it
+(data-dir/identities/local.key — node/app.py _load_or_create_identities;
+the node id doubles as the p2p peer id, transport.py binds it to the
+noise channel).
+
+  python -m spacemesh_tpu.tools.gen_p2p_identity --data-dir ./node
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="spacemesh_tpu.tools.gen_p2p_identity")
+    p.add_argument("--data-dir", required=True,
+                   help="node data dir (key lands in identities/)")
+    p.add_argument("--name", default="local.key",
+                   help="key file name (local.key = the primary identity; "
+                   "local_NN.key adds a smesher)")
+    p.add_argument("--genesis-extra", default="tpu-mainnet")
+    p.add_argument("--genesis-time", type=float, default=0.0,
+                   help="unix seconds (with --genesis-extra derives the "
+                   "signing prefix — must match the network config)")
+    a = p.parse_args(argv)
+
+    from ..core.signing import EdSigner
+    from ..node.config import GenesisConfig
+
+    prefix = GenesisConfig(time=a.genesis_time,
+                           extra_data=a.genesis_extra).genesis_id
+    key_dir = Path(a.data_dir) / "identities"
+    key_dir.mkdir(parents=True, exist_ok=True)
+    key_file = key_dir / a.name
+    if key_file.exists():
+        print(f"refusing to overwrite {key_file}", file=sys.stderr)
+        return 1
+    s = EdSigner(prefix=prefix)
+    key_file.write_text(s.private_bytes().hex())
+    key_file.chmod(0o600)
+    print(json.dumps({"path": str(key_file), "node_id": s.node_id.hex()}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

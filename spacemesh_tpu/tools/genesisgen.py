@@ -1,0 +1,63 @@
+"""genesisgen: mint genesis identities for a new network.
+
+Mirrors the reference tool (reference cmd/genesisgen/main.go): given a
+genesis time (RFC3339) and extra data, validates the genesis config,
+derives the network's genesis id, and prints N freshly generated smesher
+identities as JSON lines — private key, node id, and the initial POST
+commitment (commitment_of(node_id, golden_atx), what `post init` needs).
+
+  python -m spacemesh_tpu.tools.genesisgen \
+      --time 2026-01-01T00:00:00Z --extra my-testnet -n 4
+"""
+
+from __future__ import annotations
+
+import argparse
+import datetime
+import json
+import sys
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="spacemesh_tpu.tools.genesisgen")
+    p.add_argument("--time", required=True,
+                   help="genesis time, RFC3339 (e.g. 2026-01-01T00:00:00Z)")
+    p.add_argument("--extra", default="tpu-mainnet",
+                   help="genesis extra data, 1..255 chars")
+    p.add_argument("-n", type=int, default=10, help="number of identities")
+    a = p.parse_args(argv)
+
+    try:
+        dt = datetime.datetime.fromisoformat(a.time.replace("Z", "+00:00"))
+    except ValueError as e:
+        print(f"invalid genesis time: {e}", file=sys.stderr)
+        return 1
+    if not 1 <= len(a.extra) <= 255:
+        print("extra data must be 1..255 chars", file=sys.stderr)
+        return 1
+
+    from ..consensus.activation import commitment_of
+    from ..core.hashing import sum256
+    from ..core.signing import EdSigner
+    from ..node.config import GenesisConfig
+
+    genesis = GenesisConfig(time=dt.timestamp(), extra_data=a.extra)
+    prefix = genesis.genesis_id
+    golden = sum256(b"golden", prefix)
+    print(json.dumps({"genesis_id": prefix.hex(),
+                      "genesis_time": dt.isoformat(),
+                      "extra_data": a.extra,
+                      "golden_atx": golden.hex()}))
+    for i in range(a.n):
+        s = EdSigner(prefix=prefix)
+        print(json.dumps({
+            "n": i,
+            "private": s.private_bytes().hex(),
+            "id": s.node_id.hex(),
+            "commitment": commitment_of(s.node_id, golden).hex(),
+        }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -91,7 +91,8 @@ def test_multi_poet_survives_dead_poet(poets):
     class Dead:
         poet_id = b"\0" * 32
 
-        async def register(self, r, c):
+        async def register(self, r, c, node_id=None, signature=None,
+                           cert=None):
             raise ConnectionRefusedError
 
         async def execute_round(self, r):

@@ -1,0 +1,202 @@
+"""sync2 rangesync: XOR-Fenwick fingerprints + bisection reconciliation.
+
+Reference sync2/rangesync/rangesync.go (recursive range reconciliation,
+DefaultMaxSendRange=16) and fingerprint.go (XOR fingerprints).  The
+efficiency test pins the point of the subsystem: a small symmetric
+difference reconciles with far fewer keys on the wire than a full
+exchange.
+"""
+
+import asyncio
+import hashlib
+
+from spacemesh_tpu.p2p.rangesync import (
+    P_RANGESYNC,
+    TOP,
+    ZERO,
+    OrderedSet,
+    RangeSyncClient,
+    RangeSyncResponder,
+    XorFenwick,
+    _xor,
+)
+from spacemesh_tpu.p2p.server import LoopbackNet, Server
+
+
+def key(i: int) -> bytes:
+    return hashlib.sha256(b"k%d" % i).digest()
+
+
+def test_fenwick_matches_naive_xor():
+    keys = sorted(key(i) for i in range(50))
+    fen = XorFenwick(len(keys))
+    for i, k in enumerate(keys):
+        fen.update(i, k)
+    for lo in (0, 7, 31):
+        for hi in (lo, lo + 1, 42, 50):
+            want = bytes(32)
+            for k in keys[lo:hi]:
+                want = _xor(want, k)
+            assert _xor(fen.prefix(hi), fen.prefix(lo)) == want
+
+
+def test_ordered_set_fingerprints_and_lazy_adds():
+    s = OrderedSet(key(i) for i in range(10))
+    fp_all, n = s.fingerprint()
+    assert n == 10
+    s.add(key(99))
+    s.add(key(99))  # dupes collapse
+    fp2, n2 = s.fingerprint()
+    assert n2 == 11
+    assert fp2 == _xor(fp_all, key(99))
+    lo, hi = sorted([key(3), key(7)])
+    fp_range, cnt = s.fingerprint(lo, hi)
+    naive = bytes(32)
+    c = 0
+    for k in s.keys():
+        if lo <= k < hi:
+            naive = _xor(naive, k)
+            c += 1
+    assert (fp_range, cnt) == (naive, c)
+
+
+def _pair(local_keys, remote_keys):
+    net = LoopbackNet()
+    a, b = Server(b"A" * 32), Server(b"B" * 32)
+    net.join(a)
+    net.join(b)
+    remote = OrderedSet(remote_keys)
+    b.register(P_RANGESYNC,
+               RangeSyncResponder(lambda name: remote
+                                  if name == "s" else None).handle)
+    local = OrderedSet(local_keys)
+    client = RangeSyncClient(a, b.node_id, "s")
+    return local, client
+
+
+def test_reconcile_finds_exactly_the_missing_keys():
+    universe = [key(i) for i in range(400)]
+    local_keys = universe[:390]          # missing 10 of theirs
+    remote_keys = universe[5:]           # and they lack 5 of ours
+    local, client = _pair(local_keys, remote_keys)
+
+    async def go():
+        missing = await client.reconcile(local)
+        assert sorted(missing) == sorted(universe[390:])
+
+    asyncio.run(go())
+
+
+def test_equal_sets_need_one_roundtrip():
+    keys = [key(i) for i in range(1000)]
+    local, client = _pair(keys, keys)
+
+    async def go():
+        missing = await client.reconcile(local)
+        assert missing == []
+        assert client.roundtrips == 1  # root fingerprints matched
+
+    asyncio.run(go())
+
+
+def test_small_diff_beats_full_exchange():
+    """1000-key sets differing in 8 keys: the keys that cross the wire
+    are O(diff * max_send_range), nowhere near the 1000 a full exchange
+    ships (the reference subsystem's reason to exist)."""
+    universe = [key(i) for i in range(1008)]
+    local_keys = universe[:1000]
+    remote_keys = universe[:992] + universe[1000:]
+    local, client = _pair(local_keys, remote_keys)
+
+    async def go():
+        transferred = 0
+        orig_items = client._items
+
+        async def counting_items(x, y):
+            nonlocal transferred
+            items = await orig_items(x, y)
+            transferred += len(items)
+            return items
+
+        client._items = counting_items
+        missing = await client.reconcile(local)
+        assert sorted(missing) == sorted(universe[1000:])
+        # every differing leaf range ships <= max_send_range keys and
+        # there are 16 difference sites: worst case 256, full exchange
+        # is 1000+
+        assert transferred <= 16 * 16, f"{transferred} keys shipped"
+        assert client.roundtrips < 120
+
+    asyncio.run(go())
+
+
+def test_empty_local_pulls_everything():
+    keys = [key(i) for i in range(100)]
+    local, client = _pair([], keys)
+
+    async def go():
+        missing = await client.reconcile(local)
+        assert sorted(missing) == sorted(keys)
+
+    asyncio.run(go())
+
+
+def test_node_serves_epoch_atx_sets(tmp_path):
+    """The App registers rs/1: a peer reconciles an epoch's ATX ids
+    against a live node's state (the sync2 integration seam)."""
+    from spacemesh_tpu.node.app import App
+    from spacemesh_tpu.node.config import load
+
+    cfg = load("standalone", overrides={
+        "data_dir": str(tmp_path / "node"),
+        "smeshing": {"start": False},
+    })
+    app = App(cfg)
+    try:
+        from spacemesh_tpu.core.types import (
+            ActivationTx,
+            MerkleProof,
+            NIPost,
+            Post,
+            PostMetadataWire,
+        )
+        from spacemesh_tpu.core.signing import EdSigner
+        from spacemesh_tpu.p2p.server import LoopbackNet
+        from spacemesh_tpu.storage import atxs as atxstore
+
+        nipost = NIPost(
+            membership=MerkleProof(leaf_index=0, nodes=[]),
+            post=Post(nonce=0, indices=[1], pow_nonce=0),
+            post_metadata=PostMetadataWire(challenge=bytes(32),
+                                           labels_per_unit=256))
+        ids = []
+        for i in range(5):
+            s = EdSigner(prefix=cfg.genesis.genesis_id)
+            atx = ActivationTx(
+                publish_epoch=2, prev_atx=bytes(32), pos_atx=bytes(32),
+                commitment_atx=None, initial_post=None, nipost=nipost,
+                num_units=1, vrf_nonce=0, vrf_public_key=s.node_id,
+                coinbase=bytes(24), node_id=s.node_id,
+                signature=bytes(64))
+            atxstore.add(app.state, atx, tick_height=1)
+            ids.append(atx.id)
+
+        net = LoopbackNet()
+        app.connect_network(net)
+        peer = Server(b"P" * 32)
+        net.join(peer)
+
+        async def go():
+            client = RangeSyncClient(peer, app.server.node_id, "atx/2")
+            missing = await client.reconcile(OrderedSet())
+            assert sorted(missing) == sorted(ids)
+            # unknown set name answers empty, reconcile degrades safely
+            c2 = RangeSyncClient(peer, app.server.node_id, "nope")
+            try:
+                await c2.reconcile(OrderedSet())
+            except ValueError:
+                pass  # malformed/empty answer surfaces as an error, not a hang
+
+        asyncio.run(go())
+    finally:
+        app.close()
