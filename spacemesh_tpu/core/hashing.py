@@ -4,16 +4,19 @@ The reference hashes everything with blake3 (reference hash/hash.go:16
 `hash.Sum` via zeebo/blake3, with 32- and 20-byte variants). This is an
 independent from-spec implementation (IV/rounds/permutation per the BLAKE3
 paper: 7-round compression, 1024-byte chunks, binary tree with the
-chunk-stack merge rule). Pure Python is plenty for the control plane
-(consensus objects are small); bulk hashing hot paths belong to the JAX ops
-anyway.
+chunk-stack merge rule).
 
-API mirrors the reference's hash package: ``sum256`` / ``sum160`` one-shot,
-``Hasher`` incremental, both keyed and unkeyed.
+The ONE-SHOT paths (sum256/sum160/keyed — every gossip message id, codec
+content id, address and merkle node) dispatch to the native C++ twin
+(native/blake3.cpp, ~1000x the pure-Python rate, built on demand and
+loaded via ctypes); this module stays the reference implementation,
+vector-tested, and the fallback when the toolchain is absent.
+``Hasher`` (incremental) is Python-only — it sits on cold paths.
 """
 
 from __future__ import annotations
 
+import ctypes as _ctypes
 import struct as _struct
 
 _IV = (0x6A09E667, 0xBB67AE85, 0x3C6EF372, 0xA54FF53A,
@@ -169,24 +172,52 @@ class Hasher:
         return self.digest(length).hex()
 
 
+# --- native dispatch -------------------------------------------------------
+
+_native = None
+
+
+def _load_native():
+    global _native
+    if _native is not None:
+        return _native
+    try:
+        from .. import native as _native_mod
+
+        lib = _native_mod.load("blake3")
+    except Exception:  # pragma: no cover — packaging edge
+        lib = None
+    if lib is not None:
+        lib.smtpu_blake3.argtypes = [
+            _ctypes.c_char_p, _ctypes.c_size_t, _ctypes.c_char_p,
+            _ctypes.c_char_p, _ctypes.c_size_t]
+        lib.smtpu_blake3.restype = None
+    _native = lib if lib is not None else False
+    return _native
+
+
+def _hash_oneshot(data: bytes, key: bytes | None, length: int) -> bytes:
+    lib = _load_native()
+    if lib:
+        out = _ctypes.create_string_buffer(length)
+        lib.smtpu_blake3(data, len(data), key, out, length)
+        return out.raw
+    h = Hasher(key=key)
+    h.update(data)
+    return h.digest(length)
+
+
 def sum256(*chunks: bytes) -> bytes:
     """32-byte hash of the concatenation (reference hash.Sum)."""
-    h = Hasher()
-    for c in chunks:
-        h.update(c)
-    return h.digest(32)
+    return _hash_oneshot(b"".join(chunks), None, 32)
 
 
 def sum160(*chunks: bytes) -> bytes:
     """20-byte truncated hash (reference hash/hash.go Sum20 for addresses)."""
-    h = Hasher()
-    for c in chunks:
-        h.update(c)
-    return h.digest(20)
+    return _hash_oneshot(b"".join(chunks), None, 20)
 
 
 def keyed(key: bytes, *chunks: bytes) -> bytes:
-    h = Hasher(key=key)
-    for c in chunks:
-        h.update(c)
-    return h.digest(32)
+    if len(key) != 32:
+        raise ValueError("key must be 32 bytes")
+    return _hash_oneshot(b"".join(chunks), key, 32)

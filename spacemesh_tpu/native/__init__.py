@@ -1,0 +1,52 @@
+"""Native runtime components (C++), loaded via ctypes.
+
+The compute path is JAX/XLA/Pallas; the node RUNTIME's hot host-side
+ops live here (the reference's equivalents are Rust/C crates).  Builds
+are on-demand and cached next to the source; every native component has
+a pure-Python twin as fallback and test oracle.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from pathlib import Path
+
+_DIR = Path(__file__).resolve().parent
+_LOCK = threading.Lock()
+_LIBS: dict[str, ctypes.CDLL | None] = {}
+
+
+def _build(name: str) -> Path | None:
+    src = _DIR / f"{name}.cpp"
+    lib = _DIR / f"libsmtpu_{name}.so"
+    if lib.exists() and lib.stat().st_mtime >= src.stat().st_mtime:
+        return lib
+    tmp = lib.with_suffix(".so.tmp%d" % os.getpid())
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-o", str(tmp), str(src)]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(tmp, lib)  # atomic: concurrent builders race safely
+        return lib
+    except (subprocess.SubprocessError, OSError):
+        tmp.unlink(missing_ok=True)
+        return None
+
+
+def load(name: str) -> ctypes.CDLL | None:
+    """Compile (if stale) + dlopen libsmtpu_<name>.so; None on any
+    failure — callers fall back to their Python twin."""
+    with _LOCK:
+        if name in _LIBS:
+            return _LIBS[name]
+        lib_path = _build(name)
+        lib = None
+        if lib_path is not None:
+            try:
+                lib = ctypes.CDLL(str(lib_path))
+            except OSError:
+                lib = None
+        _LIBS[name] = lib
+        return lib

@@ -97,6 +97,37 @@ def has(db: Database, atx_id: bytes) -> bool:
     return db.one("SELECT 1 FROM atxs WHERE id=?", (atx_id,)) is not None
 
 
+def list_rows(db: Database, *, limit: int, offset: int = 0,
+              epoch: int | None = None, smesher: bytes | None = None,
+              coinbase: bytes | None = None) -> list:
+    """Paginated ATX listing (reference v2alpha1 ActivationService.List:
+    sql builder ops over epoch/smesher/coinbase, LIMIT capped by the
+    service)."""
+    where, args = [], []
+    if epoch is not None:
+        where.append("publish_epoch=?")
+        args.append(epoch)
+    if smesher is not None:
+        where.append("node_id=?")
+        args.append(smesher)
+    if coinbase is not None:
+        where.append("coinbase=?")
+        args.append(coinbase)
+    clause = (" WHERE " + " AND ".join(where)) if where else ""
+    return db.all(
+        f"SELECT * FROM atxs{clause} ORDER BY publish_epoch, id"
+        " LIMIT ? OFFSET ?", (*args, limit, offset))
+
+
+def count(db: Database, *, epoch: int | None = None) -> int:
+    if epoch is None:
+        row = db.one("SELECT COUNT(*) AS n FROM atxs", ())
+    else:
+        row = db.one("SELECT COUNT(*) AS n FROM atxs WHERE publish_epoch=?",
+                     (epoch,))
+    return row["n"] if row else 0
+
+
 def tick_height(db: Database, atx_id: bytes) -> int | None:
     row = db.one("SELECT tick_height FROM atxs WHERE id=?", (atx_id,))
     return row["tick_height"] if row else None

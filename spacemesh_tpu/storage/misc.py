@@ -129,6 +129,20 @@ def rewards_for(db: Database, coinbase: bytes) -> list[tuple[int, int]]:
                    " ORDER BY layer", (coinbase,))]
 
 
+def list_rewards(db: Database, *, limit: int, offset: int = 0,
+                 coinbase: bytes | None = None,
+                 start_layer: int = 0) -> list:
+    """Paginated reward listing (reference v2alpha1 RewardService.List)."""
+    where, args = ["layer >= ?"], [start_layer]
+    if coinbase is not None:
+        where.append("coinbase=?")
+        args.append(coinbase)
+    return db.all(
+        "SELECT coinbase, layer, total_reward, layer_reward FROM rewards"
+        f" WHERE {' AND '.join(where)} ORDER BY layer, coinbase"
+        " LIMIT ? OFFSET ?", (*args, limit, offset))
+
+
 # --- poet proofs -----------------------------------------------------------
 
 

@@ -69,14 +69,27 @@ class NodeProc:
     def listen(self) -> str:
         return f"127.0.0.1:{self.listen_port}"
 
-    def api(self, path: str, body: dict | None = None, timeout=5.0):
+    def api(self, path: str, body: dict | None = None, timeout=5.0,
+            attempts: int = 4):
+        """One API call with transient-failure retries: on a machine
+        loaded with N JAX subprocesses a node's accept queue can stall
+        for a beat — a single refused connection must not fail a chaos
+        scenario."""
         url = f"http://127.0.0.1:{self.api_port}{path}"
         data = json.dumps(body).encode() if body is not None else None
-        req = urllib.request.Request(
-            url, data=data,
-            headers={"Content-Type": "application/json"} if data else {})
-        with urllib.request.urlopen(req, timeout=timeout) as r:
-            return json.loads(r.read())
+        last: Exception | None = None
+        for attempt in range(attempts):
+            req = urllib.request.Request(
+                url, data=data,
+                headers={"Content-Type": "application/json"} if data else {})
+            try:
+                with urllib.request.urlopen(req, timeout=timeout) as r:
+                    return json.loads(r.read())
+            except (urllib.error.URLError, OSError, TimeoutError) as e:
+                last = e
+                if attempt + 1 < attempts:
+                    time.sleep(1.0)
+        raise last
 
     def alive(self) -> bool:
         return self.proc is not None and self.proc.poll() is None
@@ -180,7 +193,7 @@ class Cluster:
     @staticmethod
     def _api_up(node: NodeProc) -> bool:
         try:
-            node.api("/v1/node/status")
+            node.api("/v1/node/status", attempts=1)  # polled: no retry
             return True
         except (urllib.error.URLError, OSError, TimeoutError):
             return False
