@@ -94,7 +94,12 @@ class Handler:
     def __init__(self, *, db: Database, cache: AtxCache, verifier: EdVerifier,
                  golden_atx: bytes, post_params: ProofParams,
                  labels_per_unit: int, scrypt_n: int, pubsub: PubSub,
-                 on_atx: Optional[Callable[[ActivationTx], None]] = None):
+                 on_atx: Optional[Callable[[ActivationTx], None]] = None,
+                 now: Optional[Callable[[], float]] = None):
+        import time as _time
+
+        self.now = now or _time.time  # the NODE's clock domain: receipt
+        # times must be comparable to the layer clock (virtual in tests)
         self.db = db
         self.cache = cache
         self.verifier = verifier
@@ -166,7 +171,10 @@ class Handler:
             prev_height = atxstore.tick_height(self.db, atx.prev_atx) or 0
         height = prev_height + ticks
         with self.db.tx():
-            atxstore.add(self.db, atx, tick_height=height)
+            # receipt time feeds active-set grading
+            # (consensus/activeset.py grade_atx)
+            atxstore.add(self.db, atx, tick_height=height,
+                         received=self.now())
         self.cache.add(atx.target_epoch(), atx.id, AtxInfo(
             node_id=atx.node_id, weight=atx.num_units * ticks,
             base_height=prev_height, height=height, num_units=atx.num_units,

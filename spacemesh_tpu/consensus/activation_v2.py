@@ -54,7 +54,10 @@ class HandlerV2:
     def __init__(self, *, db: Database, cache: AtxCache,
                  verifier: EdVerifier, golden_atx: bytes,
                  post_params: ProofParams, labels_per_unit: int,
-                 scrypt_n: int, pubsub=None, on_atx=None):
+                 scrypt_n: int, pubsub=None, on_atx=None, now=None):
+        import time as _time
+
+        self.now = now or _time.time
         self.db = db
         self.cache = cache
         self.verifier = verifier
@@ -154,7 +157,8 @@ class HandlerV2:
     def _store(self, atx2: ActivationTxV2, ticks: dict,
                heights: dict) -> None:
         with self.db.tx():
-            atxstore.add_v2(self.db, atx2, tick_heights=ticks)
+            atxstore.add_v2(self.db, atx2, tick_heights=ticks,
+                            received=self.now())
             # record the equivocation set: everyone in the envelope is
             # married to everyone else via this ATX
             if atx2.marriages:

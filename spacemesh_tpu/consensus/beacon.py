@@ -50,7 +50,8 @@ from ..p2p.pubsub import (
 from ..storage import misc as miscstore
 from ..storage.db import Database
 from ..utils.logging import get as get_logger
-from .eligibility import FIXED, Oracle, _frac_of_output
+from ..core.fixedpoint import ONE as FIXED, frac_from_bytes
+from .eligibility import Oracle
 
 BEACON_SIZE = 4
 
@@ -229,7 +230,7 @@ class ProtocolDriver:
         (reference beacon proposal checker). Small nets pass trivially."""
         count = max(self.oracle.cache.epoch_count(epoch), 1)
         thresh = min(FIXED, FIXED * self.kappa // count)
-        return _frac_of_output(vrf_output(proof)) < thresh
+        return frac_from_bytes(vrf_output(proof)) < thresh
 
     async def _on_proposal(self, peer: bytes, data: bytes) -> bool:
         try:

@@ -41,7 +41,7 @@ class Generator:
         epoch = out.layer // self.layers_per_epoch
         tx_ids: list[bytes] = []
         seen = set()
-        rewards: dict[bytes, int] = {}
+        rewards: dict[bytes, tuple[bytes, int]] = {}  # atx -> (coinbase, w)
         height = 0
         for p in sorted(props, key=lambda p: p.id):
             for tx in p.tx_ids:
@@ -49,22 +49,26 @@ class Generator:
                     seen.add(tx)
                     tx_ids.append(tx)
             weight = len(p.ballot.eligibilities)
+            atx_id = p.ballot.atx_id
             coinbase = self._coinbase_of(epoch, p)
-            rewards[coinbase] = rewards.get(coinbase, 0) + weight
-            info = self.cache.get(epoch, p.ballot.atx_id)
+            prev = rewards.get(atx_id, (coinbase, 0))[1]
+            rewards[atx_id] = (coinbase, prev + weight)
+            info = self.cache.get(epoch, atx_id)
             if info is not None:
                 height = max(height, info.height)
         block = Block(
             layer=out.layer, tick_height=height,
-            rewards=[Reward(coinbase=c, weight=w)
-                     for c, w in sorted(rewards.items())],
+            rewards=[Reward(atx_id=a, coinbase=c, weight=w)
+                     for a, (c, w) in sorted(rewards.items())],
             tx_ids=tx_ids)
         return block
 
     def _coinbase_of(self, epoch: int, p: Proposal) -> bytes:
         from ..storage import atxs as atxstore
-        atx = atxstore.get(self.mesh.db, p.ballot.atx_id)
-        return atx.coinbase if atx is not None else bytes(24)
+        # version-independent: v2 (merged) identity rows share the
+        # envelope blob but carry the coinbase column directly
+        cb = atxstore.coinbase_of(self.mesh.db, p.ballot.atx_id)
+        return cb if cb is not None else bytes(24)
 
     def process_hare_output(self, out: ConsensusOutput) -> Optional[Block]:
         block = self.generate(out)

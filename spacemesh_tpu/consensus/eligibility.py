@@ -7,10 +7,11 @@ Mirrors the reference's two oracles:
   ceil(w * slots_per_epoch / W_total) proposal eligibilities per epoch;
   slot j's VRF output places it in a layer of the epoch.
 - hare committee (reference hare3/eligibility/oracle.go:344
-  CalcEligibility): per (layer, round), an identity is eligible with
-  probability committee_size * w_i / W_total, decided by its VRF output;
-  the eligibility proof is the VRF signature, verifiable by anyone
-  (oracle.go:297 Validate).
+  CalcEligibility): per (layer, round), an identity's seat count is a
+  binomial sample — ``weight`` Bernoulli trials at p = committee/W_total,
+  drawn by inverse CDF at the VRF output's uniform fraction
+  (core/fixedpoint.py); the eligibility proof is the VRF signature,
+  verifiable by anyone (oracle.go:297 Validate).
 
 VRF message shapes (domain-separated through the VRF alpha):
   proposal slot:  "PROP" || beacon || epoch u32 || j u32
@@ -21,15 +22,9 @@ from __future__ import annotations
 
 import struct
 
+from ..core import fixedpoint
 from ..core.signing import VrfVerifier, vrf_output
 from ..storage.cache import AtxCache
-
-FIXED = 1 << 52  # fixed-point scale for probability compare
-
-
-def _frac_of_output(out: bytes) -> int:
-    """Map a VRF output to a uniform fixed-point fraction in [0, FIXED)."""
-    return int.from_bytes(out[:8], "little") % FIXED
 
 
 def proposal_alpha(beacon: bytes, epoch: int, j: int) -> bytes:
@@ -42,25 +37,35 @@ def hare_alpha(beacon: bytes, layer: int, round_: int) -> bytes:
 
 class Oracle:
     def __init__(self, cache: AtxCache, layers_per_epoch: int,
-                 slots_per_layer: int = 50):
+                 slots_per_layer: int = 50,
+                 min_weight_table: list[tuple[int, int]] | None = None):
         self.cache = cache
         self.layers_per_epoch = layers_per_epoch
         self.slots_per_layer = slots_per_layer
+        # (epoch, weight) ascending — reference miner/minweight table,
+        # wired from config (mainnet.go MinimalActiveSetWeight)
+        self.min_weight_table = min_weight_table or []
         self._vrf = VrfVerifier()
 
     # --- proposal eligibility -----------------------------------------
 
     def num_slots(self, epoch: int, atx_id: bytes) -> int:
-        """Proposal slots for this ATX in the epoch (weight-proportional,
-        minimum 1 for any active ATX)."""
+        """Proposal slots for this ATX in the epoch: weight-proportional
+        with the epoch min-weight floor in the denominator
+        (proposals/util/util.go:29-39 + miner/minweight Select) — the
+        gating that stops dust identities from harvesting outsized slot
+        counts on young or shrunken networks."""
+        from .activeset import num_eligible_slots, select_min_weight
+
         info = self.cache.get(epoch, atx_id)
         if info is None or info.malicious:
             return 0
         total = self.cache.epoch_weight(epoch)
         if total == 0:
             return 0
-        slots_per_epoch = self.slots_per_layer * self.layers_per_epoch
-        return max(1, info.weight * slots_per_epoch // total)
+        return num_eligible_slots(
+            info.weight, select_min_weight(epoch, self.min_weight_table),
+            total, self.slots_per_layer, self.layers_per_epoch)
 
     def slot_layer(self, epoch: int, vrf_proof: bytes) -> int:
         """The layer (within the epoch) where a proposal slot lands."""
@@ -93,37 +98,41 @@ class Oracle:
 
     # --- hare committee ------------------------------------------------
 
-    def _expected_slots(self, epoch: int, atx_id: bytes,
-                        committee_size: int) -> tuple[int, int]:
-        """(whole slots, fractional part in FIXED) of this identity's
-        expected committee seats: committee * w_i / W (the reference's
-        binomial sampling by weight, oracle.go:344, in expectation)."""
+    def _binomial_params(self, epoch: int, atx_id: bytes,
+                         committee_size: int) -> tuple[int, int, int]:
+        """(n_trials, p_num, p_den) of this identity's seat-count binomial:
+        ``weight`` Bernoulli trials at p = committee / total_weight
+        (reference oracle.go:271-292 prepareEligibilityCheck, including the
+        committee>total rescale that keeps p <= 1)."""
         info = self.cache.get(epoch, atx_id)
         if info is None or info.malicious:
-            return 0, 0
+            return 0, 0, 1
         total = self.cache.epoch_weight(epoch)
         if total == 0:
-            return 0, 0
-        whole = committee_size * info.weight // total
-        frac = (committee_size * info.weight * FIXED // total) % FIXED
-        return whole, frac
+            return 0, 0, 1
+        n = info.weight
+        if committee_size > total:
+            n *= committee_size
+            total *= committee_size
+        return n, committee_size, total
 
-    def _count_from_proof(self, proof: bytes, whole: int, frac: int) -> int:
-        """Deterministic seat count derived from the VRF output: the
-        fractional expected seat materializes iff the uniform draw falls
-        under it — both prover and validator compute the same count."""
-        extra = 1 if _frac_of_output(vrf_output(proof)) < frac else 0
-        return whole + extra
+    def _count_from_proof(self, proof: bytes, n: int, p_num: int,
+                          p_den: int) -> int:
+        """Seat count = inverse binomial CDF at the VRF output's uniform
+        fraction (reference oracle.go:344-375 CalcEligibility via
+        fixed.BinCDF) — both prover and validator compute the same count."""
+        frac = fixedpoint.frac_from_bytes(vrf_output(proof))
+        return fixedpoint.binomial_count(n, p_num, p_den, frac)
 
     def hare_eligibility(self, vrf_signer, beacon: bytes, layer: int,
                          round_: int, epoch: int, atx_id: bytes,
                          committee_size: int) -> tuple[bytes, int] | None:
         """(VRF proof, seat count) if on the committee, else None."""
-        whole, frac = self._expected_slots(epoch, atx_id, committee_size)
-        if whole == 0 and frac == 0:
+        n, p_num, p_den = self._binomial_params(epoch, atx_id, committee_size)
+        if n == 0 or p_num == 0:
             return None
         proof = vrf_signer.prove(hare_alpha(beacon, layer, round_))
-        count = self._count_from_proof(proof, whole, frac)
+        count = self._count_from_proof(proof, n, p_num, p_den)
         return (proof, count) if count > 0 else None
 
     def validate_hare(self, beacon: bytes, layer: int, round_: int,
@@ -131,12 +140,14 @@ class Oracle:
                       proof: bytes, claimed_count: int) -> bool:
         """Membership AND the claimed seat count must match the proof —
         the count is derived, never trusted (a forged count would multiply
-        an attacker's vote weight)."""
+        an attacker's vote weight). Equivalent to the reference's interval
+        check BinCDF(n,p,x-1) <= vrfFrac < BinCDF(n,p,x) (oracle.go:324)."""
         key = self.vrf_key(epoch, atx_id)
         if key is None:
             return False
         if not self._vrf.verify(key, hare_alpha(beacon, layer, round_), proof):
             return False
-        whole, frac = self._expected_slots(epoch, atx_id, committee_size)
+        n, p_num, p_den = self._binomial_params(epoch, atx_id, committee_size)
         return (claimed_count > 0
-                and claimed_count == self._count_from_proof(proof, whole, frac))
+                and claimed_count == self._count_from_proof(
+                    proof, n, p_num, p_den))

@@ -48,15 +48,17 @@ class _BadBeacon(str):
 BAD_BEACON = _BadBeacon("bad-beacon")
 
 
-def active_set_root(atx_ids: list[bytes]) -> bytes:
-    return sum256(*sorted(atx_ids)) if atx_ids else bytes(32)
+# single definition of the set-commitment hash (consensus/activeset.py);
+# re-exported under the historical name
+from .activeset import active_set_hash as active_set_root  # noqa: E402
 
 
 class ProposalBuilder:
     def __init__(self, *, signer: EdSigner, db: Database, cache: AtxCache,
                  oracle: Oracle, tortoise: Tortoise,
                  cstate: ConservativeState, pubsub: PubSub,
-                 layers_per_epoch: int, beacon_getter):
+                 layers_per_epoch: int, beacon_getter,
+                 activeset_gen=None):
         self.signer = signer
         self.db = db
         self.cache = cache
@@ -66,6 +68,9 @@ class ProposalBuilder:
         self.pubsub = pubsub
         self.layers_per_epoch = layers_per_epoch
         self.beacon_getter = beacon_getter
+        # graded three-path generator (consensus/activeset.py); falls back
+        # to the full atxsdata view when it can't produce a set yet
+        self.activeset_gen = activeset_gen
 
     def own_atx(self, epoch: int) -> Optional[bytes]:
         for atx_id, info in self.cache.iter_epoch(epoch):
@@ -97,7 +102,17 @@ class ProposalBuilder:
         epoch_data = None
         ref_id = EMPTY32
         if ref is None:
-            active = [a for a, _ in self.cache.iter_epoch(epoch)]
+            active = None
+            if self.activeset_gen is not None:
+                try:
+                    _, _, active = self.activeset_gen.generate(layer, epoch)
+                except LookupError:
+                    active = None
+            if active is None:
+                active = [a for a, _ in self.cache.iter_epoch(epoch)]
+            from ..storage import misc as miscstore
+            miscstore.add_active_set(self.db, active_set_root(active),
+                                     epoch, sorted(active))
             epoch_data = EpochData(
                 beacon=beacon, active_set_root=active_set_root(active),
                 eligibility_count=self.oracle.num_slots(epoch, atx_id))

@@ -433,10 +433,15 @@ class Proposal:
 
 @codec.register
 class Reward:
+    """Block reward entry (reference common/types/block.go AnyReward:
+    {ATXID, Weight}; coinbase carried too since our apply path pays it
+    directly rather than re-resolving the ATX)."""
+
+    atx_id: bytes
     coinbase: bytes
     weight: int
 
-    FIELDS = [("coinbase", ADDRESS), ("weight", u64)]
+    FIELDS = [("atx_id", HASH32), ("coinbase", ADDRESS), ("weight", u64)]
 
 
 @codec.register

@@ -103,8 +103,19 @@ class App:
 
     def _wire(self) -> None:
         cfg = self.cfg
-        self.oracle = eligibility.Oracle(self.cache, cfg.layers_per_epoch,
-                                         slots_per_layer=cfg.slots_per_layer)
+        self.oracle = eligibility.Oracle(
+            self.cache, cfg.layers_per_epoch,
+            slots_per_layer=cfg.slots_per_layer,
+            min_weight_table=[tuple(x) for x in cfg.min_active_set_weight])
+        from ..consensus.activeset import ActiveSetGenerator
+
+        self.activeset_gen = ActiveSetGenerator(
+            self.state, self.local, self.cache,
+            layers_per_epoch=cfg.layers_per_epoch,
+            layer_duration=cfg.layer_duration,
+            genesis_time=lambda: self.clock.genesis_time,
+            network_delay=cfg.activeset.network_delay,
+            good_atx_percent=cfg.activeset.good_atx_percent)
         self.vm = VM(self.state, self.verifier)
         self.cstate = ConservativeState(self.state, self.vm)
         self.tortoise = tortoise_mod.Tortoise(
@@ -136,14 +147,15 @@ class App:
             golden_atx=self.golden_atx, post_params=self.post_params,
             labels_per_unit=cfg.post.labels_per_unit,
             scrypt_n=cfg.post.scrypt_n, pubsub=self.pubsub,
-            on_atx=self._on_atx)
+            on_atx=self._on_atx, now=self.time_source)
         from ..consensus import activation_v2
 
         self.atx_handler_v2 = activation_v2.HandlerV2(
             db=self.state, cache=self.cache, verifier=self.verifier,
             golden_atx=self.golden_atx, post_params=self.post_params,
             labels_per_unit=cfg.post.labels_per_unit,
-            scrypt_n=cfg.post.scrypt_n, pubsub=self.pubsub)
+            scrypt_n=cfg.post.scrypt_n, pubsub=self.pubsub,
+            now=self.time_source)
         self.generator = blocks.Generator(
             mesh=self.mesh, proposals=self.proposal_store, cache=self.cache,
             layers_per_epoch=cfg.layers_per_epoch)
@@ -158,7 +170,8 @@ class App:
             signer=s, db=self.state, cache=self.cache,
             oracle=self.oracle, tortoise=self.tortoise, cstate=self.cstate,
             pubsub=self.pubsub, layers_per_epoch=cfg.layers_per_epoch,
-            beacon_getter=self.beacon.get) for s in self.signers]
+            beacon_getter=self.beacon.get,
+            activeset_gen=self.activeset_gen) for s in self.signers]
         self.miner = self.miners[0]
         def post_checker(atx, index_pos: int) -> bool:
             """True when the ATX's POST index at ``index_pos`` fails its
@@ -972,6 +985,9 @@ class App:
             def on_activeset(epoch: int, ids: list[bytes]) -> None:
                 miscstore.add_active_set(self.state, active_set_root(ids),
                                          epoch, ids)
+                # trusted fallback feeds the generator too
+                # (miner/active_set_generator.go:78 updateFallback)
+                self.activeset_gen.update_fallback(epoch, ids)
 
             self.bootstrap = bootstrap_mod.BootstrapUpdater(
                 self.cfg.bootstrap_source,

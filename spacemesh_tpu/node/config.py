@@ -97,6 +97,15 @@ class TortoiseConfig:
 
 
 @dataclasses.dataclass
+class ActiveSetConfig:
+    """Active-set generation knobs (reference miner config: networkDelay,
+    goodAtxPercent; mainnet uses 30 min delay)."""
+
+    network_delay: float = 1800.0
+    good_atx_percent: int = 50
+
+
+@dataclasses.dataclass
 class P2PConfig:
     listen: str = "0.0.0.0:7513"
     bootnodes: list[str] = dataclasses.field(default_factory=list)
@@ -119,6 +128,11 @@ class Config:
     layer_duration: float = 300.0          # mainnet: 5 min layers
     layers_per_epoch: int = 4032           # 2 weeks
     slots_per_layer: int = 50              # proposal slots (epoch total / lpe)
+    min_active_set_weight: list = dataclasses.field(default_factory=list)
+    # ^ [(epoch, weight)] ascending — reference miner/minweight table
+    #   (config/mainnet.go MinimalActiveSetWeight)
+    activeset: ActiveSetConfig = dataclasses.field(
+        default_factory=ActiveSetConfig)
     genesis: GenesisConfig = dataclasses.field(default_factory=GenesisConfig)
     post: PostConfig = dataclasses.field(default_factory=PostConfig)
     smeshing: SmeshingConfig = dataclasses.field(default_factory=SmeshingConfig)
@@ -188,6 +202,7 @@ def _fastnet() -> Config:
     c.tortoise = TortoiseConfig(hdist=4, zdist=2, window_size=100,
                                 delay_layers=4)
     c.poet_cycle_gap = 30.0
+    c.activeset = ActiveSetConfig(network_delay=1.5)
     return c
 
 
@@ -203,6 +218,8 @@ def _standalone() -> Config:
     c.smeshing.start = True
     c.smeshing.num_units = 1
     c.p2p.listen = ""
+    # sub-second layers: the grading window must fit inside one epoch
+    c.activeset = ActiveSetConfig(network_delay=0.05)
     return c
 
 

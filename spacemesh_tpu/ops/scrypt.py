@@ -20,7 +20,7 @@ dense ((8,128) tiling pads a trailing dim of 32 by 4x; a trailing dim of
 B%128==0 pads nothing). Every op is then a (B,)-wide VPU lane op and the
 data-dependent V[j] read is a per-lane gather. V costs N*128 bytes per
 in-flight label (1 MiB at mainnet N=8192), so batch size trades HBM for
-throughput; see models/labeler.py.
+throughput; see post/initializer.py (batch sizing) and bench.py.
 """
 
 from __future__ import annotations

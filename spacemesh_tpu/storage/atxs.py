@@ -170,3 +170,22 @@ def all_rows(db: Database):
 def count_in_epoch(db: Database, epoch: int) -> int:
     return db.one("SELECT COUNT(*) c FROM atxs WHERE publish_epoch=?",
                   (epoch,))["c"]
+
+
+def coinbase_of(db: Database, atx_id: bytes) -> bytes | None:
+    """Reward coinbase for any ATX version (the column is populated for
+    both v1 rows and v2 per-identity rows)."""
+    row = db.one("SELECT coinbase FROM atxs WHERE id=?", (atx_id,))
+    return row["coinbase"] if row else None
+
+
+def rows_for_grading(db: Database, publish_epoch: int):
+    """(id, received, proof_received) for ATXs published in the epoch,
+    joined with any malfeasance-proof receipt time (reference sql/atxs
+    IterateForGrading)."""
+    return db.all(
+        "SELECT a.id id, a.received received,"
+        " (SELECT i.received FROM identities i"
+        "   WHERE i.node_id=a.node_id AND i.proof IS NOT NULL)"
+        " proof_received"
+        " FROM atxs a WHERE a.publish_epoch=?", (publish_epoch,))

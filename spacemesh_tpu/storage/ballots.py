@@ -50,3 +50,16 @@ def refballot(db: Database, node_id: bytes, epoch_start: int, epoch_end: int
         if b.epoch_data is not None:
             return b
     return None
+
+
+def refballot_by_atx(db: Database, atx_id: bytes, epoch_start: int,
+                     epoch_end: int) -> Ballot | None:
+    """First epoch-data ballot built on ``atx_id`` in the epoch (reference
+    sql/ballots FirstInEpoch, keyed by ATX for active-set recovery)."""
+    for r in db.all(
+            "SELECT data FROM ballots WHERE atx_id=? AND layer>=? AND layer<?"
+            " ORDER BY layer", (atx_id, epoch_start, epoch_end)):
+        b = Ballot.from_bytes(r["data"])
+        if b.epoch_data is not None:
+            return b
+    return None

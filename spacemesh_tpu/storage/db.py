@@ -40,10 +40,13 @@ class Database:
         self._conn.execute("PRAGMA foreign_keys=ON")
         self._migrate(migrations)
 
-    def _migrate(self, migrations: list[str]) -> None:
+    def _migrate(self, migrations: list) -> None:
         # NOTE: executescript() implicitly commits any open transaction, so
         # migrations run outside tx(); each script is itself atomic enough
         # (DDL) and user_version advances only after a script completes.
+        # A migration may also be a Python callable(conn) — data rewrites
+        # (blob re-encoding) that SQL can't express (the reference's coded
+        # migrations, sql/migrations.go).
         with self._lock:
             version = self._conn.execute("PRAGMA user_version").fetchone()[0]
             if version > len(migrations):
@@ -51,7 +54,10 @@ class Database:
                     f"{self.name}: database schema version {version} is newer "
                     f"than this build supports ({len(migrations)})")
             for i in range(version, len(migrations)):
-                self._conn.executescript(migrations[i])
+                if callable(migrations[i]):
+                    migrations[i](self._conn)
+                else:
+                    self._conn.executescript(migrations[i])
                 self._conn.execute(f"PRAGMA user_version={i + 1}")
 
     @contextlib.contextmanager
@@ -215,6 +221,55 @@ STATE_MIGRATIONS = [
     ALTER TABLE atxs ADD COLUMN version INT NOT NULL DEFAULT 1;
     """,
 ]
+
+
+def _migrate_0004_reward_atx(conn) -> None:
+    """Reward gained a leading atx_id field (reference AnyReward carries
+    the ATXID; needed for active-set-from-first-block recovery). Re-encode
+    every stored block blob from the 2-field layout; unknown provenance
+    gets the zero ATX id. Block ids are content hashes, so the id column
+    is rewritten too and dependent tables (layers.applied_block,
+    certificates.block_id) follow."""
+    import io
+
+    from ..core import codec as _codec
+    from ..core import types as _types
+
+    legacy_reward = _codec.Codec(
+        enc=None,
+        dec=lambda r: (_types.ADDRESS.dec(r), _types.u64.dec(r)))
+    legacy_block = _codec.Codec(
+        enc=None,
+        dec=lambda r: {
+            "layer": _types.u32.dec(r),
+            "tick_height": _types.u64.dec(r),
+            "rewards": _codec.vec(legacy_reward, 1 << 12).dec(r),
+            "tx_ids": _codec.vec(_types.HASH32, 1 << 16).dec(r),
+        })
+    rows = conn.execute("SELECT id, data FROM blocks").fetchall()
+    for row in rows:
+        old_id, data = row[0], row[1]
+        try:
+            reader = io.BytesIO(data)
+            raw = legacy_block.dec(reader)
+            if reader.read(1):
+                continue  # trailing bytes: not the legacy layout
+        except Exception:
+            continue  # already new-format (fresh db mid-transition)
+        block = _types.Block(
+            layer=raw["layer"], tick_height=raw["tick_height"],
+            rewards=[_types.Reward(atx_id=bytes(32), coinbase=cb, weight=w)
+                     for cb, w in raw["rewards"]],
+            tx_ids=raw["tx_ids"])
+        conn.execute("UPDATE blocks SET id=?, data=? WHERE id=?",
+                     (block.id, block.to_bytes(), old_id))
+        conn.execute("UPDATE layers SET applied_block=?"
+                     " WHERE applied_block=?", (block.id, old_id))
+        conn.execute("UPDATE certificates SET block_id=? WHERE block_id=?",
+                     (block.id, old_id))
+
+
+STATE_MIGRATIONS.append(_migrate_0004_reward_atx)
 
 # --- local database (node-private progress) -------------------------------
 

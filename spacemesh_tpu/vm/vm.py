@@ -223,13 +223,21 @@ class VM:
 
             total_weight = sum(r.weight for r in rewards) or 1
             pot = BASE_REWARD + fees
+            # rewards are keyed per ATX on the wire (AnyReward); a multi-
+            # identity smesher repeats one coinbase, and the ledger row is
+            # per (coinbase, layer) — aggregate BEFORE writing or the
+            # upsert clobbers earlier shares
+            per_coinbase: dict[bytes, tuple[int, int]] = {}
             for r in rewards:
                 share = pot * r.weight // total_weight
+                base = BASE_REWARD * r.weight // total_weight
                 acct = staged.touch(bytes(r.coinbase))
                 acct.balance += share
-                from ..storage.misc import add_reward
-                add_reward(self.db, bytes(r.coinbase), layer, share,
-                           BASE_REWARD * r.weight // total_weight)
+                tot, lay = per_coinbase.get(bytes(r.coinbase), (0, 0))
+                per_coinbase[bytes(r.coinbase)] = (tot + share, lay + base)
+            from ..storage.misc import add_reward
+            for coinbase, (share, base) in per_coinbase.items():
+                add_reward(self.db, coinbase, layer, share, base)
 
             state_root = self._persist(staged, layer)
             return results, state_root

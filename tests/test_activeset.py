@@ -1,0 +1,180 @@
+"""Active-set generation + min-weight gating (VERDICT r3 item 8).
+
+Reference: miner/active_set_generator.go (grading + three-path
+generation), miner/minweight/minweight.go (epoch table),
+proposals/util/util.go:29-39 (slot formula with the min-weight
+denominator)."""
+
+import pytest
+
+from spacemesh_tpu.consensus import activeset
+from spacemesh_tpu.consensus.activeset import (
+    GRADE_ACCEPTABLE,
+    GRADE_EVIL,
+    GRADE_GOOD,
+    ActiveSetGenerator,
+    active_set_hash,
+    grade_atx,
+    num_eligible_slots,
+    select_min_weight,
+)
+from spacemesh_tpu.core import types
+from spacemesh_tpu.storage import atxs as atxstore
+from spacemesh_tpu.storage import db as dbmod
+from spacemesh_tpu.storage import misc as miscstore
+from spacemesh_tpu.storage.cache import AtxCache, AtxInfo
+
+LPE = 4
+LAYER_DUR = 10.0
+DELAY = 5.0
+
+
+def _atx(i, epoch=0, units=2):
+    node = b"N%07d" % i + bytes(24)
+    return types.ActivationTx(
+        publish_epoch=epoch, prev_atx=bytes(32), pos_atx=bytes(32),
+        commitment_atx=None, initial_post=None,
+        nipost=types.NIPost(
+            membership=types.MerkleProof(leaf_index=0, nodes=[]),
+            post=types.Post(nonce=0, indices=[1], pow_nonce=0),
+            post_metadata=types.PostMetadataWire(challenge=bytes(32),
+                                                 labels_per_unit=64)),
+        num_units=units, vrf_nonce=7, vrf_public_key=bytes(32),
+        coinbase=bytes(24), node_id=node,
+        signature=bytes(64))
+
+
+def test_select_min_weight_table():
+    table = [(0, 100), (4, 1000), (8, 5000)]
+    assert select_min_weight(0, table) == 100
+    assert select_min_weight(3, table) == 100
+    assert select_min_weight(4, table) == 1000
+    assert select_min_weight(9, table) == 5000
+    assert select_min_weight(5, []) == 0
+    with pytest.raises(ValueError):
+        select_min_weight(1, [(4, 10), (0, 5)])
+
+
+def test_num_eligible_slots_minweight_gates_dust():
+    # young network: total weight 10, committee 50/layer, 4 layers/epoch.
+    # ungated, a weight-1 identity harvests 20 slots...
+    assert num_eligible_slots(1, 0, 10, 50, 4) == 20
+    # ...the mainnet-scale min-weight floor collapses that to the
+    # reference's single-slot floor (proposals/util/util.go:36-38)
+    assert num_eligible_slots(1, 10_000, 10, 50, 4) == 1
+    # and a real miner is proportional against the floor, not the dust net
+    assert num_eligible_slots(5_000, 10_000, 10, 50, 4) == 100
+    assert num_eligible_slots(5_000, 0, 0, 50, 4) == 0
+
+
+def test_grade_atx_boundaries():
+    s = 1000.0
+    # good: atx < s-4d, no proof before s
+    assert grade_atx(s, DELAY, 979.9, None) == GRADE_GOOD
+    assert grade_atx(s, DELAY, 979.9, 1000.0) == GRADE_GOOD
+    # proof strictly before s demotes: acceptable if proof >= s-d
+    assert grade_atx(s, DELAY, 979.9, 996.0) == GRADE_ACCEPTABLE
+    # proof before s-d: evil
+    assert grade_atx(s, DELAY, 979.9, 990.0) == GRADE_EVIL
+    # received in (s-4d, s-3d): at best acceptable
+    assert grade_atx(s, DELAY, 982.0, None) == GRADE_ACCEPTABLE
+    assert grade_atx(s, DELAY, 982.0, 990.0) == GRADE_EVIL
+    # received after s-3d: evil
+    assert grade_atx(s, DELAY, 986.0, None) == GRADE_EVIL
+
+
+def _setup(n_good=3, n_late=1, target=1):
+    state = dbmod.open_state()
+    local = dbmod.open_local()
+    cache = AtxCache()
+    epoch_start = target * LPE * LAYER_DUR  # genesis_time = 0
+    ids = []
+    for i in range(n_good + n_late):
+        atx = _atx(i, epoch=target - 1)
+        received = epoch_start - 4 * DELAY - 1 if i < n_good \
+            else epoch_start - 1
+        atxstore.add(state, atx, received=int(received))
+        cache.add(target, atx.id, AtxInfo(
+            node_id=atx.node_id, weight=10, base_height=0, height=1,
+            num_units=atx.num_units, vrf_nonce=0,
+            vrf_public_key=atx.node_id))
+        ids.append(atx.id)
+    gen = ActiveSetGenerator(
+        state, local, cache, layers_per_epoch=LPE, layer_duration=LAYER_DUR,
+        genesis_time=0.0, network_delay=DELAY, good_atx_percent=50)
+    return state, local, cache, gen, ids
+
+
+def test_generate_from_grades_and_persistence():
+    state, local, cache, gen, ids = _setup(n_good=3, n_late=1)
+    set_id, weight, got = gen.generate(current_layer=3, target_epoch=1)
+    assert sorted(got) == sorted(ids[:3])   # late ATX graded out
+    assert weight == 30
+    assert set_id == active_set_hash(got)
+    # persisted: a fresh generator over the same local db returns it
+    # without touching grading again
+    gen2 = ActiveSetGenerator(
+        state, local, AtxCache(), layers_per_epoch=LPE,
+        layer_duration=LAYER_DUR, genesis_time=0.0, network_delay=DELAY)
+    assert gen2.generate(3, 1) == (set_id, weight, got)
+
+
+def test_generate_gate_fails_when_too_few_good():
+    # 1 good / 4 total = 25% < 50% gate, and no block yet -> LookupError
+    state, local, cache, gen, ids = _setup(n_good=1, n_late=3)
+    with pytest.raises(LookupError):
+        gen.generate(current_layer=3, target_epoch=1)
+
+
+def test_fallback_wins_over_grading():
+    state, local, cache, gen, ids = _setup(n_good=3, n_late=1)
+    gen.update_fallback(1, [ids[0], ids[3]])
+    set_id, weight, got = gen.generate(3, 1)
+    assert sorted(got) == sorted([ids[0], ids[3]])
+    assert weight == 20
+    # first update wins (generator.go:86-90)
+    gen.update_fallback(1, [ids[1]])
+    assert gen._fallback[1] == [ids[0], ids[3]]
+
+
+def test_malfeasance_proof_receipt_grades_out():
+    state, local, cache, gen, ids = _setup(n_good=3, n_late=0)
+    # condemn the second identity well before epoch start
+    view = atxstore.view(state, ids[1])
+    from spacemesh_tpu.core.types import MalfeasanceProof
+    miscstore.set_malicious(
+        state, view.node_id,
+        MalfeasanceProof(domain=1, msg1=b"a", sig1=bytes(64), msg2=b"b",
+                         sig2=bytes(64), node_id=view.node_id), received=1)
+    set_id, weight, got = gen.generate(3, 1)  # 2/3 good clears the gate
+    assert sorted(got) == sorted([ids[0], ids[2]])
+
+
+def test_from_first_block_path():
+    from spacemesh_tpu.storage import ballots as ballotstore
+    from spacemesh_tpu.storage import blocks as blockstore
+    from spacemesh_tpu.storage import layers as layerstore
+
+    state, local, cache, gen, ids = _setup(n_good=1, n_late=3)  # gate fails
+    # a ref ballot built on ids[0] declaring a stored active set
+    stored = sorted(ids[:3])
+    root = active_set_hash(stored)
+    miscstore.add_active_set(state, root, 1, stored)
+    ballot = types.Ballot(
+        layer=LPE, atx_id=ids[0],
+        epoch_data=types.EpochData(beacon=b"\x01" * 4, active_set_root=root,
+                                   eligibility_count=1),
+        ref_ballot=types.EMPTY32, eligibilities=[],
+        opinion=types.Opinion(base=types.EMPTY32, support=[], against=[],
+                              abstain=[]),
+        node_id=b"N%07d" % 0 + bytes(24), signature=bytes(64))
+    ballotstore.add(state, ballot)
+    block = types.Block(
+        layer=LPE, tick_height=1,
+        rewards=[types.Reward(atx_id=ids[0], coinbase=bytes(24), weight=1)],
+        tx_ids=[])
+    blockstore.add(state, block)
+    layerstore.set_applied(state, LPE, block.id, bytes(32))
+    set_id, weight, got = gen.generate(current_layer=LPE + 1, target_epoch=1)
+    assert sorted(got) == stored
+    assert weight == 30

@@ -1,0 +1,146 @@
+"""Binomial committee sampling (VERDICT r3 item 2).
+
+The seat count must be a true inverse-CDF binomial sample over the
+identity's weight (reference hare3/eligibility/oracle.go:324-375), not an
+expectation + one fractional draw: same mean, but the full binomial
+variance the committee-size analysis depends on.
+"""
+
+import math
+from fractions import Fraction
+
+from spacemesh_tpu.consensus.eligibility import Oracle, hare_alpha
+from spacemesh_tpu.core import fixedpoint
+from spacemesh_tpu.core.signing import EdSigner
+from spacemesh_tpu.storage.cache import AtxCache, AtxInfo
+
+GEN = b"binom-test-genesis!!"
+ONE = fixedpoint.ONE
+
+
+def exact_cdf(n, p, x):
+    """Exact rational Binomial(n, p) CDF for cross-checking."""
+    p = Fraction(p)
+    return sum(math.comb(n, k) * p**k * (1 - p) ** (n - k)
+               for k in range(x + 1))
+
+
+def test_bin_cdf_matches_exact_rational():
+    for n, num, den in [(10, 1, 4), (40, 3, 10), (100, 1, 100), (7, 6, 7)]:
+        for x in range(n + 1):
+            got = fixedpoint.bin_cdf(n, num, den, x) / ONE
+            want = float(exact_cdf(n, Fraction(num, den), x))
+            assert abs(got - want) < 1e-12, (n, num, den, x)
+        # truncating fixed-point multiplies only ever lose mass, so the
+        # CDF lands just under ONE; 2**68 ulps at 128 frac bits = 1e-18
+        assert fixedpoint.bin_cdf(n, num, den, n) >= ONE - (1 << 68)
+
+
+def test_count_is_inverse_cdf():
+    n, num, den = 50, 2, 10
+    cdf = [fixedpoint.bin_cdf(n, num, den, x) for x in range(n + 1)]
+    for frac in [0, ONE // 7, ONE // 3, ONE // 2, 2 * ONE // 3,
+                 9 * ONE // 10, ONE - 1]:
+        want = next((x for x in range(n + 1) if cdf[x] > frac), n)
+        assert fixedpoint.binomial_count(n, num, den, frac) == want
+
+
+def test_empirical_distribution_binomial():
+    """Counts over many uniform draws match Binomial(n, p): mean AND
+    variance (the old expectation+fraction scheme had variance < p(1-p),
+    never the binomial's npq)."""
+    n, num, den = 64, 1, 8  # E = 8, Var = 7
+    draws = 4000
+    counts = []
+    for i in range(draws):
+        frac = (i * 2 + 1) * ONE // (2 * draws)  # uniform grid on [0,1)
+        counts.append(fixedpoint.binomial_count(n, num, den, frac))
+    mean = sum(counts) / draws
+    var = sum((c - mean) ** 2 for c in counts) / draws
+    e, v = n * num / den, n * (num / den) * (1 - num / den)
+    assert abs(mean - e) < 0.2, mean
+    assert abs(var - v) / v < 0.1, var
+
+
+def test_degenerate_and_saturation_cases():
+    assert fixedpoint.binomial_count(0, 1, 2, 0) == 0
+    assert fixedpoint.binomial_count(10, 0, 2, 0) == 0
+    # p >= 1: every trial succeeds
+    assert fixedpoint.binomial_count(10, 5, 5, ONE // 2) == 10
+    # underflow saturation: (1-p)^n below 128-bit resolution -> round(np)
+    assert fixedpoint.binomial_count(400, 1, 2, ONE // 2) == 200
+    # ... and still capped at uint16
+    assert fixedpoint.binomial_count(10**6, 1, 2, ONE // 2) \
+        == fixedpoint.COUNT_CAP
+    # count cap: uint16 parity with the reference
+    assert fixedpoint.binomial_count(10**9, 999, 1000, ONE - 1) \
+        == fixedpoint.COUNT_CAP
+
+
+def _oracle(weights, committee=40, epoch=1):
+    cache = AtxCache()
+    signers, atx_ids = [], []
+    for i, w in enumerate(weights):
+        s = EdSigner(prefix=GEN)
+        atx_id = b"BATX%04d" % i + bytes(24)
+        cache.add(epoch, atx_id, AtxInfo(
+            node_id=s.node_id, weight=w, base_height=0, height=1,
+            num_units=1, vrf_nonce=0, vrf_public_key=s.node_id))
+        signers.append(s)
+        atx_ids.append(atx_id)
+    return Oracle(cache, 4), signers, atx_ids
+
+
+def test_prover_validator_agree_and_forged_count_rejected():
+    beacon = b"\x01\x02\x03\x04"
+    oracle, signers, atx_ids = _oracle([100, 300, 50], committee=40)
+    layer, epoch = 5, 1
+    seen_any = False
+    for rnd in range(6):
+        for s, atx in zip(signers, atx_ids):
+            el = oracle.hare_eligibility(
+                s.vrf_signer(), beacon, layer, rnd, epoch, atx, 40)
+            if el is None:
+                continue
+            proof, count = el
+            seen_any = True
+            assert oracle.validate_hare(
+                beacon, layer, rnd, epoch, atx, 40, proof, count)
+            # forged counts (the attack the count derivation prevents)
+            assert not oracle.validate_hare(
+                beacon, layer, rnd, epoch, atx, 40, proof, count + 1)
+            assert not oracle.validate_hare(
+                beacon, layer, rnd, epoch, atx, 40, proof, 0)
+    assert seen_any
+
+
+def test_committee_scale_when_committee_exceeds_total():
+    """committee > total_weight triggers the reference's rescale
+    (oracle.go:275-281): p = 1/W per weight-unit-trial, n = w*C."""
+    oracle, signers, atx_ids = _oracle([2, 3], committee=40)
+    n, p_num, p_den = oracle._binomial_params(1, atx_ids[0], 40)
+    assert (n, p_num, p_den) == (2 * 40, 40, 5 * 40)
+
+
+def test_empirical_committee_size_over_rounds():
+    """Across many (layer, round) draws the realized committee size is
+    centered on the target with binomial spread."""
+    beacon = b"\x09\x09\x09\x09"
+    committee = 20
+    oracle, signers, atx_ids = _oracle([10] * 12, committee=committee)
+    sizes = []
+    for layer in range(30):
+        for rnd in range(4):
+            tot = 0
+            for s, atx in zip(signers, atx_ids):
+                el = oracle.hare_eligibility(
+                    s.vrf_signer(), beacon, layer, rnd, 1, atx, committee)
+                if el:
+                    tot += el[1]
+            sizes.append(tot)
+    mean = sum(sizes) / len(sizes)
+    assert abs(mean - committee) < 2.0, mean
+    # variance must exist (old scheme: whole-part deterministic, var ~ p(1-p)
+    # per identity only); binomial committee var = C*(1 - C/W) ~ 16.7 here
+    var = sum((x - mean) ** 2 for x in sizes) / len(sizes)
+    assert var > 5.0, var

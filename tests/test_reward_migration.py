@@ -1,0 +1,55 @@
+"""State-DB migration 0004: Reward gained atx_id — old block blobs must
+re-encode on open, with block ids (content hashes) and the tables that
+point at them following."""
+
+import io
+
+from spacemesh_tpu.core import codec, types
+from spacemesh_tpu.storage import blocks as blockstore
+from spacemesh_tpu.storage import db as dbmod
+from spacemesh_tpu.storage import layers as layerstore
+
+
+def _legacy_block_bytes(layer, tick, rewards, tx_ids):
+    w = io.BytesIO()
+    types.u32.enc(w, layer)
+    types.u64.enc(w, tick)
+    codec.vec(codec.Codec(
+        enc=lambda w_, v: (types.ADDRESS.enc(w_, v[0]),
+                           types.u64.enc(w_, v[1])),
+        dec=None), 1 << 12).enc(w, rewards)
+    codec.vec(types.HASH32, 1 << 16).enc(w, tx_ids)
+    return w.getvalue()
+
+
+def test_migration_reencodes_legacy_blocks(tmp_path):
+    path = tmp_path / "state.db"
+    # build a pre-0004 database: schema at version 3, legacy block blob
+    old = dbmod.Database(path, dbmod.STATE_MIGRATIONS[:3], name="state")
+    coinbase = b"\x07" * 24
+    data = _legacy_block_bytes(5, 9, [(coinbase, 3)], [b"\x21" * 32])
+    from spacemesh_tpu.core.hashing import sum256
+    old_id = sum256(data)
+    old.exec("INSERT INTO blocks (id, layer, data) VALUES (?,?,?)",
+             (old_id, 5, data))
+    old.exec("INSERT INTO layers (id, applied_block) VALUES (?,?)",
+             (5, old_id))
+    old.exec("INSERT INTO certificates (layer, block_id) VALUES (?,?)",
+             (5, old_id))
+    old.close()
+
+    state = dbmod.open_state(path)  # runs 0004
+    blocks = blockstore.in_layer(state, 5)
+    assert len(blocks) == 1
+    b = blocks[0]
+    assert b.tick_height == 9
+    assert b.rewards == [types.Reward(atx_id=bytes(32), coinbase=coinbase,
+                                      weight=3)]
+    assert b.id != old_id
+    assert layerstore.applied_block(state, 5) == b.id
+    assert state.one("SELECT block_id FROM certificates WHERE layer=5")[
+        "block_id"] == b.id
+    # idempotent: reopening does not re-run (user_version advanced)
+    state.close()
+    state2 = dbmod.open_state(path)
+    assert len(blockstore.in_layer(state2, 5)) == 1

@@ -85,8 +85,8 @@ def test_overspend(env):
 
 def test_rewards_distribution(env):
     state, vm, alice, bob, a_addr, b_addr = env
-    rewards = [types.Reward(coinbase=a_addr.raw, weight=3),
-               types.Reward(coinbase=b_addr.raw, weight=1)]
+    rewards = [types.Reward(atx_id=bytes(32), coinbase=a_addr.raw, weight=3),
+               types.Reward(atx_id=bytes(32), coinbase=b_addr.raw, weight=1)]
     vm.apply(1, bytes(32), [], rewards)
     a = txstore.account(state, a_addr.raw)
     b = txstore.account(state, b_addr.raw)
@@ -159,7 +159,7 @@ def test_determinism_across_instances():
         _, root1 = vm.apply(1, bytes(32), [sdk.spawn_wallet(alice)], [])
         _, root2 = vm.apply(2, bytes(32),
                             [sdk.spend(a, [alice], b, 42, nonce=1)],
-                            [types.Reward(coinbase=b.raw, weight=1)])
+                            [types.Reward(atx_id=bytes(32), coinbase=b.raw, weight=1)])
         return root1, root2
     assert run() == run()
 

@@ -1,0 +1,28 @@
+#!/bin/bash
+# Persistent TPU-tunnel watchdog (VERDICT r3 "Next round" item 1).
+# Probes jax.devices() under the axon platform on a timer for the whole
+# round, logs EVERY attempt, and on first success runs tpu_validate.py
+# (bit-exactness + ROMix race + throughput) exactly once per heal.
+cd /root/repo
+LOG=tpu_watchdog.log
+MARK=tpu_results/VALIDATE_OK
+mkdir -p tpu_results
+echo "$(date -Is) watchdog start (pid $$)" >> "$LOG"
+while true; do
+  if timeout 120 python -c "import jax; d=jax.devices()[0]; print(d.platform, getattr(d,'device_kind','?'))" >> "$LOG" 2>&1; then
+    echo "$(date -Is) probe OK" >> "$LOG"
+    if [ ! -f "$MARK" ]; then
+      echo "$(date -Is) running tpu_validate.py" >> "$LOG"
+      if timeout 3000 python tpu_validate.py >> "$LOG" 2>&1; then
+        touch "$MARK"
+        echo "$(date -Is) VALIDATE OK" >> "$LOG"
+      else
+        echo "$(date -Is) validate failed/partial (see tpu_results/)" >> "$LOG"
+      fi
+    fi
+    sleep 1200
+  else
+    echo "$(date -Is) probe timeout/fail" >> "$LOG"
+    sleep 420
+  fi
+done
