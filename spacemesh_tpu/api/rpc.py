@@ -289,12 +289,15 @@ class GrpcApiServer:
     # -- lifecycle --
 
     async def start(self) -> int:
+        from .rpc_v2 import V2AlphaServices
+
         self.server = grpc.aio.server()
         self.server.add_generic_rpc_handlers((
             self.post_service.handler(),
             self._node_handler(), self._mesh_handler(),
             self._globalstate_handler(), self._transaction_handler(),
-            self._smesher_handler(), self._admin_handler()))
+            self._smesher_handler(), self._admin_handler(),
+            *V2AlphaServices(self.node).handlers()))
         self.actual_port = self.server.add_insecure_port(self.listen)
         await self.server.start()
         return self.actual_port

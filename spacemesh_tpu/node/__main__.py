@@ -25,6 +25,9 @@ def main(argv=None) -> int:
                    help="set genesis time to now + one layer")
     p.add_argument("--api", action="store_true",
                    help="serve the JSON API on api.private_listener")
+    p.add_argument("--grpc", action="store_true",
+                   help="serve the gRPC API (spacemesh.v1 + v2alpha1) on "
+                        "api.public_listener")
     p.add_argument("--listen", help="p2p listen addr (host:port; enables "
                    "the TCP transport)")
     p.add_argument("--bootnode", action="append", default=[],
@@ -67,6 +70,11 @@ def main(argv=None) -> int:
                 port = await app.start_api()
                 api_started = True
                 print(json.dumps({"event": "ApiStarted", "port": port}),
+                      flush=True)
+            if a.grpc:
+                port = await app.start_grpc_api(
+                    listen=cfg.api.public_listener)
+                print(json.dumps({"event": "GrpcStarted", "port": port}),
                       flush=True)
             if a.listen or cfg.p2p.bootnodes:
                 addr = await app.start_network()

@@ -1010,16 +1010,18 @@ class App:
         self.api = ApiServer(self, listen=self.cfg.api.private_listener)
         return await self.api.start()
 
-    async def start_grpc_api(self) -> int:
-        """Start the gRPC listener: spacemesh.v1 services incl. the
-        PostService Register seam (reference api/grpcserver/grpc.go; the
-        reference splits listeners by audience, config.go:31-57 — here one
-        listener serves all, the split is config policy not protocol)."""
+    async def start_grpc_api(self, listen: str | None = None) -> int:
+        """Start the gRPC listener: spacemesh.v1 + v2alpha1 services incl.
+        the PostService Register seam (reference api/grpcserver/grpc.go;
+        the reference splits listeners by audience, config.go:31-57 — here
+        one listener serves all, the split is config policy not protocol).
+        Default bind is the loopback post_listener (the worker seam);
+        pass ``listen`` (e.g. cfg.api.public_listener) to serve widely."""
         from ..api.rpc import GrpcApiServer
 
         if getattr(self, "grpc_api", None) is None:
             self.grpc_api = GrpcApiServer(
-                self, listen=self.cfg.api.post_listener,
+                self, listen=listen or self.cfg.api.post_listener,
                 post_query_interval=max(self.cfg.layer_duration / 20, 0.1))
             self.grpc_port = await self.grpc_api.start()
         return self.grpc_port
