@@ -279,9 +279,15 @@ class GrpcApiServer:
     public/private split is a config matter, not a protocol one)."""
 
     def __init__(self, app, listen: str = "127.0.0.1:0",
-                 post_query_interval: float = 2.0):
+                 post_query_interval: float = 2.0,
+                 public_only: bool = False):
         self.node = app
         self.listen = listen
+        # public_only serves just the query surface — no Admin (Recover
+        # wipes state), no Smesher, no PostService Register seam. The
+        # reference splits listeners by audience for exactly this reason
+        # (api/grpcserver/config.go:31-57: public vs private vs post).
+        self.public_only = public_only
         self.post_service = PostGrpcService(query_interval=post_query_interval)
         self.server: grpc.aio.Server | None = None
         self.actual_port: int | None = None
@@ -292,12 +298,15 @@ class GrpcApiServer:
         from .rpc_v2 import V2AlphaServices
 
         self.server = grpc.aio.server()
-        self.server.add_generic_rpc_handlers((
-            self.post_service.handler(),
+        handlers = (
             self._node_handler(), self._mesh_handler(),
             self._globalstate_handler(), self._transaction_handler(),
-            self._smesher_handler(), self._admin_handler(),
-            *V2AlphaServices(self.node).handlers()))
+            *V2AlphaServices(self.node).handlers())
+        if not self.public_only:
+            handlers = (self.post_service.handler(),
+                        self._smesher_handler(), self._admin_handler(),
+                        *handlers)
+        self.server.add_generic_rpc_handlers(handlers)
         self.actual_port = self.server.add_insecure_port(self.listen)
         await self.server.start()
         return self.actual_port

@@ -12,8 +12,6 @@ surface (api/rpc.py GrpcApiServer)."""
 
 from __future__ import annotations
 
-import asyncio
-
 import grpc
 
 from ..core.types import Address
@@ -192,9 +190,11 @@ class V2AlphaServices:
                                     "event buffer overflow")
                 if req.smesher_id and ev.node_id != req.smesher_id:
                     continue
-                if req.HasField("epoch") and ev.epoch != req.epoch + 1:
+                # AtxEvent.epoch is the PUBLISH epoch (app._on_atx), the
+                # same axis the stored drain filters on
+                if req.HasField("epoch") and ev.epoch != req.epoch:
                     continue
-                if ev.epoch < req.start_epoch or ev.atx_id in seen:
+                if ev.epoch + 1 < req.start_epoch or ev.atx_id in seen:
                     continue
                 seen.add(ev.atx_id)
                 row = self.node.state.one(
@@ -417,7 +417,6 @@ class V2AlphaServices:
     # --- transactions --------------------------------------------------
 
     def _tx_msg(self, row) -> v2.TransactionV2:
-        from ..core import codec
         from ..core.types import TransactionResult
 
         res = row["result"]

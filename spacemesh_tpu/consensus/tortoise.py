@@ -212,7 +212,10 @@ class Tortoise:
             if info is None or row is None:
                 continue
             if info.layer > layer and layer not in info.abstains:
-                info.supports.setdefault(layer, set()).add(block_id)
+                # clone-on-write: the layer set may be shared with the
+                # base chain (see _ingest_one)
+                info.supports[layer] = \
+                    set(info.supports.get(layer, ())) | {block_id}
                 self._V[row, col] = 1
 
     def on_hare_output(self, layer: int, block_id: bytes) -> None:
@@ -300,8 +303,21 @@ class Tortoise:
         supports: dict[int, set[bytes]] = {}
         abstains: set[int] = set()
         if base is not None:
-            supports = {lyr: set(s) for lyr, s in base.supports.items()}
+            # copy-on-write: the dict is shallow-copied, the per-layer
+            # SETS are shared with the base chain until first mutation
+            # (_own below / on_block pending resolution). A deep copy
+            # here is O(window) per ballot — at mainnet shape (50
+            # ballots/layer, 1000-layer window) that alone dominated the
+            # whole tally (docs/TORTOISE_STRESS.md).
+            supports = dict(base.supports)
             abstains = set(base.abstains)
+        owned: set[int] = set()
+
+        def _own(lyr: int) -> set:
+            if lyr not in owned:
+                supports[lyr] = set(supports.get(lyr, ()))
+                owned.add(lyr)
+            return supports[lyr]
         pend: list[bytes] = []
         against = set(opinion.against)
         # pending votes INHERIT through the base chain: if the base ballot
@@ -316,7 +332,7 @@ class Tortoise:
             col = self._col_of.get(b)
             if col is not None:
                 lyr = int(self._col_layer[col])
-                supports.setdefault(lyr, set()).add(b)
+                _own(lyr).add(b)
                 abstains.discard(lyr)
             else:
                 pend.append(b)
@@ -325,7 +341,7 @@ class Tortoise:
             if col is not None:
                 lyr = int(self._col_layer[col])
                 if lyr in supports:
-                    supports[lyr].discard(b)
+                    _own(lyr).discard(b)
         for lyr in opinion.abstain:
             abstains.add(lyr)
             supports.pop(lyr, None)
@@ -536,6 +552,15 @@ class Tortoise:
         stale_blocks = [x for x in self._blocks if x < low]
         if not stale_layers and not stale_blocks:
             return
+        # hysteresis: compaction rebuilds the whole matrix (O(rows*cols));
+        # once the frontier advances one layer per tally, evicting eagerly
+        # would pay that rebuild EVERY tally. Let a chunk of stale layers
+        # accumulate so the cost amortizes to O(rebuild / chunk) per layer
+        # (the steady-state tally regression docs/TORTOISE_STRESS.md
+        # caught: 2.3ms -> 280ms/layer at mainnet shape without this).
+        chunk = max(self.window // 10, 16)
+        if (len(stale_layers) < chunk and len(stale_blocks) < chunk):
+            return
         for lyr in stale_layers:
             for bid in self._ballots_by_layer[lyr]:
                 self._ballots.pop(bid, None)
@@ -583,6 +608,15 @@ class Tortoise:
             del self._abstain[lyr]
         for lyr in [x for x in self._coin if x < low]:
             del self._coin[lyr]
+        # hare opinions and per-block validity below the window can never
+        # be consulted again (margins/encode_votes only span the window;
+        # the mesh persists validity to storage) — without eviction these
+        # grow without bound over a node's lifetime
+        for lyr in [x for x in self._hare if x < low]:
+            del self._hare[lyr]
+        live_cols = set(self._col_of)
+        self._validity = {b: v for b, v in self._validity.items()
+                          if b in live_cols}
         # pending votes whose waiters were all evicted can never resolve
         self._pending = {blk: live for blk, ws in self._pending.items()
                          if (live := {b for b in ws if b in self._ballots})}

@@ -72,8 +72,7 @@ def main(argv=None) -> int:
                 print(json.dumps({"event": "ApiStarted", "port": port}),
                       flush=True)
             if a.grpc:
-                port = await app.start_grpc_api(
-                    listen=cfg.api.public_listener)
+                port = await app.start_public_grpc_api()
                 print(json.dumps({"event": "GrpcStarted", "port": port}),
                       flush=True)
             if a.listen or cfg.p2p.bootnodes:

@@ -1010,26 +1010,41 @@ class App:
         self.api = ApiServer(self, listen=self.cfg.api.private_listener)
         return await self.api.start()
 
-    async def start_grpc_api(self, listen: str | None = None) -> int:
-        """Start the gRPC listener: spacemesh.v1 + v2alpha1 services incl.
-        the PostService Register seam (reference api/grpcserver/grpc.go;
-        the reference splits listeners by audience, config.go:31-57 — here
-        one listener serves all, the split is config policy not protocol).
-        Default bind is the loopback post_listener (the worker seam);
-        pass ``listen`` (e.g. cfg.api.public_listener) to serve widely."""
+    async def start_grpc_api(self) -> int:
+        """Start the PRIVATE gRPC listener (loopback post_listener): the
+        full spacemesh.v1 surface incl. the PostService Register seam,
+        Admin, and Smesher (reference api/grpcserver/grpc.go private +
+        post listeners, config.go:31-57)."""
         from ..api.rpc import GrpcApiServer
 
         if getattr(self, "grpc_api", None) is None:
             self.grpc_api = GrpcApiServer(
-                self, listen=listen or self.cfg.api.post_listener,
+                self, listen=self.cfg.api.post_listener,
                 post_query_interval=max(self.cfg.layer_duration / 20, 0.1))
             self.grpc_port = await self.grpc_api.start()
         return self.grpc_port
+
+    async def start_public_grpc_api(self, listen: str | None = None) -> int:
+        """Start the PUBLIC gRPC listener: query surface only —
+        Node/Mesh/GlobalState/Transaction + all v2alpha1 services. No
+        Admin (Recover wipes state), no Smesher, no PostService seam
+        (reference public-services set, api/grpcserver/config.go:31-40)."""
+        from ..api.rpc import GrpcApiServer
+
+        if getattr(self, "grpc_public_api", None) is None:
+            self.grpc_public_api = GrpcApiServer(
+                self, listen=listen or self.cfg.api.public_listener,
+                public_only=True)
+            self.grpc_public_port = await self.grpc_public_api.start()
+        return self.grpc_public_port
 
     async def stop_grpc_api(self) -> None:
         if getattr(self, "grpc_api", None) is not None:
             await self.grpc_api.stop()
             self.grpc_api = None
+        if getattr(self, "grpc_public_api", None) is not None:
+            await self.grpc_public_api.stop()
+            self.grpc_public_api = None
 
     async def run(self, until_layer: int | None = None) -> None:
         """The main layer loop (callers wanting the API call start_api()

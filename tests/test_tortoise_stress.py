@@ -1,0 +1,171 @@
+"""Mainnet-shape tortoise stress (VERDICT r3 item 4).
+
+Reference yardstick: tortoise/tortoise_test.go BenchmarkTallyVotes
+(the reference keeps ~12k LoC of graph state; this design must hold the
+same shape in a dense matrix without quadratic tally time or unbounded
+RSS). Shape here: ~50 ballots/layer, 3 blocks/layer, 10k ATXs/epoch,
+1000 layers with a 600-layer window so eviction cycles several times.
+
+Quick mode (default, CI): 300 layers. Full mainnet shape:
+SPACEMESH_STRESS_FULL=1 — numbers recorded in docs/TORTOISE_STRESS.md.
+"""
+
+import os
+import resource
+import time
+
+import numpy as np
+
+from spacemesh_tpu.consensus.tortoise import Tortoise
+from spacemesh_tpu.core.types import Opinion
+from spacemesh_tpu.storage.cache import AtxCache, AtxInfo
+
+FULL = os.environ.get("SPACEMESH_STRESS_FULL") == "1"
+LAYERS = 1000 if FULL else 300
+WINDOW = 600 if FULL else 150
+BALLOTS = 50
+BLOCKS = 3
+LPE = 100
+ATXS_PER_EPOCH = 10_000 if FULL else 2_000
+
+
+def _rss_mb() -> float:
+    return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024
+
+
+def _bid(layer, i):
+    return b"B%07d%03d" % (layer, i) + bytes(18)
+
+
+def _ballot_id(layer, i):
+    return b"L%07d%03d" % (layer, i) + bytes(18)
+
+
+def _run(layers=LAYERS, window=WINDOW, on_layer=None):
+    cache = AtxCache()
+    for epoch in range((layers // LPE) + 2):
+        for i in range(ATXS_PER_EPOCH):
+            nid = b"N%05d" % i + bytes(26)
+            cache.add(epoch, b"A%05d%04d" % (i, epoch) + bytes(22),
+                      AtxInfo(node_id=nid, weight=100, base_height=0,
+                              height=1, num_units=1, vrf_nonce=0,
+                              vrf_public_key=nid))
+    t = Tortoise(cache, LPE, hdist=10, zdist=8, window=window)
+    rng = np.random.default_rng(7)
+    prev_ballot = None
+    tally_times = []
+    for layer in range(1, layers + 1):
+        hare_block = _bid(layer, 0)
+        for i in range(BLOCKS):
+            t.on_block(layer, _bid(layer, i))
+        t.on_hare_output(layer, hare_block)
+        t.on_weak_coin(layer, bool(rng.integers(2)))
+        base = prev_ballot if prev_ballot else b""
+        support = [_bid(layer - 1, 0)] if layer > 1 else []
+        for i in range(BALLOTS):
+            bid = _ballot_id(layer, i)
+            op = Opinion(base=base if base else bytes(32),
+                         support=list(support), against=[], abstain=[])
+            t._ingest(bid, layer, b"N%05d" % (i % ATXS_PER_EPOCH)
+                      + bytes(26), op, weight=100)
+        prev_ballot = _ballot_id(layer, 0)
+        t0 = time.perf_counter()
+        t.tally_votes(layer)
+        tally_times.append(time.perf_counter() - t0)
+        t.updates()  # drain, as the mesh does
+        if on_layer:
+            on_layer(t, layer)
+    return t, tally_times
+
+
+def test_stress_tally_time_and_rss():
+    rss_samples = []
+
+    def sample(t, layer):
+        if layer % 50 == 0:
+            rss_samples.append((layer, _rss_mb(), t._rows, t._cols,
+                               len(t._ballots)))
+
+    t0 = time.perf_counter()
+    t, times = _run(on_layer=sample)
+    total = time.perf_counter() - t0
+
+    # frontier keeps up: everything but the hdist tail is verified
+    assert t.verified >= LAYERS - t.hdist - 1, t.verified
+
+    # steady-state tally time per layer stays flat: the mean of the last
+    # quarter must not exceed 4x the mean of the second quarter (a
+    # quadratic tally fails this immediately) and stays under an absolute
+    # per-layer budget
+    q = len(times) // 4
+    early = sum(times[q:2 * q]) / q
+    late = sum(times[-q:]) / q
+    assert late < early * 4 + 0.05, (early, late)
+    assert late < 0.25, f"steady-state tally {late * 1000:.1f}ms/layer"
+
+    # the window bounds live state: ballots/blocks in memory never exceed
+    # window * per-layer rate (+ the eviction-hysteresis chunk and the
+    # pre-eviction ramp)
+    slack = WINDOW + max(WINDOW // 10, 16) + t.hdist + 2
+    assert len(t._ballots) <= slack * BALLOTS
+    assert t._cols <= slack * BLOCKS
+    # aux maps are evicted too (hare outputs, validity, coins)
+    assert len(t._hare) <= slack
+    assert len(t._validity) <= slack * BLOCKS
+    assert len(t._coin) <= slack
+
+    if os.environ.get("SPACEMESH_STRESS_REPORT"):
+        import json
+        print(json.dumps({
+            "layers": LAYERS, "window": WINDOW, "ballots_per_layer": BALLOTS,
+            "blocks_per_layer": BLOCKS, "atxs_per_epoch": ATXS_PER_EPOCH,
+            "total_s": round(total, 2),
+            "tally_ms_mean": round(sum(times) / len(times) * 1000, 3),
+            "tally_ms_p99": round(sorted(times)[int(len(times) * .99)] * 1000,
+                                  3),
+            "rss_mb_final": round(_rss_mb(), 1),
+            "rss_samples": [(x, round(m, 1), r, c, nb)
+                            for x, m, r, c, nb in rss_samples],
+        }))
+
+
+def test_window_slide_eviction_keeps_consistency():
+    """After the window slides, evicted layers stay decided (validity was
+    drained via updates) and the matrix only holds in-window state."""
+    t, _ = _run(layers=2 * WINDOW, window=WINDOW)
+    low = t.verified - t.window - max(t.window // 10, 16)  # hysteresis
+    assert min(t._ballots_by_layer) >= low
+    assert min(t._blocks) >= low
+    assert all(int(t._col_layer[c]) >= low for c in range(t._cols))
+    # still live: new layers keep verifying after several slides
+    assert t.verified >= 2 * WINDOW - t.hdist - 1
+
+
+def test_dirty_retally_crossing_eviction_edge():
+    """Late evidence (malfeasance) marks layers at the eviction edge
+    dirty; the re-tally must clamp to retained state, not crash, and the
+    frontier must recover."""
+    t, _ = _run(layers=WINDOW + 60, window=WINDOW)
+    before = t.verified
+    # condemn an identity whose ballots span every layer incl. evicted
+    t.on_malfeasance(b"N%05d" % 1 + bytes(26))
+    assert t._dirty is not None and t._dirty <= before - t.window + 1
+    t.tally_votes(WINDOW + 60)
+    assert t.verified >= before - t.hdist  # frontier recovers
+    # the zeroed weight is visible in the retained matrix
+    rows = t._node_rows.get(b"N%05d" % 1 + bytes(26), [])
+    assert rows and all(t._weights[r] == 0 for r in rows)
+
+
+def test_late_ballot_below_eviction_edge_is_safe():
+    """A ballot arriving for a layer already evicted must not corrupt
+    state or un-verify the frontier."""
+    t, _ = _run(layers=WINDOW + 60, window=WINDOW)
+    before = t.verified
+    low = before - t.window
+    old_layer = max(low - 5, 1)
+    op = Opinion(base=bytes(32), support=[], against=[], abstain=[])
+    t._ingest(b"LATE" + bytes(28), old_layer, b"N%05d" % 2 + bytes(26),
+              op, weight=100)
+    t.tally_votes(WINDOW + 60)
+    assert t.verified >= before - t.hdist
