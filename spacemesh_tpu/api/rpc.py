@@ -288,7 +288,11 @@ class GrpcApiServer:
         # reference splits listeners by audience for exactly this reason
         # (api/grpcserver/config.go:31-57: public vs private vs post).
         self.public_only = public_only
-        self.post_service = PostGrpcService(query_interval=post_query_interval)
+        # the Register seam only exists on the private listener — a public
+        # server never even constructs it, so auditing the public attack
+        # surface starts and ends here
+        self.post_service = None if public_only else PostGrpcService(
+            query_interval=post_query_interval)
         self.server: grpc.aio.Server | None = None
         self.actual_port: int | None = None
 

@@ -741,10 +741,15 @@ class App:
             on_fork=self._on_fork, derive_beacon=derive_beacon)
 
     async def start_network(self) -> tuple[str, int]:
-        """Open the real TCP transport (p2p/transport.Host) on
-        cfg.p2p.listen, bootstrap-dial cfg.p2p.bootnodes, and run the
-        syncer in the background. Returns the bound (host, port)."""
-        from ..p2p.transport import Host
+        """Open the real transport (TCP by default; QUIC-lite when
+        cfg.p2p.transport == "quic" — reference p2p/host.go:166
+        EnableQUICTransport) on cfg.p2p.listen, bootstrap-dial
+        cfg.p2p.bootnodes, and run the syncer in the background.
+        Returns the bound (host, port)."""
+        if self.cfg.p2p.transport == "quic":
+            from ..p2p.quic import QuicHost as Host
+        else:
+            from ..p2p.transport import Host
 
         cfg = self.cfg.p2p
         self.host = Host(

@@ -112,6 +112,8 @@ class P2PConfig:
     min_peers: int = 20
     max_peers: int = 100
     network_cookie: str = ""
+    transport: str = "tcp"       # "tcp" | "quic" (reference
+                                 # p2p/host.go:166 EnableQUICTransport)
 
 
 @dataclasses.dataclass
@@ -231,4 +233,9 @@ def load(preset_name: str = "", file: str | Path | None = None,
         _merge(cfg, json.loads(Path(file).read_text()))
     if overrides:
         _merge(cfg, overrides)
+    if cfg.p2p.transport not in ("tcp", "quic"):
+        # a typo'd transport must fail at startup, not silently run TCP
+        raise ValueError(
+            f"p2p.transport must be 'tcp' or 'quic', got "
+            f"{cfg.p2p.transport!r}")
     return cfg

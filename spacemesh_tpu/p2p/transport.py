@@ -213,15 +213,26 @@ class Host:
 
     async def start(self) -> tuple[str, int]:
         host, _, port = self.listen.rpartition(":")
-        self._listener = await asyncio.start_server(
-            self._accept, host or "127.0.0.1", int(port or 0))
-        sock = self._listener.sockets[0]
-        self.address = sock.getsockname()[:2]
+        self.address = await self._listen(host or "127.0.0.1", int(port or 0))
         for spec in self.bootstrap:
             h, _, p = spec.rpartition(":")
             self._known[(h, int(p))] = 0.0
         self._tasks.append(asyncio.ensure_future(self._maintain()))
         return self.address
+
+    # -- transport plumbing (overridden by QuicHost, p2p/quic.py) --
+
+    async def _listen(self, host: str, port: int) -> tuple[str, int]:
+        self._listener = await asyncio.start_server(self._accept, host, port)
+        return self._listener.sockets[0].getsockname()[:2]
+
+    async def _open_connection(self, addr: tuple[str, int]):
+        return await asyncio.open_connection(addr[0], addr[1])
+
+    async def _close_listener(self) -> None:
+        if self._listener is not None:
+            self._listener.close()
+            await self._listener.wait_closed()
 
     async def stop(self) -> None:
         self._stopping = True
@@ -230,9 +241,7 @@ class Host:
         for conn in list(self._conns.values()):
             self._drop(conn)
         self._conns.clear()
-        if self._listener is not None:
-            self._listener.close()
-            await self._listener.wait_closed()
+        await self._close_listener()
         for fut in self._pending.values():
             if not fut.done():
                 fut.set_exception(ConnectionError("host stopped"))
@@ -326,7 +335,7 @@ class Host:
             return
         try:
             reader, writer = await asyncio.wait_for(
-                asyncio.open_connection(addr[0], addr[1]), 5.0)
+                self._open_connection(addr), 5.0)
         except (OSError, asyncio.TimeoutError):
             return
         try:
