@@ -157,7 +157,8 @@ class HareSession:
         self.layer = layer
         self.my_proposals = sorted(proposals)
         # the proven graded machine makes every decision (hare3.py)
-        self.protocol = hare3.Protocol(hare.committee // 2 + 1)
+        self.protocol = hare3.Protocol(
+            hare.committee_for(layer) // 2 + 1)
         self.commits: dict[bytes, tuple[int, tuple]] = {}
         # (iteration, values) -> node_id -> (raw COMMIT, its own seat
         # count) — kept to assemble the NOTIFY commit certificate; the
@@ -243,7 +244,9 @@ class Hare:
                  proposals_for: Callable[[int], list[bytes]],
                  on_output: Callable[[ConsensusOutput], Awaitable[None]],
                  on_equivocation=None, preround_delay: float = 0.0,
-                 wall=None, compact: bool = False, server=None):
+                 wall=None, compact: bool = False, server=None,
+                 committee_upgrade: tuple[int, int] | None = None,
+                 compact_enable_layer: int | None = None):
         """Multi-identity: every signer in ``signers`` participates with
         its own eligibility (reference hare iterates registered signers);
         atx_for(epoch, node_id) resolves each signer's ATX.
@@ -280,15 +283,38 @@ class Hare:
         self._pending: dict[int, list] = {}  # (msg, raw_signed, raw_full)
         self._pending_cap = 1 << 10
         self.compact = compact
+        # (layer, size): from that layer on the committee size switches
+        # (reference hare4/hare.go:52 CommitteeUpgrade + :74 CommitteeFor)
+        self.committee_upgrade = tuple(committee_upgrade) \
+            if committee_upgrade else None
+        # layer-gated plain->compact protocol switch (reference
+        # node/node.go:915-943: hare3 serves layers below the hare4
+        # enable layer, hare4 takes over from it)
+        self.compact_enable_layer = compact_enable_layer
         self.server = server
         # full value lists we can serve over hf/1:
         # (layer, iteration, round, node_id) -> list of full ids
         self._full_values: dict[tuple, list[bytes]] = {}
         pubsub.register(TOPIC_HARE, self._gossip)
-        if compact:
+        if compact or compact_enable_layer is not None:
             pubsub.register(TOPIC_HARE_COMPACT, self._gossip_compact)
         if server is not None:
             server.register(P_FULL_EXCHANGE, self._serve_full)
+
+    # --- per-layer protocol parameters ------------------------------
+
+    def committee_for(self, layer: int) -> int:
+        """Committee size for a layer (reference hare4/hare.go:73-78
+        CommitteeFor: the upgrade takes effect at its layer)."""
+        if self.committee_upgrade and layer >= self.committee_upgrade[0]:
+            return self.committee_upgrade[1]
+        return self.committee
+
+    def compact_for(self, layer: int) -> bool:
+        """Whether this layer speaks the compact (hare4) wire format."""
+        if self.compact_enable_layer is not None:
+            return layer >= self.compact_enable_layer
+        return self.compact
 
     # --- gossip ingestion ------------------------------------------
 
@@ -305,7 +331,7 @@ class Hare:
         round_tag = msg.iteration * 4 + msg.round
         if not self.oracle.validate_hare(
                 beacon, msg.layer, round_tag, epoch, msg.atx_id,
-                self.committee, msg.eligibility_proof,
+                self.committee_for(msg.layer), msg.eligibility_proof,
                 msg.eligibility_count):
             return False
         if msg.round == COMMIT:
@@ -410,7 +436,7 @@ class Hare:
         round_tag = cm.iteration * 4 + cm.round
         if not self.oracle.validate_hare(
                 beacon, cm.layer, round_tag, epoch, cm.atx_id,
-                self.committee, cm.eligibility_proof,
+                self.committee_for(cm.layer), cm.eligibility_proof,
                 cm.eligibility_count):
             return False
         if cm.round == NOTIFY and not await self._validate_cert(
@@ -441,7 +467,7 @@ class Hare:
         both encodings share), senders distinct, summed seats reaching
         the commit threshold. Mixed networks therefore interoperate: a
         full-encoded commit can certify a compact NOTIFY and vice versa."""
-        threshold = self.committee // 2 + 1
+        threshold = self.committee_for(layer) // 2 + 1
         epoch = layer // self.layers_per_epoch
         beacon = await self.beacon_of(epoch)
         total = 0
@@ -471,8 +497,8 @@ class Hare:
                 tag = cm.iteration * 4 + COMMIT
                 if not self.oracle.validate_hare(
                         beacon, cm.layer, tag, epoch, cm.atx_id,
-                        self.committee, cm.eligibility_proof,
-                        cm.eligibility_count):
+                        self.committee_for(cm.layer),
+                        cm.eligibility_proof, cm.eligibility_count):
                     return False
                 self._remember_valid_commit(raw)
             senders.add(cm.node_id)
@@ -529,7 +555,7 @@ class Hare:
         # committee's total seats sum to ~committee_size network-wide), so
         # the same constant is safe for any network size — a lone smesher
         # with all the weight holds ~all committee seats itself.
-        threshold = self.committee // 2 + 1
+        threshold = self.committee_for(layer) // 2 + 1
         protocol = session.protocol
 
         async def send(om: hare3.OutMessage) -> None:
@@ -551,11 +577,12 @@ class Hare:
             round_tag = iteration * 4 + wire_round
             for signer, vrf, atx in participants:
                 el = self.oracle.hare_eligibility(
-                    vrf, beacon, layer, round_tag, epoch, atx, self.committee)
+                    vrf, beacon, layer, round_tag, epoch, atx,
+                    self.committee_for(layer))
                 if el is None:
                     continue
                 proof, count = el
-                if self.compact:
+                if self.compact_for(layer):
                     cm = CompactHareMessage(
                         layer=layer, iteration=iteration, round=wire_round,
                         compact_ids=[compact_id(v) for v in values],

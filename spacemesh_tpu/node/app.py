@@ -235,6 +235,8 @@ class App:
             beacon_of=self.beacon.get, atx_for=self._atx_of,
             proposals_for=self.proposal_store.ids_in_layer,
             on_output=self._on_hare_output, compact=cfg.hare.compact,
+            committee_upgrade=cfg.hare.committee_upgrade,
+            compact_enable_layer=cfg.hare.compact_enable_layer,
             wall=self.time_source)
         if cfg.poet_servers:
             # external poet daemons (reference activation/poet.go client;

@@ -70,6 +70,11 @@ class HareConfig:
     preround_delay: float = 25.0
     iteration_limit: int = 4
     compact: bool = False        # hare4-style compact proposal ids (b4)
+    committee_upgrade: list | None = None   # [layer, size] — committee
+                                 # switches at that layer (reference
+                                 # hare4/hare.go:52 CommitteeUpgrade)
+    compact_enable_layer: int | None = None  # layer-gated plain->compact
+                                 # protocol switch (node.go:915-943)
 
 
 @dataclasses.dataclass
