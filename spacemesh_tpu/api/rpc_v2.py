@@ -230,22 +230,30 @@ class V2AlphaServices:
                                  req.limit, req.offset)
         return v2.RewardList(rewards=[self._reward_msg(r) for r in rows])
 
+    def _reward_pages(self, coinbase, start_layer: int):
+        """Reward rows from ``start_layer`` in 100-row pages — a scan
+        over a long range must not materialize it in one query
+        (ADVICE r4). The start layer stays FIXED across pages (offset
+        paging): advancing it per page would skip rows when several
+        coinbases share the page-boundary layer."""
+        offset = 0
+        while True:
+            page = self._reward_rows(coinbase, start_layer, 100, offset)
+            yield from page
+            if len(page) < 100:
+                return
+            offset += 100
+
     async def _reward_stream(self, req, ctx):
         sub = None
         if req.watch:
             sub = self.node.events.subscribe(events_mod.LayerUpdate, size=256)
         try:
             last = req.start_layer - 1
-            offset = 0
-            while True:
-                rows = self._reward_rows(req.coinbase or None,
-                                         req.start_layer, 100, offset)
-                for row in rows:
-                    last = max(last, row["layer"])
-                    yield self._reward_msg(row)
-                if len(rows) < 100:
-                    break
-                offset += 100
+            for row in self._reward_pages(req.coinbase or None,
+                                          req.start_layer):
+                last = max(last, row["layer"])
+                yield self._reward_msg(row)
             if sub is None:
                 return
             while True:
@@ -254,8 +262,8 @@ class V2AlphaServices:
                 # triggers a DB re-scan from `last`, nothing is lost
                 if ev.status != "applied" or ev.layer <= last:
                     continue
-                for row in self._reward_rows(req.coinbase or None, last + 1,
-                                             1 << 30, 0):
+                for row in self._reward_pages(req.coinbase or None,
+                                              last + 1):
                     last = max(last, row["layer"])
                     yield self._reward_msg(row)
         finally:

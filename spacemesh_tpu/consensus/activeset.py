@@ -64,6 +64,29 @@ def num_eligible_slots(weight: int, min_weight: int, total_weight: int,
     return max(num, 1)
 
 
+def declared_set_weight(db: Database, cache: AtxCache, epoch: int,
+                        root: bytes) -> int | None:
+    """Total weight of the stored active set with this root, when every
+    member resolves in the cache. The eligibility denominator must come
+    from the set a ballot DECLARES, not the validator's local ATX view —
+    nodes with divergent views would otherwise disagree on ballot
+    validity (reference proposals/eligibility_validator.go validates
+    against the ref ballot's declared set; ADVICE r4). None → caller
+    falls back to the local epoch weight."""
+    from ..storage import misc as miscstore
+
+    ids = miscstore.active_set(db, root)
+    if ids is None:
+        return None
+    total = 0
+    for atx_id in ids:
+        member = cache.get(epoch, atx_id)
+        if member is None:
+            return None
+        total += member.weight
+    return total or None
+
+
 def grade_atx(epoch_start: float, network_delay: float,
               atx_received: float, proof_received: float | None) -> int:
     """Grade by receipt time vs epoch start (generator.go:283-293)."""

@@ -49,18 +49,45 @@ class Oracle:
 
     # --- proposal eligibility -----------------------------------------
 
-    def num_slots(self, epoch: int, atx_id: bytes) -> int:
+    def trusts_declared(self, epoch: int) -> bool:
+        """Whether declared-active-set denominators are honored for this
+        epoch. Only on networks with a NONZERO consensus min-weight
+        floor: the floor is what stops an attacker from declaring a
+        dust set (e.g. only their own ATX) and collecting the whole
+        epoch's slot allotment — num_eligible_slots divides by
+        max(floor, declared). With floor == 0 that defense is absent,
+        so the declared total is ignored and eligibility falls back to
+        the validator's local epoch weight (code-review r5; reference
+        config/mainnet.go MinimalActiveSetWeight is nonzero from
+        genesis for the same reason)."""
+        from .activeset import select_min_weight
+
+        return select_min_weight(epoch, self.min_weight_table) > 0
+
+    def num_slots(self, epoch: int, atx_id: bytes,
+                  total_override: int | None = None) -> int:
         """Proposal slots for this ATX in the epoch: weight-proportional
         with the epoch min-weight floor in the denominator
         (proposals/util/util.go:29-39 + miner/minweight Select) — the
         gating that stops dust identities from harvesting outsized slot
-        counts on young or shrunken networks."""
+        counts on young or shrunken networks.
+
+        ``total_override`` carries the weight of the active set DECLARED
+        by the ballot under validation (reference validates against the
+        ref ballot's declared set, not the local view — ADVICE r4: nodes
+        with divergent ATX views must not disagree on ballot validity
+        when the declared set is resolvable). The min-weight table is a
+        CONSENSUS parameter: it enters this denominator, so it must
+        match network-wide (like genesis config)."""
         from .activeset import num_eligible_slots, select_min_weight
 
         info = self.cache.get(epoch, atx_id)
         if info is None or info.malicious:
             return 0
-        total = self.cache.epoch_weight(epoch)
+        if total_override is not None and self.trusts_declared(epoch):
+            total = total_override
+        else:
+            total = self.cache.epoch_weight(epoch)
         if total == 0:
             return 0
         return num_eligible_slots(
@@ -74,10 +101,12 @@ class Oracle:
         return first + int.from_bytes(out[8:16], "little") % self.layers_per_epoch
 
     def eligible_slots_for_layer(self, vrf_signer, beacon: bytes, epoch: int,
-                                 atx_id: bytes, layer: int) -> list[tuple[int, bytes]]:
+                                 atx_id: bytes, layer: int,
+                                 total_override: int | None = None,
+                                 ) -> list[tuple[int, bytes]]:
         """All (j, proof) proposal slots of this signer landing in ``layer``."""
         out = []
-        for j in range(self.num_slots(epoch, atx_id)):
+        for j in range(self.num_slots(epoch, atx_id, total_override)):
             proof = vrf_signer.prove(proposal_alpha(beacon, epoch, j))
             if self.slot_layer(epoch, proof) == layer:
                 out.append((j, proof))
@@ -88,9 +117,11 @@ class Oracle:
         return info.vrf_public_key if info else None
 
     def validate_slot(self, beacon: bytes, epoch: int, atx_id: bytes,
-                      layer: int, j: int, proof: bytes) -> bool:
+                      layer: int, j: int, proof: bytes,
+                      total_override: int | None = None) -> bool:
         key = self.vrf_key(epoch, atx_id)
-        if key is None or j >= self.num_slots(epoch, atx_id):
+        if key is None or j >= self.num_slots(epoch, atx_id,
+                                              total_override):
             return False
         if not self._vrf.verify(key, proposal_alpha(beacon, epoch, j), proof):
             return False

@@ -91,16 +91,19 @@ class ProposalBuilder:
             return None
         beacon = await self.beacon_getter(epoch)
         vrf = self.signer.vrf_signer()
-        slots = self.oracle.eligible_slots_for_layer(
-            vrf, beacon, epoch, atx_id, layer)
-        if not slots:
-            return None
 
+        # resolve the active set this ballot DECLARES first — slot counts
+        # must be computed against that set's weight, matching what
+        # validators recompute (activeset.declared_set_weight); otherwise
+        # a builder whose local ATX view runs ahead of its declared set
+        # would claim slot indices validators reject
         epoch_start = epoch * self.layers_per_epoch
         ref = ballotstore.refballot(self.db, self.signer.node_id,
                                     epoch_start, epoch_start + self.layers_per_epoch)
         epoch_data = None
         ref_id = EMPTY32
+        from .activeset import declared_set_weight
+        from ..storage import misc as miscstore
         if ref is None:
             active = None
             if self.activeset_gen is not None:
@@ -110,14 +113,28 @@ class ProposalBuilder:
                     active = None
             if active is None:
                 active = [a for a, _ in self.cache.iter_epoch(epoch)]
-            from ..storage import misc as miscstore
-            miscstore.add_active_set(self.db, active_set_root(active),
-                                     epoch, sorted(active))
+            root = active_set_root(active)
+            miscstore.add_active_set(self.db, root, epoch, sorted(active))
+            declared_total = declared_set_weight(
+                self.db, self.cache, epoch, root) \
+                if self.oracle.trusts_declared(epoch) else None
             epoch_data = EpochData(
-                beacon=beacon, active_set_root=active_set_root(active),
-                eligibility_count=self.oracle.num_slots(epoch, atx_id))
+                beacon=beacon, active_set_root=root,
+                eligibility_count=self.oracle.num_slots(epoch, atx_id,
+                                                        declared_total))
         else:
             ref_id = ref.id
+            declared_total = None
+            if ref.epoch_data is not None \
+                    and self.oracle.trusts_declared(epoch):
+                declared_total = declared_set_weight(
+                    self.db, self.cache, epoch,
+                    ref.epoch_data.active_set_root)
+
+        slots = self.oracle.eligible_slots_for_layer(
+            vrf, beacon, epoch, atx_id, layer, declared_total)
+        if not slots:
+            return None
 
         ballot = Ballot(
             layer=layer, atx_id=atx_id, epoch_data=epoch_data,
@@ -155,6 +172,12 @@ class ProposalHandler:
         self.layers_per_epoch = layers_per_epoch
         self.beacon_getter = beacon_getter
         self.on_malfeasance = on_malfeasance
+        # async root -> bool; wired to fetch.get_hashes(HINT_ACTIVESET)
+        # once the network starts (app.start_network) — a ballot's
+        # declared active set must be FETCHABLE, not just locally
+        # resolvable, or validators fall back to their local epoch
+        # weight and disagree with the builder (code-review r5)
+        self.fetch_active_set = None
         pubsub.register(TOPIC_PROPOSAL, self._gossip)
 
     async def _gossip(self, peer: bytes, data: bytes) -> bool:
@@ -163,6 +186,27 @@ class ProposalHandler:
         except (codec.DecodeError, ValueError):
             return False
         return await self.process(proposal)
+
+    async def _declared_set_weight(self, epoch: int, epoch_data
+                                   ) -> int | None:
+        """Weight of the active set the ballot DECLARES (via its own or
+        its ref ballot's EpochData.active_set_root) — see
+        activeset.declared_set_weight. On a local miss the set is
+        fetched from peers (content-addressed by its root) before
+        falling back to the local epoch weight."""
+        from .activeset import declared_set_weight
+
+        if epoch_data is None:
+            return None
+        root = epoch_data.active_set_root
+        total = declared_set_weight(self.db, self.cache, epoch, root)
+        if total is None and self.fetch_active_set is not None:
+            try:
+                await self.fetch_active_set(root)
+            except Exception:
+                return None
+            total = declared_set_weight(self.db, self.cache, epoch, root)
+        return total
 
     async def ingest_ballot(self, ballot) -> bool:
         """Full ballot validation + store + tortoise feed. ONE path for
@@ -186,19 +230,18 @@ class ProposalHandler:
         # majority chain's ballots survive a local beacon divergence
         # while a grinding adversary can't steer margins immediately.
         local_beacon = await self.beacon_getter(epoch)
-        declared = None
-        if ballot.epoch_data is not None:
-            declared = ballot.epoch_data.beacon
-        else:
-            ref = ballotstore.get(self.db, ballot.ref_ballot)
-            if ref is not None and ref.epoch_data is not None \
-                    and ref.node_id == ballot.node_id:
-                declared = ref.epoch_data.beacon
+        epoch_data = ballotstore.resolve_epoch_data(self.db, ballot)
+        declared = epoch_data.beacon if epoch_data is not None else None
         beacon = declared if declared is not None else local_beacon
         bad_beacon = declared is not None and declared != local_beacon
+        declared_total = None
+        if self.oracle.trusts_declared(epoch):
+            declared_total = await self._declared_set_weight(epoch,
+                                                             epoch_data)
         for el in ballot.eligibilities:
             if not self.oracle.validate_slot(beacon, epoch, ballot.atx_id,
-                                             ballot.layer, el.j, el.sig):
+                                             ballot.layer, el.j, el.sig,
+                                             declared_total):
                 return False
         # double ballot in one (layer, signer) slot set -> malfeasance
         existing = ballotstore.by_node_in_layer(self.db, ballot.node_id,
@@ -211,7 +254,8 @@ class ProposalHandler:
                 return False
         with self.db.tx():
             ballotstore.add(self.db, ballot)
-        num_slots = self.oracle.num_slots(epoch, ballot.atx_id)
+        num_slots = self.oracle.num_slots(epoch, ballot.atx_id,
+                                          declared_total)
         unit = info.weight // max(num_slots, 1)
         self.tortoise.on_ballot(ballot, unit * len(ballot.eligibilities),
                                 bad_beacon=bad_beacon)

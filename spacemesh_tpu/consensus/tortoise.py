@@ -716,28 +716,34 @@ class Tortoise:
                 t.on_hare_output(layer, cert)
             elif applied is not None:
                 t.on_hare_output(layer, applied)
-        for layer in range(low, processed + 1):
+        # Ballots at or below the 0004 block-id-rewrite boundary carry
+        # signed vote lists naming pre-rewrite block ids; replaying them
+        # would resolve every support as against and could flip validity
+        # of in-window blocks (ADVICE r4). Persisted per-block verdicts
+        # (loaded above) already cover those layers.
+        ballot_low = max(low, miscstore.migration_boundary(db) + 1)
+        for layer in range(ballot_low, processed + 1):
             for ballot in ballotstore.in_layer(db, layer):
                 epoch = layer // layers_per_epoch
                 info = cache.get(epoch, ballot.atx_id)
                 if info is None:
                     continue
-                num = oracle.num_slots(epoch, ballot.atx_id)
+                # shared with live ingest (miner.ingest_ballot) —
+                # recover must not flag ballots the live path left
+                # unflagged, nor weigh them differently
+                epoch_data = ballotstore.resolve_epoch_data(db, ballot)
+                # per-eligibility weight uses the DECLARED active set's
+                # weight exactly like live ingest — a restart must not
+                # change ballot weights (code-review r5)
+                declared_total = None
+                if epoch_data is not None and oracle.trusts_declared(epoch):
+                    from .activeset import declared_set_weight
+                    declared_total = declared_set_weight(
+                        db, cache, epoch, epoch_data.active_set_root)
+                num = oracle.num_slots(epoch, ballot.atx_id, declared_total)
                 unit = info.weight // max(num, 1)
-                # re-derive the bad-beacon flag from storage: the
-                # ballot's declared beacon (own EpochData or its ref
-                # ballot's) vs the stored epoch beacon
-                declared = None
-                if ballot.epoch_data is not None:
-                    declared = ballot.epoch_data.beacon
-                else:
-                    ref = ballotstore.get(db, ballot.ref_ballot)
-                    if ref is not None and ref.epoch_data is not None \
-                            and ref.node_id == ballot.node_id:
-                        # same owner check as the live ingest path
-                        # (miner.ingest_ballot) — recover must not flag
-                        # ballots the live path left unflagged
-                        declared = ref.epoch_data.beacon
+                declared = epoch_data.beacon if epoch_data is not None \
+                    else None
                 local = miscstore.get_beacon(db, epoch)
                 bad = (declared is not None and local is not None
                        and declared != local)

@@ -498,6 +498,17 @@ class App:
         self.fetch.set_validator(fetch_mod.HINT_MALFEASANCE, v_malfeasance)
         self.fetch.set_validator(fetch_mod.HINT_ACTIVESET, v_active_set)
 
+        async def fetch_active_set(root: bytes) -> bool:
+            got = await self.fetch.get_hashes(fetch_mod.HINT_ACTIVESET,
+                                              [root])
+            return bool(got.get(root))
+
+        # ballots declare active sets by root; eligibility validation
+        # resolves the declared set (fetching it if unseen) so nodes
+        # with divergent ATX views agree on slot counts (ADVICE r4 +
+        # code-review r5)
+        self.proposal_handler.fetch_active_set = fetch_active_set
+
         # index endpoints
         async def serve_epoch(peer: bytes, data: bytes) -> bytes:
             epoch = _struct.unpack("<I", data)[0]

@@ -137,7 +137,11 @@ class Config:
     slots_per_layer: int = 50              # proposal slots (epoch total / lpe)
     min_active_set_weight: list = dataclasses.field(default_factory=list)
     # ^ [(epoch, weight)] ascending — reference miner/minweight table
-    #   (config/mainnet.go MinimalActiveSetWeight)
+    #   (config/mainnet.go MinimalActiveSetWeight).
+    #   CONSENSUS PARAMETER (ADVICE r4): it enters the eligibility
+    #   denominator (num_eligible_slots), so every node on a network
+    #   must run the same table — like genesis config, a mismatch splits
+    #   validate_slot's j >= num_slots check and partitions the network.
     activeset: ActiveSetConfig = dataclasses.field(
         default_factory=ActiveSetConfig)
     genesis: GenesisConfig = dataclasses.field(default_factory=GenesisConfig)

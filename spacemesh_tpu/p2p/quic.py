@@ -48,6 +48,62 @@ RTO_MIN, RTO_MAX = 0.2, 2.0
 IDLE_TIMEOUT = 30.0
 KEEPALIVE = 5.0
 SYN_RETRIES = 5
+MAX_HALF_OPEN = 64        # server conns accepted but with no DATA yet —
+                          # a spoofed SYN flood stops allocating state here
+                          # (the TCP path gets this from the kernel accept
+                          # queue; ADVICE r4)
+MAX_CONNS = 1024          # hard cap on live connections per endpoint
+
+
+class CountingReader(asyncio.StreamReader):
+    """StreamReader that tracks buffered bytes (fed minus consumed) so
+    receive flow control does not rely on asyncio's private ``_buffer``
+    attribute (ADVICE r4: if that internal were renamed, backpressure
+    would silently never engage)."""
+
+    def __init__(self, *a, **kw):
+        super().__init__(*a, **kw)
+        self._fed = 0
+        self._consumed = 0
+
+    @property
+    def buffered(self) -> int:
+        return self._fed - self._consumed
+
+    def feed_data(self, data) -> None:
+        self._fed += len(data)
+        super().feed_data(data)
+
+    # Consumption is counted ONLY at the primitive consume points —
+    # read(n>=0), readexactly, readuntil. read(-1) loops over
+    # self.read(limit) and readline delegates to self.readuntil, so
+    # counting in those wrappers too would double-count every byte and
+    # drive `buffered` negative (code-review r5).
+
+    async def read(self, n=-1):
+        if n < 0:
+            return await super().read(n)  # delegates to counted read(n)
+        data = await super().read(n)
+        self._consumed += len(data)
+        return data
+
+    async def readexactly(self, n):
+        try:
+            data = await super().readexactly(n)
+        except asyncio.IncompleteReadError as e:
+            self._consumed += len(e.partial)  # partial IS consumed
+            raise
+        self._consumed += len(data)
+        return data
+
+    async def readuntil(self, separator=b"\n"):
+        try:
+            data = await super().readuntil(separator)
+        except asyncio.IncompleteReadError as e:
+            self._consumed += len(e.partial)  # EOF drains the buffer
+            raise
+        self._consumed += len(data)
+        return data
 
 
 class QuicWriter:
@@ -79,10 +135,12 @@ class QuicConnection:
         self.remote_addr = remote_addr
         self.local_id = local_id          # what the PEER puts in dest id
         self.remote_id: bytes | None = None
-        self.reader = asyncio.StreamReader()
+        self.reader = CountingReader()
         self.writer = QuicWriter(self)
         self.established = asyncio.Event()
         self.closed = False
+        self.half_open = False            # server-accepted, no DATA yet
+        self._peer_key = None             # (client_id, addr) accept index
         # send side
         self._send_buf = bytearray()
         self._next_seq = 0                # next seq to assign
@@ -103,11 +161,14 @@ class QuicConnection:
     def start_io(self) -> None:
         self._tasks.append(asyncio.ensure_future(self._retransmit_loop()))
 
-    def close(self) -> None:
+    def close(self, *, _send_fin: bool = True) -> None:
         if self.closed:
             return
         self.closed = True
-        if self.remote_id is not None:
+        if self.half_open:
+            self.half_open = False
+            self.endpoint.half_open_count -= 1
+        if _send_fin and self.remote_id is not None:
             self.endpoint._send_raw(FIN, self.remote_id, 0, 0, b"",
                                     self.remote_addr)
         self.reader.feed_eof()
@@ -199,8 +260,10 @@ class QuicConnection:
             # while the application hasn't drained the reader — the
             # sender's window fills and its RTO paces retransmission
             # until we catch up (no unbounded reader growth)
-            buffered = len(getattr(self.reader, "_buffer", b""))
-            if seq == self._recv_next and buffered < RECV_BUF_CAP:
+            if self.half_open:
+                self.half_open = False
+                self.endpoint.half_open_count -= 1
+            if seq == self._recv_next and self.reader.buffered < RECV_BUF_CAP:
                 self.reader.feed_data(payload)
                 self._recv_next += 1
                 while self._recv_next in self._ooo:
@@ -217,12 +280,11 @@ class QuicConnection:
             self.endpoint._send_raw(ACK, self.remote_id, 0,
                                     self._recv_next, b"", self.remote_addr)
         elif ptype == FIN:
-            self.closed = True
-            self.reader.feed_eof()
-            self._drain_ev.set()
-            for t in self._tasks:
-                t.cancel()
-            self.endpoint._forget(self)
+            # full teardown via close() so the half-open accounting runs
+            # (code-review r5: a SYN->FIN pair that skipped the decrement
+            # leaked admission slots until the endpoint refused everyone);
+            # no FIN echo — the peer initiated the close
+            self.close(_send_fin=False)
 
 
 class QuicEndpoint(asyncio.DatagramProtocol):
@@ -234,6 +296,11 @@ class QuicEndpoint(asyncio.DatagramProtocol):
         self.transport: asyncio.DatagramTransport | None = None
         self.address: tuple[str, int] | None = None
         self._by_id: dict[bytes, QuicConnection] = {}
+        self._accepted: dict[tuple, QuicConnection] = {}
+        # ^ (client_id, addr) -> conn, so retransmitted-SYN dedupe is
+        #   O(1) — the SYN path must do constant work per packet or the
+        #   flood it refuses still starves the event loop
+        self.half_open_count = 0          # O(1) admission check under flood
         self._syn_waiters: dict[bytes, asyncio.Future] = {}
         self.loss_rate = loss_rate
         self._rng = rng or random.Random(0xC0FFEE)
@@ -308,6 +375,9 @@ class QuicEndpoint(asyncio.DatagramProtocol):
     def _forget(self, conn: QuicConnection) -> None:
         if self._by_id.get(conn.local_id) is conn:
             del self._by_id[conn.local_id]
+        if conn._peer_key is not None \
+                and self._accepted.get(conn._peer_key) is conn:
+            del self._accepted[conn._peer_key]
 
     def datagram_received(self, data: bytes, addr) -> None:
         if len(data) < HEADER.size:
@@ -324,15 +394,29 @@ class QuicEndpoint(asyncio.DatagramProtocol):
             client_id = payload[:8]
             if len(client_id) != 8:
                 return
-            for conn in self._by_id.values():
-                if conn.remote_id == client_id and conn.remote_addr == addr:
-                    self._send_raw(SYNACK, client_id, 0, 0, conn.local_id,
-                                   addr)
-                    return
+            known = self._accepted.get((client_id, addr))
+            if known is not None:
+                self._send_raw(SYNACK, client_id, 0, 0, known.local_id,
+                               addr)
+                return
+            # admission control: a spoofed SYN flood must not grow
+            # _by_id and its tasks unboundedly — refuse new state once
+            # too many accepted connections have never sent DATA, or
+            # the endpoint is at its hard connection cap (ADVICE r4).
+            # The counter keeps this O(1) on the flooded path.
+            if self.half_open_count >= MAX_HALF_OPEN \
+                    or len(self._by_id) >= MAX_CONNS:
+                self.stats["syn_refused"] = \
+                    self.stats.get("syn_refused", 0) + 1
+                return
             local_id = os.urandom(8)
             conn = QuicConnection(self, addr, local_id)
             conn.remote_id = client_id
+            conn.half_open = True
+            conn._peer_key = (client_id, addr)
+            self.half_open_count += 1
             self._by_id[local_id] = conn
+            self._accepted[conn._peer_key] = conn
             conn.established.set()
             conn.start_io()
             self._send_raw(SYNACK, client_id, 0, 0, local_id, addr)

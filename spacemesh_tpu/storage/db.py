@@ -271,6 +271,68 @@ def _migrate_0004_reward_atx(conn) -> None:
 
 STATE_MIGRATIONS.append(_migrate_0004_reward_atx)
 
+
+def _migrate_0005_rewrite_fixups(conn) -> None:
+    """The 0004 block-id rewrite invalidated derived data it did not fix
+    (ADVICE r4) — and 0004 itself cannot be amended (databases already at
+    user_version 4 would never re-run it), so the repair is a separate
+    migration that DETECTS whether a rewrite ever happened: it recomputes
+    the chained aggregated layer hashes agg(L) = H(agg(L-1) || applied)
+    (mesh.py _aggregate) and compares with the stored chain. A mismatch
+    can only mean the stored chain predates the id rewrite, in which
+    case:
+      - the chain is replaced with the recomputed one (fork-finder
+        comparisons against freshly syncing peers must match);
+      - hare certificates are dropped — their blobs embed the old block
+        id under a signature that cannot be re-issued;
+      - the top layer is recorded as a boundary mark; Tortoise.recover
+        replays ballots strictly after it (their signed vote lists name
+        pre-rewrite ids that would all resolve as against). Persisted
+        per-block validity verdicts cover the fenced-off layers."""
+    from ..core.hashing import sum256
+
+    conn.execute("CREATE TABLE IF NOT EXISTS migration_marks ("
+                 " key TEXT PRIMARY KEY, value INT NOT NULL)")
+    rows = conn.execute(
+        "SELECT id, applied_block, aggregated_hash FROM layers"
+        " WHERE aggregated_hash IS NOT NULL ORDER BY id").fetchall()
+    # The rewrite point is localizable with the STEP relation over stored
+    # values: stored_agg(L) == H(stored_agg(L-1) || applied(L)) holds for
+    # layers chained after the id rewrite and fails for layers whose
+    # applied_block was rewritten under them (0004 changed the column but
+    # not the hash). A node that kept running on the v4 build for weeks
+    # has thousands of perfectly valid post-rewrite layers — fencing and
+    # cert-dropping must stop at the true boundary, not the top
+    # (code-review r5). Residual: trailing EMPTY pre-rewrite layers are
+    # step-consistent (their input bytes(32) never changed), so a ballot
+    # in one of those few layers may still be replayed; its unresolved
+    # supports default to against within an already-fenced window.
+    boundary = -1
+    stored = {lr[0]: lr[2] for lr in rows}
+    for lr in rows:
+        layer, applied = lr[0], lr[1] or bytes(32)
+        prev = stored.get(layer - 1, bytes(32))
+        if sum256(prev, applied) != lr[2]:
+            boundary = layer
+    if boundary < 0:
+        return
+    # full-chain recompute from genesis: post-boundary layers are
+    # step-consistent but chain over a pre-rewrite PREFIX, so their
+    # absolute values still differ from what a freshly syncing peer
+    # computes over the rewritten ids
+    agg: dict[int, bytes] = {}
+    for lr in rows:
+        layer, applied = lr[0], lr[1] or bytes(32)
+        agg[layer] = sum256(agg.get(layer - 1, bytes(32)), applied)
+        conn.execute("UPDATE layers SET aggregated_hash=? WHERE id=?",
+                     (agg[layer], layer))
+    conn.execute("DELETE FROM certificates WHERE layer<=?", (boundary,))
+    conn.execute("INSERT OR REPLACE INTO migration_marks VALUES"
+                 " ('block_id_rewrite_boundary', ?)", (boundary,))
+
+
+STATE_MIGRATIONS.append(_migrate_0005_rewrite_fixups)
+
 # --- local database (node-private progress) -------------------------------
 
 LOCAL_MIGRATIONS = [

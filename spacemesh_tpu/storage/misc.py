@@ -41,6 +41,25 @@ def beacon_source(db: Database, epoch: int) -> int | None:
     return row["source"] if row else None
 
 
+# --- migration marks -------------------------------------------------------
+
+
+def migration_boundary(db: Database) -> int:
+    """Highest layer whose signed artifacts (ballot vote lists, hare
+    certificates) predate the 0004 block-id rewrite; -1 when the database
+    never held legacy-format blocks. Tortoise.recover replays ballots only
+    strictly after this layer (their support votes name pre-rewrite ids
+    that no longer resolve; persisted per-block validity covers the rest).
+    """
+    import sqlite3
+    try:
+        row = db.one("SELECT value FROM migration_marks"
+                     " WHERE key='block_id_rewrite_boundary'")
+    except sqlite3.OperationalError:
+        return -1  # db migrated before the mark table existed
+    return row["value"] if row else -1
+
+
 # --- certificates ----------------------------------------------------------
 
 

@@ -178,3 +178,131 @@ def test_from_first_block_path():
     set_id, weight, got = gen.generate(current_layer=LPE + 1, target_epoch=1)
     assert sorted(got) == stored
     assert weight == 30
+
+
+def test_declared_set_denominator_overrides_local_view():
+    """A validator whose local ATX view carries MORE weight than the
+    ballot's declared active set must still size slot counts against the
+    declared set (ADVICE r4) — divergent ATX views must not make nodes
+    disagree on ballot validity when the set resolves."""
+    from spacemesh_tpu.consensus.activeset import (active_set_hash,
+                                                   declared_set_weight)
+    from spacemesh_tpu.consensus.eligibility import Oracle
+    from spacemesh_tpu.storage import db as dbmod
+    from spacemesh_tpu.storage import misc as miscstore
+
+    db = dbmod.open_state(":memory:")
+    cache = AtxCache()
+
+    def info(w):
+        return AtxInfo(node_id=b"n" * 32, weight=w, base_height=0,
+                       height=1, num_units=1, vrf_nonce=0,
+                       vrf_public_key=b"n" * 32)
+
+    a, b, c = b"A" * 32, b"B" * 32, b"C" * 32
+    cache.add(1, a, info(100))
+    cache.add(1, b, info(100))
+    cache.add(1, c, info(800))  # local-only ATX the ballot did not declare
+
+    declared = sorted([a, b])
+    root = active_set_hash(declared)
+    miscstore.add_active_set(db, root, 1, declared)
+    assert declared_set_weight(db, cache, 1, root) == 200
+
+    # declared denominators require a nonzero consensus floor (the
+    # dust-set defense); 50 < any honest total here, so it never binds
+    oracle = Oracle(cache, LPE, slots_per_layer=10,
+                    min_weight_table=[(0, 50)])
+    # local denominator 1000 vs declared 200: 5x more slots
+    assert oracle.num_slots(1, a) == 100 * 10 * LPE // 1000
+    assert oracle.num_slots(1, a, 200) == 100 * 10 * LPE // 200
+
+    # unknown root or unresolvable member -> None (caller falls back)
+    assert declared_set_weight(db, cache, 1, b"x" * 32) is None
+    root2 = active_set_hash(sorted([a, b"Z" * 32]))
+    miscstore.add_active_set(db, root2, 1, sorted([a, b"Z" * 32]))
+    assert declared_set_weight(db, cache, 1, root2) is None
+    db.close()
+
+
+def test_handler_fetches_unresolved_declared_set():
+    """A ballot declaring an active set the validator has not stored
+    triggers a fetch by root; once stored, the declared denominator is
+    used (code-review r5: without the fetch, validators silently fall
+    back to local weight and disagree with the builder)."""
+    import asyncio
+
+    from spacemesh_tpu.consensus.activeset import active_set_hash
+    from spacemesh_tpu.consensus.eligibility import Oracle
+    from spacemesh_tpu.consensus.miner import ProposalHandler
+    from spacemesh_tpu.storage import db as dbmod
+    from spacemesh_tpu.storage import misc as miscstore
+
+    db = dbmod.open_state(":memory:")
+    cache = AtxCache()
+    a = b"A" * 32
+    cache.add(1, a, AtxInfo(node_id=b"n" * 32, weight=100, base_height=0,
+                            height=1, num_units=1, vrf_nonce=0,
+                            vrf_public_key=b"n" * 32))
+    root = active_set_hash([a])
+
+    class _Hub:
+        def register(self, topic, fn):
+            pass
+
+    handler = ProposalHandler(
+        db=db, cache=cache,
+        oracle=Oracle(cache, LPE, min_weight_table=[(0, 10)]),
+        tortoise=None, store=None, verifier=None, pubsub=_Hub(),
+        layers_per_epoch=LPE, beacon_getter=None)
+    calls = []
+
+    async def fake_fetch(r):
+        calls.append(r)
+        miscstore.add_active_set(db, r, -1, [a])  # what v_active_set does
+        return True
+
+    handler.fetch_active_set = fake_fetch
+    ed = types.EpochData(beacon=b"\x01" * 4, active_set_root=root,
+                         eligibility_count=1)
+    total = asyncio.run(handler._declared_set_weight(1, ed))
+    assert calls == [root]
+    assert total == 100
+    # second resolution hits the store, no re-fetch
+    assert asyncio.run(handler._declared_set_weight(1, ed)) == 100
+    assert calls == [root]
+    db.close()
+
+
+def test_dust_declared_set_cannot_shrink_denominator():
+    """Security (code-review r5): an attacker declaring a dust active
+    set (only their own ATX) must not collect the epoch's whole slot
+    allotment. Two defenses: without a consensus min-weight floor the
+    declared total is IGNORED (local weight used); with a floor, the
+    floor caps the amplification via max(floor, declared)."""
+    from spacemesh_tpu.consensus.eligibility import Oracle
+
+    cache = AtxCache()
+    attacker = b"E" * 32
+    cache.add(1, attacker, AtxInfo(node_id=b"e" * 32, weight=10,
+                                   base_height=0, height=1, num_units=1,
+                                   vrf_nonce=0, vrf_public_key=b"e" * 32))
+    for i in range(9):  # honest weight dwarfs the attacker
+        cache.add(1, bytes([i]) * 32,
+                  AtxInfo(node_id=bytes([i]) * 32, weight=1000,
+                          base_height=0, height=1, num_units=1,
+                          vrf_nonce=0, vrf_public_key=bytes([i]) * 32))
+
+    # no floor configured: the declared dust total is not trusted
+    o_nofloor = Oracle(cache, LPE, slots_per_layer=10)
+    assert not o_nofloor.trusts_declared(1)
+    assert o_nofloor.num_slots(1, attacker, 10) \
+        == o_nofloor.num_slots(1, attacker)
+
+    # floor configured: denominator = max(5000, 10), not 10
+    o_floor = Oracle(cache, LPE, slots_per_layer=10,
+                     min_weight_table=[(0, 5000)])
+    assert o_floor.trusts_declared(1)
+    slots = o_floor.num_slots(1, attacker, 10)
+    assert slots == max(10 * 10 * LPE // 5000, 1)
+    assert slots < 10 * LPE  # nowhere near the epoch allotment

@@ -1,13 +1,18 @@
 """State-DB migration 0004: Reward gained atx_id — old block blobs must
 re-encode on open, with block ids (content hashes) and the tables that
-point at them following."""
+point at them following. Derived data the rewrite invalidates (chained
+aggregated layer hashes, signed certificates, ballot vote lists) must be
+recomputed, dropped, or fenced off behind the recorded boundary layer
+(ADVICE r4)."""
 
 import io
 
 from spacemesh_tpu.core import codec, types
+from spacemesh_tpu.core.hashing import sum256
 from spacemesh_tpu.storage import blocks as blockstore
 from spacemesh_tpu.storage import db as dbmod
 from spacemesh_tpu.storage import layers as layerstore
+from spacemesh_tpu.storage import misc as miscstore
 
 
 def _legacy_block_bytes(layer, tick, rewards, tx_ids):
@@ -28,12 +33,11 @@ def test_migration_reencodes_legacy_blocks(tmp_path):
     old = dbmod.Database(path, dbmod.STATE_MIGRATIONS[:3], name="state")
     coinbase = b"\x07" * 24
     data = _legacy_block_bytes(5, 9, [(coinbase, 3)], [b"\x21" * 32])
-    from spacemesh_tpu.core.hashing import sum256
     old_id = sum256(data)
     old.exec("INSERT INTO blocks (id, layer, data) VALUES (?,?,?)",
              (old_id, 5, data))
-    old.exec("INSERT INTO layers (id, applied_block) VALUES (?,?)",
-             (5, old_id))
+    old.exec("INSERT INTO layers (id, applied_block, aggregated_hash)"
+             " VALUES (?,?,?)", (5, old_id, sum256(bytes(32), old_id)))
     old.exec("INSERT INTO certificates (layer, block_id) VALUES (?,?)",
              (5, old_id))
     old.close()
@@ -47,9 +51,146 @@ def test_migration_reencodes_legacy_blocks(tmp_path):
                                       weight=3)]
     assert b.id != old_id
     assert layerstore.applied_block(state, 5) == b.id
-    assert state.one("SELECT block_id FROM certificates WHERE layer=5")[
-        "block_id"] == b.id
+    # certificates are signed over the OLD id and cannot be re-signed:
+    # the migration drops them instead of rewriting the column
+    assert state.one("SELECT COUNT(*) c FROM certificates")["c"] == 0
+    # the boundary mark fences pre-rewrite signed ballots off from recovery
+    assert miscstore.migration_boundary(state) == 5
     # idempotent: reopening does not re-run (user_version advanced)
     state.close()
     state2 = dbmod.open_state(path)
     assert len(blockstore.in_layer(state2, 5)) == 1
+    assert miscstore.migration_boundary(state2) == 5
+
+
+def test_migration_recomputes_aggregated_hash_chain(tmp_path):
+    """agg(L) = H(agg(L-1) || applied_block) chains over the REWRITTEN ids
+    after the migration — a freshly syncing peer computing the chain from
+    the new blocks must agree with the upgraded node's stored values."""
+    path = tmp_path / "state.db"
+    old = dbmod.Database(path, dbmod.STATE_MIGRATIONS[:3], name="state")
+    ids = {}
+    agg = bytes(32)
+    for layer in (1, 2, 3):
+        data = _legacy_block_bytes(layer, 0, [(b"\x01" * 24, layer)], [])
+        ids[layer] = sum256(data)
+        agg = sum256(agg, ids[layer])  # pre-migration chain (old ids)
+        old.exec("INSERT INTO blocks (id, layer, data) VALUES (?,?,?)",
+                 (ids[layer], layer, data))
+        old.exec("INSERT INTO layers (id, applied_block, aggregated_hash)"
+                 " VALUES (?,?,?)", (layer, ids[layer], agg))
+    old.close()
+
+    state = dbmod.open_state(path)
+    expect = bytes(32)
+    for layer in (1, 2, 3):
+        new_id = layerstore.applied_block(state, layer)
+        assert new_id != ids[layer]
+        expect = sum256(expect, new_id)
+        assert layerstore.aggregated_hash(state, layer) == expect
+    assert miscstore.migration_boundary(state) == 3
+    state.close()
+
+
+def test_version4_database_gets_fixups_via_0005(tmp_path):
+    """A database already migrated to version 4 by the previous build
+    (ids rewritten, derived data left stale) must still receive the
+    repairs — 0005 detects the stale aggregated-hash chain on its own
+    (0004 cannot be amended: version-4 databases never re-run it)."""
+    path = tmp_path / "state.db"
+    old = dbmod.Database(path, dbmod.STATE_MIGRATIONS[:3], name="state")
+    data = _legacy_block_bytes(7, 1, [(b"\x09" * 24, 2)], [])
+    old_id = sum256(data)
+    old.exec("INSERT INTO blocks (id, layer, data) VALUES (?,?,?)",
+             (old_id, 7, data))
+    old.exec("INSERT INTO layers (id, applied_block, aggregated_hash)"
+             " VALUES (?,?,?)", (7, old_id, sum256(bytes(32), old_id)))
+    old.exec("INSERT INTO certificates (layer, block_id) VALUES (?,?)",
+             (7, old_id))
+    old.close()
+    # version 4 as the old code left it: rewrite done, fixups absent
+    mid = dbmod.Database(path, dbmod.STATE_MIGRATIONS[:4], name="state")
+    assert mid.one("SELECT COUNT(*) c FROM certificates")["c"] == 1
+    new_id = layerstore.applied_block(mid, 7)
+    assert new_id != old_id
+    assert layerstore.aggregated_hash(mid, 7) == sum256(bytes(32), old_id)
+    mid.close()
+
+    state = dbmod.open_state(path)  # 0005 runs
+    assert layerstore.aggregated_hash(state, 7) == sum256(bytes(32), new_id)
+    assert state.one("SELECT COUNT(*) c FROM certificates")["c"] == 0
+    assert miscstore.migration_boundary(state) == 7
+    state.close()
+
+
+def test_0005_fences_only_pre_rewrite_layers(tmp_path):
+    """A version-4 node that kept RUNNING after the rewrite has valid
+    post-rewrite layers, certificates, and ballots — 0005 must localize
+    the boundary with the step relation and fence only at/below it
+    (code-review r5: over-fencing discarded weeks of valid state)."""
+    from spacemesh_tpu.core.types import Block
+
+    path = tmp_path / "state.db"
+    old = dbmod.Database(path, dbmod.STATE_MIGRATIONS[:3], name="state")
+    data = _legacy_block_bytes(1, 0, [(b"\x01" * 24, 1)], [])
+    old_id = sum256(data)
+    old.exec("INSERT INTO blocks (id, layer, data) VALUES (?,?,?)",
+             (old_id, 1, data))
+    stale_agg = sum256(bytes(32), old_id)
+    old.exec("INSERT INTO layers (id, applied_block, aggregated_hash)"
+             " VALUES (?,?,?)", (1, old_id, stale_agg))
+    old.exec("INSERT INTO certificates (layer, block_id) VALUES (?,?)",
+             (1, old_id))
+    old.close()
+    # version-4 code rewrites layer 1's ids; the node then keeps running
+    # and applies layer 2 with a NEW-format block, chaining its agg hash
+    # on the (stale-prefixed) stored value — step-consistent
+    mid = dbmod.Database(path, dbmod.STATE_MIGRATIONS[:4], name="state")
+    new1 = layerstore.applied_block(mid, 1)
+    assert new1 != old_id
+    blk2 = Block(layer=2, tick_height=0, rewards=[], tx_ids=[])
+    mid.exec("INSERT INTO blocks (id, layer, data) VALUES (?,?,?)",
+             (blk2.id, 2, blk2.to_bytes()))
+    mid.exec("INSERT INTO layers (id, applied_block, aggregated_hash)"
+             " VALUES (?,?,?)", (2, blk2.id, sum256(stale_agg, blk2.id)))
+    mid.exec("INSERT INTO certificates (layer, block_id) VALUES (?,?)",
+             (2, blk2.id))
+    mid.close()
+
+    state = dbmod.open_state(path)  # 0005
+    assert miscstore.migration_boundary(state) == 1
+    # pre-rewrite cert dropped, valid post-rewrite cert KEPT
+    certs = [r["layer"] for r in
+             state.all("SELECT layer FROM certificates ORDER BY layer")]
+    assert certs == [2]
+    # full chain recomputed from genesis over rewritten ids
+    assert layerstore.aggregated_hash(state, 1) == sum256(bytes(32), new1)
+    assert layerstore.aggregated_hash(state, 2) \
+        == sum256(sum256(bytes(32), new1), blk2.id)
+    state.close()
+
+
+def test_0005_is_noop_on_consistent_chain(tmp_path):
+    """A database whose chain already matches (never held legacy blocks)
+    keeps its certificates and gets no boundary."""
+    path = tmp_path / "state.db"
+    mid = dbmod.Database(path, dbmod.STATE_MIGRATIONS[:4], name="state")
+    from spacemesh_tpu.core.types import Block, Certificate
+    blk = Block(layer=3, tick_height=0, rewards=[], tx_ids=[])
+    mid.exec("INSERT INTO blocks (id, layer, data) VALUES (?,?,?)",
+             (blk.id, 3, blk.to_bytes()))
+    mid.exec("INSERT INTO layers (id, applied_block, aggregated_hash)"
+             " VALUES (?,?,?)", (3, blk.id, sum256(bytes(32), blk.id)))
+    mid.exec("INSERT INTO certificates (layer, block_id) VALUES (?,?)",
+             (3, blk.id))
+    mid.close()
+    state = dbmod.open_state(path)
+    assert state.one("SELECT COUNT(*) c FROM certificates")["c"] == 1
+    assert miscstore.migration_boundary(state) == -1
+    state.close()
+
+
+def test_fresh_database_has_no_boundary(tmp_path):
+    state = dbmod.open_state(tmp_path / "state.db")
+    assert miscstore.migration_boundary(state) == -1
+    state.close()

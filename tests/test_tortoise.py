@@ -298,6 +298,40 @@ def test_recover_roundtrip(tmp_path):
     assert t._hare.get(2) == blk.id
 
 
+def test_recover_skips_ballots_at_or_below_migration_boundary(tmp_path):
+    """Ballots at or below the 0004 block-id-rewrite boundary carry signed
+    vote lists over pre-rewrite ids; recover must not replay them (their
+    supports would all resolve as against), while later ballots load."""
+    from spacemesh_tpu.consensus.eligibility import Oracle
+    from spacemesh_tpu.core.types import VotingEligibility
+    from spacemesh_tpu.storage import ballots as ballotstore
+    from spacemesh_tpu.storage import db as dbmod
+    from spacemesh_tpu.storage import layers as layerstore
+
+    db = dbmod.open_state(":memory:")
+    cache = _cache(weight=100)
+
+    def stored_ballot(layer, tag):
+        op = Opinion(base=EMPTY, support=[], against=[], abstain=[])
+        return Ballot(layer=layer, atx_id=b"atx-%02d" % (layer // LPE)
+                      + bytes(26), node_id=b"n" * 32, epoch_data=None,
+                      ref_ballot=bytes(32), opinion=op,
+                      eligibilities=[VotingEligibility(j=0, sig=bytes(80))],
+                      signature=tag.ljust(64, b"\0"))
+
+    pre, post = stored_ballot(2, b"pre"), stored_ballot(6, b"post")
+    ballotstore.add(db, pre)
+    ballotstore.add(db, post)
+    layerstore.set_processed(db, 6)
+    db.exec("INSERT OR REPLACE INTO migration_marks VALUES"
+            " ('block_id_rewrite_boundary', 3)")
+
+    t = Tortoise.recover(db, cache, Oracle(cache, LPE),
+                         layers_per_epoch=LPE, hdist=3, zdist=2, window=100)
+    assert post.id in t._ballots
+    assert pre.id not in t._ballots
+
+
 def test_tally_speed_vs_scalar_loop():
     """The mat-vec tally must beat a per-ballot Python recount by a wide
     margin on a realistic window (informational: prints the ratio; asserts
