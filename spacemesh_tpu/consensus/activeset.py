@@ -64,6 +64,9 @@ def num_eligible_slots(weight: int, min_weight: int, total_weight: int,
     return max(num, 1)
 
 
+_SET_WEIGHT_MEMO_MAX = 256
+
+
 def declared_set_weight(db: Database, cache: AtxCache, epoch: int,
                         root: bytes) -> int | None:
     """Total weight of the stored active set with this root, when every
@@ -72,9 +75,23 @@ def declared_set_weight(db: Database, cache: AtxCache, epoch: int,
     nodes with divergent views would otherwise disagree on ballot
     validity (reference proposals/eligibility_validator.go validates
     against the ref ballot's declared set; ADVICE r4). None → caller
-    falls back to the local epoch weight."""
+    falls back to the local epoch weight.
+
+    Fully-resolved sums are memoized by (epoch, root) ON THE NODE'S
+    cache (per-node state, not module-global — separate nodes in one
+    process have separate views): thousands of ref ballots per epoch
+    declare the same root, and ATX weight is intrinsic (num_units x
+    ticks), so the sum is stable once every member resolved —
+    re-summing a mainnet-shape set per ballot is O(smeshers x set_size)
+    wasted work (code-review r5)."""
     from ..storage import misc as miscstore
 
+    memo = getattr(cache, "_set_weight_memo", None)
+    if memo is None:
+        memo = cache._set_weight_memo = {}
+    hit = memo.get((epoch, root))
+    if hit is not None:
+        return hit
     ids = miscstore.active_set(db, root)
     if ids is None:
         return None
@@ -84,6 +101,10 @@ def declared_set_weight(db: Database, cache: AtxCache, epoch: int,
         if member is None:
             return None
         total += member.weight
+    if total:
+        if len(memo) >= _SET_WEIGHT_MEMO_MAX:
+            memo.pop(next(iter(memo)))
+        memo[(epoch, root)] = total
     return total or None
 
 

@@ -118,10 +118,24 @@ class Oracle:
 
     def validate_slot(self, beacon: bytes, epoch: int, atx_id: bytes,
                       layer: int, j: int, proof: bytes,
-                      total_override: int | None = None) -> bool:
+                      total_override: int | None = None,
+                      num_slots_override: int | None = None) -> bool:
+        """``num_slots_override`` is the eligibility count already
+        validated on the smesher's ref ballot — secondary ballots are
+        bounded by THAT count, not a recomputation (reference
+        eligibility_validator.go validateSecondary returns the ref
+        ballot's stored EligibilityCount)."""
+        info = self.cache.get(epoch, atx_id)
+        if info is None or info.malicious:
+            # the override must NOT bypass the malfeasance gate a
+            # num_slots recomputation would apply — a detected
+            # equivocator's later ballots lose eligibility immediately
+            # (code-review r5)
+            return False
         key = self.vrf_key(epoch, atx_id)
-        if key is None or j >= self.num_slots(epoch, atx_id,
-                                              total_override):
+        bound = num_slots_override if num_slots_override is not None \
+            else self.num_slots(epoch, atx_id, total_override)
+        if key is None or j >= bound:
             return False
         if not self._vrf.verify(key, proposal_alpha(beacon, epoch, j), proof):
             return False

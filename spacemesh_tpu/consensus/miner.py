@@ -178,6 +178,10 @@ class ProposalHandler:
         # resolvable, or validators fall back to their local epoch
         # weight and disagree with the builder (code-review r5)
         self.fetch_active_set = None
+        # async ballot_id -> bool; wired to HINT_BALLOT fetch — a
+        # secondary ballot arriving before its ref ballot must fetch it,
+        # not be permanently rejected by delivery order
+        self.fetch_ballot = None
         pubsub.register(TOPIC_PROPOSAL, self._gossip)
 
     async def _gossip(self, peer: bytes, data: bytes) -> bool:
@@ -230,18 +234,54 @@ class ProposalHandler:
         # majority chain's ballots survive a local beacon divergence
         # while a grinding adversary can't steer margins immediately.
         local_beacon = await self.beacon_getter(epoch)
-        epoch_data = ballotstore.resolve_epoch_data(self.db, ballot)
-        declared = epoch_data.beacon if epoch_data is not None else None
-        beacon = declared if declared is not None else local_beacon
-        bad_beacon = declared is not None and declared != local_beacon
-        declared_total = None
-        if self.oracle.trusts_declared(epoch):
-            declared_total = await self._declared_set_weight(epoch,
-                                                             epoch_data)
+        trusted = self.oracle.trusts_declared(epoch)
+        if ballot.epoch_data is not None:
+            # REF ballot: the smesher's first of the epoch. Its
+            # eligibility count is computed from the DECLARED active
+            # set's weight and checked against the declared count —
+            # exactly ONCE per (smesher, epoch); every later ballot
+            # reuses the validated number (reference
+            # eligibility_validator.go validateReference).
+            epoch_data = ballot.epoch_data
+            declared_total = await self._declared_set_weight(
+                epoch, epoch_data) if trusted else None
+            if trusted and declared_total is None:
+                # an unresolvable declared set must REJECT, not fall
+                # back: skipping the count check would store an
+                # attacker-chosen eligibility_count that every later
+                # secondary ballot (and restart recovery) trusts as its
+                # slot bound (code-review r5; reference
+                # validateReference errors when the set can't be
+                # resolved — sync redelivers once it is fetchable)
+                return False
+            bound = self.oracle.num_slots(epoch, ballot.atx_id,
+                                          declared_total)
+            if trusted and epoch_data.eligibility_count != bound:
+                return False
+        else:
+            # SECONDARY ballot: must share smesher AND atx with its ref
+            # ballot, whose validated eligibility count bounds j. A
+            # missing ref is fetched (gossip order must not decide
+            # validity — code-review r5), then the ballot is dropped if
+            # still unresolvable; sync redelivers in layer order.
+            ref = ballotstore.get(self.db, ballot.ref_ballot)
+            if ref is None and self.fetch_ballot is not None:
+                try:
+                    await self.fetch_ballot(ballot.ref_ballot)
+                except Exception:
+                    pass
+                ref = ballotstore.get(self.db, ballot.ref_ballot)
+            epoch_data = ballotstore.resolve_epoch_data(self.db, ballot)
+            if epoch_data is None:
+                return False
+            bound = epoch_data.eligibility_count if trusted \
+                else self.oracle.num_slots(epoch, ballot.atx_id)
+        beacon = epoch_data.beacon
+        bad_beacon = beacon != local_beacon
         for el in ballot.eligibilities:
             if not self.oracle.validate_slot(beacon, epoch, ballot.atx_id,
                                              ballot.layer, el.j, el.sig,
-                                             declared_total):
+                                             num_slots_override=bound):
                 return False
         # double ballot in one (layer, signer) slot set -> malfeasance
         existing = ballotstore.by_node_in_layer(self.db, ballot.node_id,
@@ -254,9 +294,7 @@ class ProposalHandler:
                 return False
         with self.db.tx():
             ballotstore.add(self.db, ballot)
-        num_slots = self.oracle.num_slots(epoch, ballot.atx_id,
-                                          declared_total)
-        unit = info.weight // max(num_slots, 1)
+        unit = info.weight // max(bound, 1)
         self.tortoise.on_ballot(ballot, unit * len(ballot.eligibilities),
                                 bad_beacon=bad_beacon)
         return True if not bad_beacon else BAD_BEACON

@@ -730,17 +730,15 @@ class Tortoise:
                     continue
                 # shared with live ingest (miner.ingest_ballot) —
                 # recover must not flag ballots the live path left
-                # unflagged, nor weigh them differently
+                # unflagged, nor weigh them differently: the stored
+                # (already-validated) ref-ballot eligibility count
+                # bounds the per-eligibility weight on trusted
+                # networks, the local recomputation otherwise
                 epoch_data = ballotstore.resolve_epoch_data(db, ballot)
-                # per-eligibility weight uses the DECLARED active set's
-                # weight exactly like live ingest — a restart must not
-                # change ballot weights (code-review r5)
-                declared_total = None
                 if epoch_data is not None and oracle.trusts_declared(epoch):
-                    from .activeset import declared_set_weight
-                    declared_total = declared_set_weight(
-                        db, cache, epoch, epoch_data.active_set_root)
-                num = oracle.num_slots(epoch, ballot.atx_id, declared_total)
+                    num = epoch_data.eligibility_count
+                else:
+                    num = oracle.num_slots(epoch, ballot.atx_id)
                 unit = info.weight // max(num, 1)
                 declared = epoch_data.beacon if epoch_data is not None \
                     else None

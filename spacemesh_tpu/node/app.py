@@ -64,7 +64,8 @@ class App:
         self.clock = clock_mod.LayerClock(cfg.genesis.time, cfg.layer_duration,
                                           time_source=time_source)
         self.pubsub = pubsub or PubSub(node_name=self.signer.node_id)
-        self.state = dbmod.open_state(self.data / "state.db")
+        self.state = dbmod.open_state(self.data / "state.db",
+                                      read_pool=cfg.db_read_pool)
         self.local = dbmod.open_local(self.data / "local.db")
         self.cache = AtxCache()
         self.golden_atx = sum256(b"golden", prefix)
@@ -475,6 +476,13 @@ class App:
 
             if active_set_root(ids) != set_id:  # content-addressed
                 return False
+            # members we don't know yet are fetched like the reference's
+            # handleSet (proposals/handler.go:225) — the declared set's
+            # weight is only computable once every member resolves
+            missing = [a for a in ids
+                       if atxstore.get(self.state, a) is None]
+            if missing:
+                await self.fetch.get_hashes(fetch_mod.HINT_ATX, missing)
             # epoch unknown at fetch time: -1 keeps the row out of the
             # pruner's epoch-horizon deletes (it prunes epoch>=0 only)
             miscstore.add_active_set(self.state, set_id, -1, ids)
@@ -503,11 +511,18 @@ class App:
                                               [root])
             return bool(got.get(root))
 
+        async def fetch_ballot(ballot_id: bytes) -> bool:
+            got = await self.fetch.get_hashes(fetch_mod.HINT_BALLOT,
+                                              [ballot_id])
+            return bool(got.get(ballot_id))
+
         # ballots declare active sets by root; eligibility validation
         # resolves the declared set (fetching it if unseen) so nodes
-        # with divergent ATX views agree on slot counts (ADVICE r4 +
-        # code-review r5)
+        # with divergent ATX views agree on slot counts, and secondary
+        # ballots fetch a missing ref ballot instead of letting gossip
+        # order decide validity (ADVICE r4 + code-review r5)
         self.proposal_handler.fetch_active_set = fetch_active_set
+        self.proposal_handler.fetch_ballot = fetch_ballot
 
         # index endpoints
         async def serve_epoch(peer: bytes, data: bytes) -> bytes:

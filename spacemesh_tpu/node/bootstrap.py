@@ -137,7 +137,19 @@ class Pruner:
 
     async def run(self) -> None:
         while not self._stop:
-            await asyncio.to_thread(self.prune_once)
+            # maintenance failures (a transient "database is locked"
+            # from a slow reader, a full disk) must not kill the
+            # retention loop for the life of the node — log and retry
+            # next tick (code-review r5)
+            try:
+                await asyncio.to_thread(self.prune_once)
+                # retention deletes leave free pages; reclaim them when
+                # the freelist crosses the threshold (reference
+                # sql/vacuum.go — scheduled maintenance alongside
+                # pruning, not per-write)
+                await asyncio.to_thread(self.db.maybe_vacuum)
+            except Exception:
+                log.exception("prune/vacuum tick failed; will retry")
             await asyncio.sleep(self.interval)
 
     def stop(self) -> None:

@@ -135,6 +135,9 @@ class Config:
     layer_duration: float = 300.0          # mainnet: 5 min layers
     layers_per_epoch: int = 4032           # 2 weeks
     slots_per_layer: int = 50              # proposal slots (epoch total / lpe)
+    db_read_pool: int = 4                  # read-only sqlite connections
+    # (WAL snapshot readers — API/sync reads don't serialize behind the
+    #  writer lock; 0 disables, :memory: databases never pool)
     min_active_set_weight: list = dataclasses.field(default_factory=list)
     # ^ [(epoch, weight)] ascending — reference miner/minweight table
     #   (config/mainnet.go MinimalActiveSetWeight).

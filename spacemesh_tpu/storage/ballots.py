@@ -21,17 +21,20 @@ def get(db: Database, ballot_id: bytes) -> Ballot | None:
 
 def resolve_epoch_data(db: Database, ballot: Ballot):
     """The ballot's own EpochData, else its ref ballot's — accepted only
-    from the same owner (a ballot must not inherit another identity's
-    epoch declaration). ONE definition shared by live ingest
-    (miner.ingest_ballot) and restart recovery (Tortoise.recover): the
-    two paths must derive identical beacons and declared active sets,
-    or a restart changes ballot weights and bad-beacon flags
-    (code-review r5)."""
+    from the same owner AND the same ATX (reference
+    eligibility_validator.go validateSecondary: a ballot must share its
+    atx with its reference ballot; it must not inherit another
+    identity's epoch declaration either). ONE definition shared by live
+    ingest (miner.ingest_ballot) and restart recovery
+    (Tortoise.recover): the two paths must derive identical beacons and
+    eligibility counts, or a restart changes ballot weights and
+    bad-beacon flags (code-review r5)."""
     if ballot.epoch_data is not None:
         return ballot.epoch_data
     ref = get(db, ballot.ref_ballot)
     if ref is not None and ref.epoch_data is not None \
-            and ref.node_id == ballot.node_id:
+            and ref.node_id == ballot.node_id \
+            and ref.atx_id == ballot.atx_id:
         return ref.epoch_data
     return None
 

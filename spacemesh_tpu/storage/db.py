@@ -15,30 +15,71 @@ transactions; WAL journaling for file databases.
 from __future__ import annotations
 
 import contextlib
+import queue
 import sqlite3
 import threading
+import time
 from pathlib import Path
 
 
 class Database:
-    """A single sqlite database handle, thread-safe via a lock.
+    """One writer sqlite handle plus an optional read-only pool.
 
     The control plane is asyncio/single-threaded per subsystem; the lock
     makes cross-thread use (post worker callbacks, API server) safe.
+
+    ``read_pool`` (reference sql/database.go: a pooled connection set so
+    API reads don't serialize behind the writer) opens that many extra
+    read-only connections for file databases in WAL mode — WAL readers
+    see a consistent snapshot and never block the writer or each other.
+    ``one``/``all`` borrow from the pool except when the CALLING thread
+    holds an open transaction (its uncommitted writes are only visible
+    on the writer handle). In-memory databases cannot pool (each sqlite
+    connection to ":memory:" is a distinct database) and keep the
+    single-handle behavior.
+
+    Every query records its latency in the global metrics registry
+    (reference sql/metrics.go) under ``sql_<name>_query_seconds``.
     """
 
     def __init__(self, path: str | Path, migrations: list[str],
-                 name: str = "db"):
+                 name: str = "db", read_pool: int = 0):
         self.path = str(path)
         self.name = name
         self._conn = sqlite3.connect(
             self.path, isolation_level=None, check_same_thread=False)
         self._conn.row_factory = sqlite3.Row
         self._lock = threading.RLock()
+        self._tx_owner: int | None = None
         if self.path != ":memory:":
+            # incremental auto-vacuum: maybe_vacuum reclaims free pages
+            # in bounded chunks instead of a full-database VACUUM that
+            # would hold the writer lock for minutes on a mainnet-shape
+            # db (code-review r5). MUST precede journal_mode=WAL — the
+            # WAL switch initializes page 1, after which the pragma is a
+            # silent no-op. Pre-existing dbs without it never reclaim
+            # (retrofitting needs a full offline VACUUM).
+            self._conn.execute("PRAGMA auto_vacuum=INCREMENTAL")
             self._conn.execute("PRAGMA journal_mode=WAL")
         self._conn.execute("PRAGMA foreign_keys=ON")
+        from ..utils import metrics as _metrics
+        self._latency = _metrics.REGISTRY.histogram(
+            f"sql_{name}_query_seconds",
+            f"{name} db query latency",
+            buckets=(0.0005, 0.005, 0.05, 0.5, 5.0, float("inf")))
+        self._queries = _metrics.REGISTRY.counter(
+            f"sql_{name}_queries", f"{name} db queries executed")
+        self._readers: queue.SimpleQueue | None = None
+        self._pool_closed = False
         self._migrate(migrations)
+        if read_pool > 0 and self.path != ":memory:":
+            self._readers = queue.SimpleQueue()
+            for _ in range(read_pool):
+                rc = sqlite3.connect(self.path, isolation_level=None,
+                                     check_same_thread=False)
+                rc.row_factory = sqlite3.Row
+                rc.execute("PRAGMA query_only=ON")
+                self._readers.put(rc)
 
     def _migrate(self, migrations: list) -> None:
         # NOTE: executescript() implicitly commits any open transaction, so
@@ -55,10 +96,22 @@ class Database:
                     f"than this build supports ({len(migrations)})")
             for i in range(version, len(migrations)):
                 if callable(migrations[i]):
-                    migrations[i](self._conn)
+                    # data rewrites must be atomic WITH the version bump:
+                    # autocommit would persist a half-rewritten state on
+                    # a crash, and a rerun over partial output can
+                    # mis-detect what it is repairing (code-review r5 on
+                    # 0005's boundary scan)
+                    self._conn.execute("BEGIN IMMEDIATE")
+                    try:
+                        migrations[i](self._conn)
+                        self._conn.execute(f"PRAGMA user_version={i + 1}")
+                    except BaseException:
+                        self._conn.execute("ROLLBACK")
+                        raise
+                    self._conn.execute("COMMIT")
                 else:
                     self._conn.executescript(migrations[i])
-                self._conn.execute(f"PRAGMA user_version={i + 1}")
+                    self._conn.execute(f"PRAGMA user_version={i + 1}")
 
     @contextlib.contextmanager
     def tx(self):
@@ -69,6 +122,7 @@ class Database:
                 yield self._conn
                 return
             self._conn.execute("BEGIN IMMEDIATE")
+            self._tx_owner = threading.get_ident()
             try:
                 yield self._conn
             except BaseException:
@@ -76,26 +130,96 @@ class Database:
                 raise
             else:
                 self._conn.execute("COMMIT")
+            finally:
+                self._tx_owner = None
+
+    @contextlib.contextmanager
+    def _timed(self):
+        start = time.perf_counter()
+        try:
+            yield
+        finally:
+            self._latency.observe(time.perf_counter() - start)
+            self._queries.inc()
+
+    @contextlib.contextmanager
+    def _read_conn(self):
+        """A connection for a read: a pooled read-only handle when one
+        exists and the calling thread is not inside tx() (uncommitted
+        writes are only visible on the writer handle)."""
+        if self._readers is None \
+                or self._tx_owner == threading.get_ident():
+            with self._lock:
+                yield self._conn
+            return
+        rc = self._readers.get()
+        try:
+            yield rc
+        finally:
+            if self._pool_closed:
+                rc.close()
+            else:
+                self._readers.put(rc)
 
     def exec(self, sql: str, params=()) -> sqlite3.Cursor:
-        with self._lock:
+        with self._timed(), self._lock:
             return self._conn.execute(sql, params)
 
     def one(self, sql: str, params=()):
-        with self._lock:
-            return self._conn.execute(sql, params).fetchone()
+        with self._timed(), self._read_conn() as conn:
+            return conn.execute(sql, params).fetchone()
 
     def all(self, sql: str, params=()):
-        with self._lock:
-            return self._conn.execute(sql, params).fetchall()
+        with self._timed(), self._read_conn() as conn:
+            return conn.execute(sql, params).fetchall()
 
     def close(self) -> None:
+        # the queue object stays — a reader borrowed by another thread
+        # returns through _read_conn's finally, which checks this flag
+        # and closes it instead of re-pooling (code-review r5: nulling
+        # the queue raced the in-flight return)
+        self._pool_closed = True
+        if self._readers is not None:
+            while True:
+                try:
+                    self._readers.get_nowait().close()
+                except queue.Empty:
+                    break
         with self._lock:
             self._conn.close()
 
     def vacuum(self) -> None:
         with self._lock:
             self._conn.execute("VACUUM")
+
+    def maybe_vacuum(self, min_free_fraction: float = 0.2,
+                     max_pages: int = 512) -> bool:
+        """Reclaim free pages when the freelist says it is worth it
+        (reference sql/vacuum.go: scheduled maintenance, not per-write).
+        Uses ``PRAGMA incremental_vacuum`` bounded to ``max_pages`` per
+        call so the writer lock is held for a bounded slice, never a
+        full-database rewrite; the pruner's next tick continues the
+        reclaim. Returns True if pages were reclaimed. Falls back to a
+        full VACUUM only where incremental mode is unavailable
+        (pre-existing dbs created without auto_vacuum)."""
+        with self._lock:
+            pages = self._conn.execute("PRAGMA page_count").fetchone()[0]
+            free = self._conn.execute("PRAGMA freelist_count").fetchone()[0]
+            if pages == 0 or free / pages < min_free_fraction:
+                return False
+            mode = self._conn.execute("PRAGMA auto_vacuum").fetchone()[0]
+            if mode != 2:
+                # a full VACUUM here would hold the writer lock for the
+                # whole database rewrite — exactly the stall this method
+                # exists to avoid. Databases created before incremental
+                # mode keep their freelist; the operator can run
+                # vacuum() offline (code-review r5).
+                return False
+            # streaming pragma: each cursor step frees one page — the
+            # cursor must be drained or only a single page is reclaimed
+            self._conn.execute(
+                f"PRAGMA incremental_vacuum({max_pages})").fetchall()
+            return True
 
 
 # --- state database (replicated consensus data) ---------------------------
@@ -379,9 +503,11 @@ LOCAL_MIGRATIONS = [
 ]
 
 
-def open_state(path: str | Path = ":memory:") -> Database:
+def open_state(path: str | Path = ":memory:",
+               read_pool: int = 0) -> Database:
     """The replicated consensus database (reference sql/statesql)."""
-    return Database(path, STATE_MIGRATIONS, name="state")
+    return Database(path, STATE_MIGRATIONS, name="state",
+                    read_pool=read_pool)
 
 
 def open_local(path: str | Path = ":memory:") -> Database:

@@ -1,0 +1,194 @@
+"""Ballot ingestion semantics around declared active sets (reference
+proposals/eligibility_validator.go):
+
+- a REF ballot's eligibility count is recomputed from its declared
+  set's weight and must MATCH the declared count (validateReference);
+- SECONDARY ballots reuse the ref ballot's validated count — never a
+  local recomputation — and must share smesher + atx with the ref
+  (validateSecondary);
+- a secondary arriving before its ref fetches the ref instead of
+  letting gossip delivery order decide validity (code-review r5).
+
+Crypto is stubbed (verifier/oracle validate_slot); what's under test is
+the ingestion state machine, not ed25519/ECVRF.
+"""
+
+import asyncio
+
+import pytest
+
+from spacemesh_tpu.consensus.activeset import active_set_hash
+from spacemesh_tpu.consensus.miner import BAD_BEACON, ProposalHandler
+from spacemesh_tpu.core.types import (
+    Ballot,
+    EpochData,
+    Opinion,
+    VotingEligibility,
+)
+from spacemesh_tpu.storage import ballots as ballotstore
+from spacemesh_tpu.storage import db as dbmod
+from spacemesh_tpu.storage import misc as miscstore
+from spacemesh_tpu.storage.cache import AtxCache, AtxInfo
+
+LPE = 4
+BEACON = b"\x0b" * 4
+NODE = b"n" * 32
+ATX = b"A" * 32
+
+
+class _Verifier:
+    def verify(self, domain, node_id, msg, sig):
+        return True
+
+
+class _Oracle:
+    """validate_slot honors ONLY num_slots_override (the handler must
+    always pass the validated bound); num_slots mirrors the slot
+    formula weight*10 // total."""
+
+    def __init__(self, cache):
+        self.cache = cache
+
+    def trusts_declared(self, epoch):
+        return True
+
+    def num_slots(self, epoch, atx_id, total_override=None):
+        info = self.cache.get(epoch, atx_id)
+        total = total_override if total_override is not None \
+            else self.cache.epoch_weight(epoch)
+        return info.weight * 10 // total if total else 0
+
+    def validate_slot(self, beacon, epoch, atx_id, layer, j, proof,
+                      total_override=None, num_slots_override=None):
+        assert num_slots_override is not None, \
+            "handler must pass the validated bound"
+        return j < num_slots_override
+
+
+class _Tortoise:
+    def __init__(self):
+        self.ballots = []
+
+    def on_ballot(self, ballot, weight, bad_beacon=False):
+        self.ballots.append((ballot.id, weight, bad_beacon))
+
+
+class _Store:
+    def add(self, proposal):
+        pass
+
+
+class _Hub:
+    def register(self, topic, fn):
+        pass
+
+
+def _setup():
+    db = dbmod.open_state(":memory:")
+    cache = AtxCache()
+    cache.add(1, ATX, AtxInfo(node_id=NODE, weight=100, base_height=0,
+                              height=1, num_units=1, vrf_nonce=0,
+                              vrf_public_key=NODE))
+    other = b"B" * 32
+    cache.add(1, other, AtxInfo(node_id=b"o" * 32, weight=900,
+                                base_height=0, height=1, num_units=1,
+                                vrf_nonce=0, vrf_public_key=b"o" * 32))
+    # the DECLARED set is just {ATX}: weight 200? no — weight 100, so
+    # declared denominator 100 vs local 1000
+    root = active_set_hash([ATX])
+    miscstore.add_active_set(db, root, 1, [ATX])
+    tortoise = _Tortoise()
+
+    async def beacon_getter(epoch):
+        return BEACON
+
+    handler = ProposalHandler(
+        db=db, cache=cache, oracle=_Oracle(cache), tortoise=tortoise,
+        store=_Store(), verifier=_Verifier(), pubsub=_Hub(),
+        layers_per_epoch=LPE, beacon_getter=beacon_getter)
+    return db, cache, tortoise, handler, root
+
+
+def _ballot(layer, *, epoch_data=None, ref=bytes(32), eligs=1, tag=b"x"):
+    return Ballot(
+        layer=layer, atx_id=ATX, node_id=NODE, epoch_data=epoch_data,
+        ref_ballot=ref,
+        eligibilities=[VotingEligibility(j=j, sig=bytes(80))
+                       for j in range(eligs)],
+        opinion=Opinion(base=bytes(32), support=[], against=[], abstain=[]),
+        signature=tag.ljust(64, b"\0"))
+
+
+def test_ref_ballot_count_validated_against_declared_set():
+    db, cache, tortoise, handler, root = _setup()
+    # declared denominator 100 -> bound = 100*10//100 = 10
+    good = _ballot(4, epoch_data=EpochData(
+        beacon=BEACON, active_set_root=root, eligibility_count=10))
+    assert asyncio.run(handler.ingest_ballot(good)) is True
+    # per-eligibility weight divides by the validated bound
+    assert tortoise.ballots == [(good.id, (100 // 10) * 1, False)]
+
+    forged = _ballot(5, epoch_data=EpochData(
+        beacon=BEACON, active_set_root=root, eligibility_count=40),
+        tag=b"f")
+    assert asyncio.run(handler.ingest_ballot(forged)) is False
+    db.close()
+
+
+def test_secondary_reuses_ref_count_and_requires_same_atx():
+    db, cache, tortoise, handler, root = _setup()
+    ref = _ballot(4, epoch_data=EpochData(
+        beacon=BEACON, active_set_root=root, eligibility_count=10))
+    assert asyncio.run(handler.ingest_ballot(ref)) is True
+
+    # secondary: bound is the REF's validated count (10), which admits
+    # j up to 9 — a local recomputation (1000 denominator -> 1) would
+    # reject these
+    sec = _ballot(5, ref=ref.id, eligs=3, tag=b"s")
+    assert asyncio.run(handler.ingest_ballot(sec)) is True
+    assert tortoise.ballots[-1] == (sec.id, (100 // 10) * 3, False)
+
+    # different atx than the ref: rejected (validateSecondary)
+    cache.add(1, b"C" * 32, AtxInfo(node_id=NODE, weight=100,
+                                    base_height=0, height=1, num_units=1,
+                                    vrf_nonce=0, vrf_public_key=NODE))
+    import dataclasses
+    bad = dataclasses.replace(_ballot(6, ref=ref.id, tag=b"m"),
+                              atx_id=b"C" * 32)
+    assert asyncio.run(handler.ingest_ballot(bad)) is False
+    db.close()
+
+
+def test_secondary_fetches_missing_ref_ballot():
+    db, cache, tortoise, handler, root = _setup()
+    ref = _ballot(4, epoch_data=EpochData(
+        beacon=BEACON, active_set_root=root, eligibility_count=10))
+    calls = []
+
+    async def fetch_ballot(ballot_id):
+        calls.append(ballot_id)
+        ballotstore.add(db, ref)  # what v_ballot does after validating
+        return True
+
+    handler.fetch_ballot = fetch_ballot
+    sec = _ballot(5, ref=ref.id, tag=b"s")
+    assert asyncio.run(handler.ingest_ballot(sec)) is True
+    assert calls == [ref.id]
+    db.close()
+
+
+def test_secondary_without_resolvable_ref_rejected():
+    db, cache, tortoise, handler, root = _setup()
+    sec = _ballot(5, ref=b"R" * 32, tag=b"s")
+    assert asyncio.run(handler.ingest_ballot(sec)) is False
+    assert tortoise.ballots == []
+    db.close()
+
+
+def test_bad_beacon_ballot_ingested_but_flagged():
+    db, cache, tortoise, handler, root = _setup()
+    odd = _ballot(4, epoch_data=EpochData(
+        beacon=b"\xee" * 4, active_set_root=root, eligibility_count=10))
+    assert asyncio.run(handler.ingest_ballot(odd)) is BAD_BEACON
+    assert tortoise.ballots == [(odd.id, 10, True)]
+    db.close()

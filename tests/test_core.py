@@ -177,7 +177,8 @@ def test_ballot_proposal_block_roundtrip():
     assert types.Proposal.from_bytes(prop.to_bytes()) == prop
 
     blk = types.Block(layer=12, tick_height=1000,
-                      rewards=[types.Reward(coinbase=bytes(24), weight=10)],
+                      rewards=[types.Reward(atx_id=bytes([7]) * 32,
+                                            coinbase=bytes(24), weight=10)],
                       tx_ids=[bytes([5]) * 32])
     assert types.Block.from_bytes(blk.to_bytes()) == blk
     cert = types.Certificate(

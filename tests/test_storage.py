@@ -174,3 +174,79 @@ def test_local_db():
                (b"\x01" * 32, 1))
     assert local.one("SELECT phase FROM nipost_state WHERE node_id=?",
                      (b"\x01" * 32,))["phase"] == 1
+
+
+# --- reader pool / latency metrics / vacuum (VERDICT r3 item 10) ----------
+
+
+def test_reader_pool_does_not_serialize_behind_writer(tmp_path):
+    """With a read pool, a SELECT completes while another thread holds a
+    long write transaction — WAL snapshot readers bypass the writer lock
+    (reference sql/database.go pooled connections)."""
+    import threading
+    import time as _time
+
+    d = db.open_state(tmp_path / "pool.db", read_pool=2)
+    d.exec("INSERT INTO layers (id, processed) VALUES (1, 1)")
+
+    in_tx = threading.Event()
+    release = threading.Event()
+
+    def long_writer():
+        with d.tx():
+            d.exec("INSERT INTO layers (id, processed) VALUES (2, 1)")
+            in_tx.set()
+            release.wait(timeout=30)
+
+    t = threading.Thread(target=long_writer)
+    t.start()
+    assert in_tx.wait(timeout=10)
+    start = _time.perf_counter()
+    rows = d.all("SELECT id FROM layers ORDER BY id")
+    elapsed = _time.perf_counter() - start
+    # snapshot isolation: committed data only, and promptly
+    assert [r["id"] for r in rows] == [1]
+    assert elapsed < 5.0, "read serialized behind the open write tx"
+    release.set()
+    t.join()
+    assert [r["id"] for r in d.all("SELECT id FROM layers ORDER BY id")] \
+        == [1, 2]
+    d.close()
+
+
+def test_tx_reads_its_own_uncommitted_writes(tmp_path):
+    """Inside tx() the calling thread's reads use the WRITER handle —
+    pooled readers cannot see uncommitted rows."""
+    d = db.open_state(tmp_path / "ryw.db", read_pool=2)
+    with d.tx():
+        d.exec("INSERT INTO layers (id, processed) VALUES (7, 1)")
+        assert d.one("SELECT processed FROM layers WHERE id=7")["processed"] \
+            == 1
+        assert len(d.all("SELECT id FROM layers")) == 1
+    d.close()
+
+
+def test_maybe_vacuum_reclaims_after_bulk_delete(tmp_path):
+    d = db.open_state(tmp_path / "vac.db")
+    with d.tx():
+        for i in range(2000):
+            d.exec("INSERT INTO layers (id, processed) VALUES (?, 1)",
+                   (i + 10,))
+    before = d.one("PRAGMA page_count")[0]
+    d.exec("DELETE FROM layers")
+    assert d.maybe_vacuum(min_free_fraction=0.2) is True
+    assert d.one("PRAGMA page_count")[0] < before
+    # nothing left to reclaim
+    assert d.maybe_vacuum(min_free_fraction=0.2) is False
+    d.close()
+
+
+def test_query_latency_metrics_recorded():
+    from spacemesh_tpu.utils.metrics import REGISTRY
+
+    d = db.open_state()
+    d.all("SELECT id FROM layers")
+    text = REGISTRY.expose()
+    assert "sql_state_query_seconds" in text
+    assert "sql_state_queries" in text
+    d.close()
