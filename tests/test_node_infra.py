@@ -22,6 +22,46 @@ def test_presets():
     assert len(main.genesis.genesis_id) == 20
 
 
+def test_mainnet_preset_consensus_parameters():
+    """The full mainnet profile (reference config/mainnet.go) — the
+    values that are CONSENSUS-critical must be pinned, not defaulted."""
+    main = config_mod.load("mainnet")
+    assert main.post.min_num_units == 4            # 256 GiB minimum
+    assert main.post.k1 == 26 and main.post.k2 == 37 and main.post.k3 == 1
+    assert main.post.pow_difficulty.startswith("000dfb23b0979b4b")
+    # nonzero min-weight floor: the dust-declared-set defense is ON
+    assert main.min_active_set_weight == [(0, 1_000_000)]
+    # historical hare committee downgrade (mainnet.go:70-75)
+    assert main.hare.committee_size == 400
+    assert main.hare.committee_upgrade == [105_720, 50]
+    assert main.tortoise.hdist == 10
+    assert main.tortoise.window_size == 4032
+
+
+def test_testnet_preset():
+    """Testnet trio completes the reference's preset set
+    (config/presets/testnet.go): mainnet timing, day-long epochs, small
+    units, low-but-nonzero floor."""
+    tn = config_mod.load("testnet")
+    assert tn.layer_duration == 300.0
+    assert tn.layers_per_epoch == 288
+    assert tn.post.min_num_units == 2
+    assert tn.post.labels_per_unit == 1024
+    assert tn.min_active_set_weight == [(0, 10_000)]
+    assert tn.poet_cycle_gap == 7200.0
+    # distinct genesis id from mainnet (different network)
+    assert tn.genesis.genesis_id != config_mod.load("mainnet") \
+        .genesis.genesis_id
+
+
+def test_every_preset_loads_and_validates():
+    for name in config_mod.PRESETS:
+        cfg = config_mod.load(name)
+        assert cfg.preset == name
+        assert cfg.layers_per_epoch > 0 and cfg.layer_duration > 0
+        assert cfg.p2p.transport in ("tcp", "quic")
+
+
 def test_config_file_and_overrides(tmp_path):
     f = tmp_path / "c.json"
     f.write_text(json.dumps({"layer_duration": 1.5,

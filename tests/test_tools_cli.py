@@ -247,3 +247,32 @@ def test_certifier_flow_gates_poet_registration(tmp_path):
             await daemon.stop()
 
     asyncio.run(go())
+
+
+def test_profiler_lists_providers_and_recommends(capsys):
+    """Operator tuning tool (reference post_supervisor.go:105-127
+    Providers()/Benchmark(); post-rs profiler binary): providers
+    enumerate, a tiny benchmark produces per-provider rates and a
+    recommendation with an init-batch suggestion for device providers."""
+    import json as _json
+
+    from spacemesh_tpu.tools import profiler
+
+    assert profiler.main(["--providers", "--no-probe"]) == 0
+    doc = _json.loads(capsys.readouterr().out)
+    ids = [p["id"] for p in doc["providers"]]
+    assert "cpu:openssl" in ids
+    assert any(i.startswith("jax:") for i in ids)
+
+    assert profiler.main(["--n", "2", "--batches", "32", "--reps", "1",
+                          "--cpu-labels", "4", "--no-probe"]) == 0
+    doc = _json.loads(capsys.readouterr().out)
+    assert doc["scrypt_n"] == 2
+    rec = doc["recommendation"]
+    assert rec["labels_per_sec"] > 0
+    assert "hours_per_space_unit" in rec
+    rates = [p["labels_per_sec"] for p in doc["providers"]]
+    assert rates == sorted(rates, reverse=True)
+    jax_row = next(p for p in doc["providers"]
+                   if p["id"].startswith("jax:"))
+    assert jax_row["best_batch"] == 32
