@@ -75,6 +75,12 @@ class ApiServer:
         r.add_post("/v1/admin/chaos/timeskew", self.admin_chaos_timeskew)
         r.add_get("/v1/events", self.events)
         r.add_get("/metrics", self.metrics)
+        # pprof-analogue debug surface (reference node/node.go:2121-2151
+        # mounts net/http/pprof): stack dumps and an on-demand CPU
+        # profile, the two handles operators actually pull on a wedged
+        # or hot node
+        r.add_get("/debug/stacks", self.debug_stacks)
+        r.add_get("/debug/profile", self.debug_profile)
 
     # --- lifecycle ---------------------------------------------------
 
@@ -312,6 +318,62 @@ class ApiServer:
             checkpoint_mod.recover_file, self.node.state, path,
             self.node.signer.node_id)
         return web.json_response({"recovered_layer": snap["layer"]})
+
+    # --- debug/profiling (reference node/node.go:2121-2151 pprof) -----
+
+    async def debug_stacks(self, req) -> web.Response:
+        """Every thread's stack plus every asyncio task — the
+        goroutine-dump equivalent for diagnosing a wedged node."""
+        import io
+        import sys
+        import traceback
+
+        buf = io.StringIO()
+        frames = sys._current_frames()
+        for tid, frame in frames.items():
+            buf.write(f"--- thread {tid} ---\n")
+            traceback.print_stack(frame, file=buf)
+        buf.write(f"\n=== asyncio tasks "
+                  f"({len(asyncio.all_tasks())}) ===\n")
+        for task in asyncio.all_tasks():
+            buf.write(f"--- {task.get_name()}"
+                      f"{' (current)' if task == asyncio.current_task() else ''}\n")
+            stack = task.get_stack(limit=8)
+            for frame in stack:
+                buf.write("".join(traceback.format_stack(frame, limit=1)))
+        return web.Response(text=buf.getvalue(),
+                            content_type="text/plain")
+
+    async def debug_profile(self, req) -> web.Response:
+        """CPU-profile the node for ?seconds=N (default 5, max 60) and
+        return cProfile stats ordered by cumulative time — the
+        /debug/pprof/profile analogue."""
+        import cProfile
+        import io
+        import pstats
+
+        try:
+            seconds = min(float(req.query.get("seconds", 5)), 60.0)
+        except ValueError:
+            raise web.HTTPBadRequest(text="seconds must be a number")
+        prof = cProfile.Profile()
+        try:
+            prof.enable()
+        except ValueError:
+            # another profiler is live: the node's --profile whole-run
+            # profiler (node/__main__.py), or a concurrent request —
+            # only one cProfile may be active per interpreter
+            raise web.HTTPConflict(
+                text="another profiler is already active")
+        try:
+            await asyncio.sleep(seconds)
+        finally:
+            prof.disable()
+        buf = io.StringIO()
+        pstats.Stats(prof, stream=buf).sort_stats("cumulative") \
+            .print_stats(40)
+        return web.Response(text=buf.getvalue(),
+                            content_type="text/plain")
 
     # --- chaos fault injection (systest harness; reference
     # systest/chaos/{partition,timeskew}.go) ---------------------------

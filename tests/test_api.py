@@ -76,6 +76,10 @@ def api_env(tmp_path_factory):
             results["debug"] = await (await s.get(f"{base}/v1/debug/state")).json()
             results["events"] = await (await s.get(
                 f"{base}/v1/events?timeout=0.3")).json()
+            results["stacks"] = await (await s.get(
+                f"{base}/debug/stacks")).text()
+            results["profile"] = await (await s.get(
+                f"{base}/debug/profile?seconds=0.2")).text()
         await run
         await app.api.stop()
 
@@ -117,3 +121,12 @@ def test_layer_and_state(api_env):
     assert r["debug"]["last_applied"] >= 3
     assert isinstance(r["events"]["events"], list)
     assert r["acct_pre"]["balance"] > 0  # rewards had landed
+
+
+def test_debug_profiling_endpoints(api_env):
+    """pprof analogue (reference node/node.go:2121-2151): thread/task
+    stack dumps and an on-demand CPU profile over the admin HTTP API."""
+    app, r = api_env
+    assert "--- thread" in r["stacks"]
+    assert "asyncio tasks" in r["stacks"]
+    assert "cumulative" in r["profile"]  # pstats header
