@@ -150,12 +150,65 @@ def benchmark(n: int, batches: list[int], reps: int,
             "recommendation": recommendation}
 
 
+def verify_benchmark(counts: list[int], reps: int = 2,
+                     probe: bool = True) -> dict:
+    """Proof-verification throughput (BASELINE config 3: batch of NIPoST
+    proofs through the vmapped verifier vs the reference's CPU worker
+    pool). Builds one tiny real unit + proof (scrypt N=2), then measures
+    verify_many over batches of that proof — proofs are LANES in the
+    batched pass, so duplicates exercise the same compute path as
+    distinct proofs."""
+    import tempfile
+
+    from ..post import initializer, verifier
+    from ..post.prover import ProofParams, Prover
+    from ..utils import accel
+
+    if probe and not accel.ensure_usable_platform():
+        _log("accelerator unreachable; JAX restricted to CPU")
+    node = hashlib.sha256(b"profiler-node").digest()
+    commit = hashlib.sha256(b"profiler-commit").digest()
+    challenge = hashlib.sha256(b"profiler-challenge").digest()
+    params = ProofParams(k1=64, k2=16, k3=8,
+                         pow_difficulty=bytes([32]) + bytes([255]) * 31)
+    rates = []
+    with tempfile.TemporaryDirectory() as d:
+        meta, _ = initializer.initialize(
+            d, node_id=node, commitment=commit, num_units=2,
+            labels_per_unit=512, scrypt_n=2, max_file_size=4096,
+            batch_size=256)
+        proof = Prover(d, params, batch_labels=512).prove(challenge)
+        item = verifier.VerifyItem(
+            proof=proof, challenge=challenge, node_id=node,
+            commitment=commit, scrypt_n=meta.scrypt_n,
+            total_labels=meta.total_labels)
+        for count in counts:
+            batch = [item] * count
+            best = 0.0
+            for _ in range(reps + 1):  # first rep pays the compile
+                t0 = time.perf_counter()
+                ok = verifier.verify_many(batch, params)
+                best = max(best, count / (time.perf_counter() - t0))
+                if not all(ok):
+                    # a throughput number for proofs that FAILED would
+                    # be worse than no number (and `assert` vanishes
+                    # under python -O)
+                    raise RuntimeError("verifier rejected a valid proof")
+            _log(f"verify batch={count}: {best:,.0f} proofs/s")
+            rates.append({"batch": count, "proofs_per_sec": round(best, 1)})
+    return {"verify": rates}
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="profiler",
         description="POST provider enumeration + label benchmark")
     ap.add_argument("--providers", action="store_true",
                     help="list providers only, no benchmark")
+    ap.add_argument("--verify", action="store_true",
+                    help="benchmark proof verification instead of labels")
+    ap.add_argument("--verify-batches", default="100,1000",
+                    help="comma-separated proof batch sizes for --verify")
     ap.add_argument("--n", type=int, default=8192, help="scrypt N")
     ap.add_argument("--batches", default="1024,2048,4096",
                     help="comma-separated label lanes per program")
@@ -169,6 +222,12 @@ def main(argv=None) -> int:
     if a.providers:
         print(json.dumps({"providers": providers(probe=not a.no_probe)},
                          indent=2))
+        return 0
+    if a.verify:
+        doc = verify_benchmark(
+            [int(b) for b in a.verify_batches.split(",")],
+            reps=a.reps, probe=not a.no_probe)
+        print(json.dumps(doc, indent=2))
         return 0
     doc = benchmark(a.n, [int(b) for b in a.batches.split(",")],
                     a.reps, a.cpu_labels, probe=not a.no_probe)

@@ -106,6 +106,43 @@ class VirtualClockLoop(asyncio.SelectorEventLoop):
         return fut
 
 
+class ChaosClockLoop(VirtualClockLoop):
+    """VirtualClockLoop that PERTURBS ready-callback ordering with a
+    seeded RNG — the asyncio analogue of the reference's race detector
+    plus schedule fuzzing (`go test -race` over randomized goroutine
+    interleavings, SURVEY §5.2).
+
+    asyncio's cooperative model rules out data races inside one loop,
+    but ORDERING bugs survive: code that accidentally depends on two
+    tasks resuming in FIFO order (who observes a shared dict first, a
+    publish racing a subscribe) behaves identically on every normal run
+    and breaks only under real-world timing. Shuffling the ready queue
+    each iteration (timers still respect their deadlines — only
+    already-runnable callbacks are reordered, so time causality is
+    preserved) surfaces those dependencies deterministically: any
+    failure replays exactly from its seed."""
+
+    def __init__(self, seed: int, start: float = START):
+        super().__init__(start=start)
+        import random
+
+        self._chaos_rng = random.Random(seed)
+        # VirtualClockLoop already wrapped select for the time-jump; we
+        # wrap once more so the shuffle runs every loop iteration,
+        # before the loop drains self._ready
+        inner = self._selector.select
+
+        def chaotic_select(timeout):
+            if len(self._ready) > 1:
+                ready = list(self._ready)
+                self._chaos_rng.shuffle(ready)
+                self._ready.clear()
+                self._ready.extend(ready)
+            return inner(timeout)
+
+        self._selector.select = chaotic_select
+
+
 async def cancel_all_tasks() -> None:
     """Cancel every task but the caller and await them (teardown helper —
     must run INSIDE the loop so gather binds to it)."""

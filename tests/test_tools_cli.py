@@ -276,3 +276,18 @@ def test_profiler_lists_providers_and_recommends(capsys):
     jax_row = next(p for p in doc["providers"]
                    if p["id"].startswith("jax:"))
     assert jax_row["best_batch"] == 32
+
+
+def test_profiler_verify_benchmark(capsys):
+    """--verify measures proofs/second through the batched verifier
+    (BASELINE config 3's metric) on a real tiny unit + proof."""
+    import json as _json
+
+    from spacemesh_tpu.tools import profiler
+
+    assert profiler.main(["--verify", "--verify-batches", "10,20",
+                          "--no-probe"]) == 0
+    doc = _json.loads(capsys.readouterr().out)
+    rates = doc["verify"]
+    assert [r["batch"] for r in rates] == [10, 20]
+    assert all(r["proofs_per_sec"] > 0 for r in rates)
