@@ -11,6 +11,11 @@ EdVerifier, signing/vrf.go ECVRF via curve25519-voi):
   0x03), implemented from spec in pure Python (curve arithmetic below).
   The VRF output (beta) drives eligibility sampling and the beacon's weak
   coin, so it must be a *proof* (unique, verifiable), not a bare signature.
+
+A native twin (native/ecvrf.cpp, ~20x faster) handles prove/verify/
+output when it builds; the Python implementation is the fallback AND the
+test oracle (tests/test_native_ecvrf.py pins bit-identical behavior).
+Set SPACEMESH_NO_NATIVE_VRF=1 to force the Python path.
 """
 
 from __future__ import annotations
@@ -187,6 +192,37 @@ def _pt_decode(data: bytes):
 
 _SUITE = b"\x03"
 
+_NATIVE_VRF_UNSET = object()
+_NATIVE_VRF = _NATIVE_VRF_UNSET
+
+
+def _native_vrf():
+    """libsmtpu_ecvrf handle, or None (build failure / opt-out)."""
+    global _NATIVE_VRF
+    if _NATIVE_VRF is _NATIVE_VRF_UNSET:
+        import ctypes
+        import os
+
+        lib = None
+        if not os.environ.get("SPACEMESH_NO_NATIVE_VRF"):
+            from ..native import load
+
+            lib = load("ecvrf")
+            if lib is not None:
+                for fn, args in (
+                        ("smtpu_vrf_public_key", 2),
+                        ("smtpu_vrf_output", 2)):
+                    getattr(lib, fn).argtypes = \
+                        [ctypes.c_char_p] * args
+                    getattr(lib, fn).restype = ctypes.c_int
+                for fn in ("smtpu_vrf_prove", "smtpu_vrf_verify"):
+                    getattr(lib, fn).argtypes = [
+                        ctypes.c_char_p, ctypes.c_char_p,
+                        ctypes.c_size_t, ctypes.c_char_p]
+                    getattr(lib, fn).restype = ctypes.c_int
+        _NATIVE_VRF = lib
+    return _NATIVE_VRF
+
 
 def _expand_key(seed32: bytes) -> tuple[int, bytes]:
     h = hashlib.sha512(seed32).digest()
@@ -218,19 +254,50 @@ class VrfSigner:
     def __init__(self, seed32: bytes, public_key: bytes | None = None):
         if len(seed32) != 32:
             raise ValueError("vrf seed must be 32 bytes")
+        self._seed = seed32
         self._x, self._nonce_key = _expand_key(seed32)
-        self._y = _pt_mul(self._x, _B)
-        self.public_key = _pt_encode(self._y)
+        # the Python scalar mult for the public key costs ~1/4 of a full
+        # Python prove, and VrfSigners are constructed per eligibility
+        # check — when the native library is up it derives the key and
+        # the Python point stays lazy (code-review r5)
+        self.__y = None
+        lib = _native_vrf()
+        if lib is not None:
+            import ctypes
+
+            buf = ctypes.create_string_buffer(32)
+            if lib.smtpu_vrf_public_key(seed32, buf) == 0:
+                self.public_key = buf.raw
+            else:  # pragma: no cover - native failure
+                self.public_key = _pt_encode(self._y_point)
+        else:
+            self.public_key = _pt_encode(self._y_point)
         if public_key is not None and public_key != self.public_key:
             raise ValueError("public key mismatch")
 
+    @property
+    def _y_point(self):
+        if self.__y is None:
+            self.__y = _pt_mul(self._x, _B)
+        return self.__y
+
     def prove(self, alpha: bytes) -> bytes:
+        lib = _native_vrf()
+        if lib is not None:
+            import ctypes
+
+            buf = ctypes.create_string_buffer(VRF_PROOF_SIZE)
+            if lib.smtpu_vrf_prove(self._seed, alpha, len(alpha),
+                                   buf) == 0:
+                return buf.raw
+            # fall through to the Python twin on any native failure
         h_pt = _hash_to_curve_tai(self.public_key, alpha)
         h_bytes = _pt_encode(h_pt)
         gamma = _pt_mul(self._x, h_pt)
         k = int.from_bytes(
             hashlib.sha512(self._nonce_key + h_bytes).digest(), "little") % _Q
-        c = _challenge([self._y, h_pt, gamma, _pt_mul(k, _B), _pt_mul(k, h_pt)])
+        c = _challenge([self._y_point, h_pt, gamma, _pt_mul(k, _B),
+                        _pt_mul(k, h_pt)])
         s = (k + c * self._x) % _Q
         return (_pt_encode(gamma) + c.to_bytes(16, "little")
                 + s.to_bytes(32, "little"))
@@ -241,6 +308,15 @@ class VrfSigner:
 
 def vrf_output(proof: bytes) -> bytes:
     """beta = proof_to_hash(pi): the uniform VRF output (64 bytes)."""
+    lib = _native_vrf()
+    if lib is not None and len(proof) >= 32:
+        import ctypes
+
+        out = ctypes.create_string_buffer(64)
+        rc = lib.smtpu_vrf_output(proof[:32], out)
+        if rc == 0:
+            return out.raw
+        raise ValueError("invalid vrf proof")
     gamma = _pt_decode(proof[:32])
     if gamma is None:
         raise ValueError("invalid vrf proof")
@@ -250,8 +326,12 @@ def vrf_output(proof: bytes) -> bytes:
 
 class VrfVerifier:
     def verify(self, public_key: bytes, alpha: bytes, proof: bytes) -> bool:
-        if len(proof) != VRF_PROOF_SIZE:
+        if len(proof) != VRF_PROOF_SIZE or len(public_key) != 32:
             return False
+        lib = _native_vrf()
+        if lib is not None:
+            return bool(lib.smtpu_vrf_verify(public_key, alpha,
+                                             len(alpha), proof))
         y = _pt_decode(public_key)
         gamma = _pt_decode(proof[:32])
         if y is None or gamma is None:

@@ -1,0 +1,128 @@
+"""Native ECVRF (native/ecvrf.cpp) vs the pure-Python twin.
+
+Same pattern as tests/test_native_blake3.py: the from-spec Python
+implementation in core/signing.py is the ORACLE; the native library must
+be bit-identical on proofs, outputs, and accept/reject decisions —
+including rejection edges (flipped bits, s >= q, non-canonical points).
+"""
+
+import contextlib
+import ctypes
+import hashlib
+import os
+import random
+
+import pytest
+
+from spacemesh_tpu.core import signing
+from spacemesh_tpu.native import load
+
+lib = load("ecvrf")
+pytestmark = pytest.mark.skipif(lib is None, reason="native build failed")
+
+
+@pytest.fixture
+def python_path(monkeypatch):
+    """Force core/signing.py onto its pure-Python path."""
+    monkeypatch.setattr(signing, "_NATIVE_VRF", None)
+
+
+@contextlib.contextmanager
+def forced_python():
+    saved = signing._NATIVE_VRF
+    signing._NATIVE_VRF = None
+    try:
+        yield
+    finally:
+        signing._NATIVE_VRF = saved
+
+
+def test_differential_prove_verify_output():
+    """Randomized differential: proofs are deterministic (RFC 9381 TAI
+    nonce), so native and Python must produce IDENTICAL bytes, verify
+    each other's proofs, and agree on the output hash."""
+    rng = random.Random(0xECF)
+    for trial in range(12):
+        seed = hashlib.sha256(b"dvrf-%d" % trial).digest()
+        alpha = bytes(rng.getrandbits(8)
+                      for _ in range(rng.randrange(1, 100)))
+        with forced_python():
+            py_signer = signing.VrfSigner(seed)
+            py_proof = py_signer.prove(alpha)
+            py_out = signing.vrf_output(py_proof)
+            pk = py_signer.public_key
+
+        npk = ctypes.create_string_buffer(32)
+        assert lib.smtpu_vrf_public_key(seed, npk) == 0
+        assert npk.raw == pk, f"trial {trial}: pk mismatch"
+        nproof = ctypes.create_string_buffer(80)
+        assert lib.smtpu_vrf_prove(seed, alpha, len(alpha), nproof) == 0
+        assert nproof.raw == py_proof, f"trial {trial}: proof mismatch"
+        assert lib.smtpu_vrf_verify(pk, alpha, len(alpha), py_proof) == 1
+        nout = ctypes.create_string_buffer(64)
+        assert lib.smtpu_vrf_output(py_proof[:32], nout) == 0
+        assert nout.raw == py_out, f"trial {trial}: beta mismatch"
+
+
+def test_rejections_agree(python_path):
+    """Bit flips anywhere in pk/proof/alpha must be rejected by BOTH
+    implementations (never accepted by one and not the other)."""
+    seed = hashlib.sha256(b"rej").digest()
+    signer = signing.VrfSigner(seed)
+    alpha = b"alpha-rejections"
+    proof = signer.prove(alpha)  # python path (fixture)
+    pk = signer.public_key
+    pyv = signing.VrfVerifier()
+    rng = random.Random(7)
+    for _ in range(40):
+        what = rng.randrange(3)
+        p, k, a = bytearray(proof), bytearray(pk), bytearray(alpha)
+        if what == 0:
+            p[rng.randrange(len(p))] ^= 1 << rng.randrange(8)
+        elif what == 1:
+            k[rng.randrange(len(k))] ^= 1 << rng.randrange(8)
+        else:
+            a[rng.randrange(len(a))] ^= 1 << rng.randrange(8)
+        py = pyv.verify(bytes(k), bytes(a), bytes(p))
+        nat = bool(lib.smtpu_vrf_verify(bytes(k), bytes(a), len(a),
+                                        bytes(p)))
+        assert py == nat, f"divergence: what={what} py={py} native={nat}"
+
+
+def test_s_out_of_range_rejected():
+    seed = hashlib.sha256(b"srange").digest()
+    with forced_python():
+        signer = signing.VrfSigner(seed)
+        proof = signer.prove(b"a")
+        pk = signer.public_key
+    # s >= q: set the scalar's top bytes
+    bad = proof[:48] + b"\xff" * 32
+    assert lib.smtpu_vrf_verify(pk, b"a", 1, bad) == 0
+
+
+def test_native_is_default_and_faster():
+    """The wired-in path actually uses the native library, and it beats
+    the Python oracle by a wide margin (informational floor: 5x)."""
+    import time
+
+    if os.environ.get("SPACEMESH_NO_NATIVE_VRF"):
+        pytest.skip("native disabled by env")
+    seed = hashlib.sha256(b"perf").digest()
+    signer = signing.VrfSigner(seed)
+    alpha = b"perf-alpha"
+    proof = signer.prove(alpha)
+    v = signing.VrfVerifier()
+    assert v.verify(signer.public_key, alpha, proof)
+
+    n = 60
+    t0 = time.perf_counter()
+    for _ in range(n):
+        v.verify(signer.public_key, alpha, proof)
+    fast = n / (time.perf_counter() - t0)
+
+    with forced_python():
+        t0 = time.perf_counter()
+        for _ in range(6):
+            signing.VrfVerifier().verify(signer.public_key, alpha, proof)
+        slow = 6 / (time.perf_counter() - t0)
+    assert fast > 5 * slow, (fast, slow)
