@@ -317,8 +317,30 @@ static void ge_add(ge* r, const ge* p, const ge* q) {
     fe_mul(&r->T, &e, &h);
 }
 
-// scalar as little-endian bytes; plain LSB-first double-and-add,
-// mirroring the twin's _pt_mul (variable-time — see file header)
+// dedicated doubling (EFD dbl-2008-hwcd for a=-1): 4 squarings + 4 muls
+// vs the unified add's 9 muls
+static void ge_dbl(ge* r, const ge* p) {
+    fe A, B, C, D, E, F, G, H, t;
+    fe_sq(&A, &p->X);
+    fe_sq(&B, &p->Y);
+    fe_sq(&C, &p->Z);
+    fe_add(&C, &C, &C);        // C = 2 Z^2
+    fe_neg(&D, &A);            // D = a*A, a = -1
+    fe_add(&t, &p->X, &p->Y);
+    fe_sq(&E, &t);
+    fe_sub(&E, &E, &A);
+    fe_sub(&E, &E, &B);        // E = (X+Y)^2 - A - B
+    fe_add(&G, &D, &B);        // G = D + B
+    fe_sub(&F, &G, &C);        // F = G - C
+    fe_sub(&H, &D, &B);        // H = D - B
+    fe_mul(&r->X, &E, &F);
+    fe_mul(&r->Y, &G, &H);
+    fe_mul(&r->Z, &F, &G);
+    fe_mul(&r->T, &E, &H);
+}
+
+// scalar as little-endian bytes; LSB-first double-and-add, mirroring
+// the twin's _pt_mul (variable-time — see file header)
 static void ge_scalarmult(ge* r, const uint8_t* scalar_le, size_t len,
                           const ge* p) {
     ge acc, base = *p;
@@ -327,21 +349,48 @@ static void ge_scalarmult(ge* r, const uint8_t* scalar_le, size_t len,
         uint8_t byte = scalar_le[i];
         for (int bit = 0; bit < 8; bit++) {
             if ((byte >> bit) & 1) ge_add(&acc, &acc, &base);
-            ge_add(&base, &base, &base);
+            ge_dbl(&base, &base);
         }
     }
     *r = acc;
 }
 
-static void ge_tobytes(uint8_t s[32], const ge* p) {
-    fe zi, x, y;
-    fe_invert(&zi, &p->Z);
-    fe_mul(&x, &p->X, &zi);
-    fe_mul(&y, &p->Y, &zi);
+// Shamir's trick: r = a*P + b*Q in one MSB-first pass — one shared
+// doubling chain instead of two (verify's U and V are this shape)
+static void ge_double_scalarmult(ge* r, const uint8_t* a_le, size_t alen,
+                                 const ge* p, const uint8_t* b_le,
+                                 size_t blen, const ge* q) {
+    ge pq, acc;
+    ge_add(&pq, p, q);
+    ge_identity(&acc);
+    size_t bits = (alen > blen ? alen : blen) * 8;
+    for (size_t i = bits; i-- > 0;) {
+        ge_dbl(&acc, &acc);
+        int abit = i < alen * 8 && (a_le[i / 8] >> (i % 8)) & 1;
+        int bbit = i < blen * 8 && (b_le[i / 8] >> (i % 8)) & 1;
+        if (abit && bbit) ge_add(&acc, &acc, &pq);
+        else if (abit) ge_add(&acc, &acc, p);
+        else if (bbit) ge_add(&acc, &acc, q);
+    }
+    *r = acc;
+}
+
+// shared wire encoding: y bytes with x-parity in bit 255 — challenge
+// hashing and proof/pk encoding MUST stay byte-identical
+static void ge_encode_affine(uint8_t s[32], const ge* p, const fe* zi) {
+    fe x, y;
+    fe_mul(&x, &p->X, zi);
+    fe_mul(&y, &p->Y, zi);
     fe_tobytes(s, &y);
     uint8_t xb[32];
     fe_tobytes(xb, &x);
     s[31] |= (xb[0] & 1) << 7;
+}
+
+static void ge_tobytes(uint8_t s[32], const ge* p) {
+    fe zi;
+    fe_invert(&zi, &p->Z);
+    ge_encode_affine(s, p, &zi);
 }
 
 // returns 0 on failure (not on curve / non-canonical), 1 on success
@@ -540,17 +589,36 @@ static int hash_to_curve_tai(ge* out, const uint8_t pk[32],
     return 0;
 }
 
+// encode 5 points with ONE field inversion (Montgomery's trick) — a
+// fe_invert is ~380 fe_muls, comparable to a whole scalarmult, and the
+// challenge hash needs five encodings
+static void ge_tobytes_batch5(uint8_t enc[5][32], const ge* pts[5]) {
+    fe prefix[5], inv;
+    prefix[0] = pts[0]->Z;
+    for (int i = 1; i < 5; i++) fe_mul(&prefix[i], &prefix[i - 1],
+                                       &pts[i]->Z);
+    fe_invert(&inv, &prefix[4]);
+    for (int i = 4; i >= 0; i--) {
+        fe zi;
+        if (i == 0) {
+            zi = inv;
+        } else {
+            fe_mul(&zi, &inv, &prefix[i - 1]);
+            fe_mul(&inv, &inv, &pts[i]->Z);
+        }
+        ge_encode_affine(enc[i], pts[i], &zi);
+    }
+}
+
 static void challenge16(uint8_t c16[16], const ge* pts[5]) {
     Sha512 h;
     uint8_t prefix[2] = {SUITE, 0x02};
     uint8_t zero = 0x00;
     uint8_t d[64];
+    uint8_t enc[5][32];
+    ge_tobytes_batch5(enc, pts);
     h.update(prefix, 2);
-    for (int i = 0; i < 5; i++) {
-        uint8_t enc[32];
-        ge_tobytes(enc, pts[i]);
-        h.update(enc, 32);
-    }
+    for (int i = 0; i < 5; i++) h.update(enc[i], 32);
     h.update(&zero, 1);
     h.final(d);
     memcpy(c16, d, 16);
@@ -644,13 +712,9 @@ int smtpu_vrf_verify(const uint8_t pk[32], const uint8_t* alpha,
     fe_neg(&negGamma.X, &Gamma.X);
     fe_neg(&negGamma.T, &Gamma.T);
 
-    ge sB, cY, U, sH, cG, V;
-    ge_scalarmult(&sB, s_le, 32, &B);
-    ge_scalarmult(&cY, c16, 16, &negY);
-    ge_add(&U, &sB, &cY);
-    ge_scalarmult(&sH, s_le, 32, &H);
-    ge_scalarmult(&cG, c16, 16, &negGamma);
-    ge_add(&V, &sH, &cG);
+    ge U, V;
+    ge_double_scalarmult(&U, s_le, 32, &B, c16, 16, &negY);
+    ge_double_scalarmult(&V, s_le, 32, &H, c16, 16, &negGamma);
 
     uint8_t c_check[16];
     const ge* pts[5] = {&Y, &H, &Gamma, &U, &V};
