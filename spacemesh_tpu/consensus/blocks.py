@@ -93,6 +93,8 @@ class Certifier:
         self.layers_per_epoch = layers_per_epoch
         self.beacon_getter = beacon_getter
         self._pending: dict[tuple[int, bytes], list[CertifyMessage]] = {}
+        # callback(layer, block_id) on every ASSEMBLED threshold cert
+        self.on_certificate = None
         pubsub.register(TOPIC_CERTIFY, self._gossip)
 
     CERT_ROUND = 250  # distinct VRF round tag for certifier eligibility
@@ -181,4 +183,9 @@ class Certifier:
             cert = Certificate(block_id=msg.block_id, signatures=list(msgs))
             with self.db.tx():
                 miscstore.add_certificate(self.db, msg.layer, cert)
+            # a full certificate is the committee's decision for the
+            # layer — the node must ADOPT it even if its own hare
+            # failed there (App wires this to mesh.adopt_certified)
+            if self.on_certificate is not None:
+                self.on_certificate(msg.layer, msg.block_id)
         return True

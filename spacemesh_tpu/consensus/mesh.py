@@ -15,6 +15,7 @@ from typing import Optional
 from ..core.types import Block, Proposal, Reward, Transaction
 from ..storage import blocks as blockstore
 from ..storage import layers as layerstore
+from ..storage import misc as miscstore
 from ..storage import transactions as txstore
 from ..storage.cache import AtxCache
 from ..storage.db import Database
@@ -110,6 +111,13 @@ class Mesh:
         # recover the applied frontier from storage on restart (reference
         # mesh.go:123 recoverFromDB)
         self.latest_applied = max(layerstore.last_applied(db), 0)
+        # layers applied differently than their (later-arriving)
+        # committee certificate — healed by process_layer
+        self._cert_dirty: set[int] = set()
+        # earliest layer whose reapply was deferred (content in flight):
+        # tortoise.updates() is drained once, so the retry intent must
+        # survive the pass (code-review r5)
+        self._pending_reapply: int | None = None
 
     def add_block(self, block: Block) -> None:
         with self.db.tx():
@@ -148,8 +156,36 @@ class Mesh:
             if applied is not None and applied != should:
                 if min_changed is None or upd.layer < min_changed:
                     min_changed = upd.layer
+        # layers whose COMMITTEE decision (adopted certificate) arrived
+        # after we applied them differently — heal them here, where the
+        # reapply is prechecked, not in the gossip handler
+        for lyr in sorted(self._cert_dirty):
+            cert = miscstore.certified_block(self.db, lyr)
+            if cert is None or lyr > self.latest_applied:
+                self._cert_dirty.discard(lyr)
+                continue
+            if layerstore.applied_block(self.db, lyr) == cert:
+                self._cert_dirty.discard(lyr)
+                continue
+            if blockstore.get(self.db, cert) is None:
+                continue  # block still in flight; keep the mark
+            if min_changed is None or lyr < min_changed:
+                min_changed = lyr
+        if self._pending_reapply is not None:
+            if min_changed is None or self._pending_reapply < min_changed:
+                min_changed = self._pending_reapply
         if min_changed is not None:
-            self._reapply_from(min_changed)
+            if self._reapply_from(min_changed):
+                self._pending_reapply = None
+                # drop only marks the reapply actually SETTLED — a
+                # cert-dirty layer whose block is still in flight was
+                # applied per fallback and must stay marked
+                self._cert_dirty = {
+                    x for x in self._cert_dirty
+                    if layerstore.applied_block(self.db, x)
+                    != miscstore.certified_block(self.db, x)}
+            else:
+                self._pending_reapply = min_changed
         # advance the applied frontier through tortoise-DECIDED layers:
         # a layer whose hare never concluded stalls the hare fast path
         # forever; once the tortoise verifies it (margins/healing), the
@@ -170,8 +206,45 @@ class Mesh:
             nxt += 1
 
     def _block_to_apply(self, layer: int) -> bytes:
+        """Positive tortoise verdicts win; otherwise the hare output
+        (including an adopted certificate) decides — a thin-margin
+        "nothing proven valid" must not override the committee's
+        certified agreement (reference mesh.go: applied block follows
+        hare output until the tortoise verifies otherwise)."""
         valid = self.tortoise.valid_blocks(layer)
-        return valid[0] if valid else EMPTY
+        if valid:
+            return valid[0]
+        hare = self.tortoise.hare_of(layer)
+        if hare is not None and hare != EMPTY \
+                and self.tortoise.verdict(hare) is not False \
+                and blockstore.get(self.db, hare) is not None:
+            # hare/cert output holds only while the tortoise has not
+            # verified OTHERWISE (code-review r5: an explicitly
+            # invalidated block must not stay applied)
+            return hare
+        return EMPTY
+
+    def adopt_certified(self, layer: int, block_id: bytes) -> None:
+        """A VALIDATED threshold certificate IS the network's hare
+        output for the layer — adopt it even when our own hare failed
+        or we already applied the layer differently (e.g. this node
+        raced ahead on a skewed clock and settled on empty; round-5
+        chaos test). Without this, a node whose local hare missed a
+        layer diverges PERMANENTLY whenever the tortoise margin never
+        crosses (small committees). Reference: certificate adoption in
+        syncer/state_syncer.go + mesh.go:497 ProcessLayerPerHareOutput.
+
+        Only RECORDS the adoption (hare output + dirty mark): the
+        revert/reapply runs inside the next process_layer pass, which
+        prechecks that the whole affected span is executable — a
+        mid-gossip partial revert would leave holes in the applied
+        chain. The certified BLOCK may not be local yet (the cert can
+        assemble before the block gossip lands); the dirty mark
+        persists until the block arrives and the reapply succeeds."""
+        self.tortoise.on_hare_output(layer, block_id)
+        applied = layerstore.applied_block(self.db, layer)
+        if applied is not None and applied != block_id:
+            self._cert_dirty.add(layer)
 
     def _executable(self, bid: bytes) -> Optional[Block]:
         """The block, if its content AND all its txs are local. Executing
@@ -186,7 +259,17 @@ class Mesh:
                 return None
         return block
 
-    def _reapply_from(self, layer: int) -> None:
+    def _reapply_from(self, layer: int) -> bool:
+        """Revert to ``layer``-1 and re-execute forward. PRECHECKS that
+        every affected layer is executable before reverting: a revert
+        that cannot be fully reapplied leaves holes in the applied
+        chain — every later state root diverges and the sync frontier
+        skips the gap (round-5 chaos debugging). Returns True when the
+        reapply ran to the old frontier."""
+        for lyr in range(layer, self.latest_applied + 1):
+            bid = self._block_to_apply(lyr)
+            if bid != EMPTY and self._executable(bid) is None:
+                return False  # content/txs in flight; retry next pass
         self.executor.revert(layer - 1)
         target = self.latest_applied
         self.latest_applied = layer - 1
@@ -196,13 +279,11 @@ class Mesh:
                 self.executor.execute_empty(lyr)
             else:
                 block = self._executable(bid)
-                if block is None:
-                    # content/txs not local yet: stop here — the frontier
-                    # reflects what is actually applied; the next sync
-                    # pass fetches and resumes
-                    return
+                if block is None:  # pragma: no cover - precheck holds
+                    return False
                 self.executor.execute(block)
             # revert dropped the layer rows; the re-executed layers are
             # processed again (keeps the processed frontier monotone)
             layerstore.set_processed(self.db, lyr)
             self.latest_applied = lyr
+        return True

@@ -640,8 +640,19 @@ class Tortoise:
         return sorted(b for b in self._blocks.get(layer, set())
                       if self._validity.get(b))
 
+    def hare_of(self, layer: int) -> bytes | None:
+        """The recorded hare output (or adopted certificate) for the
+        layer; EMPTY means hare decided empty, None means undecided."""
+        return self._hare.get(layer)
+
     def is_valid(self, block_id: bytes) -> bool:
         return bool(self._validity.get(block_id))
+
+    def verdict(self, block_id: bytes) -> bool | None:
+        """True/False once the tortoise decided; None while undecided —
+        callers that treat hare output as provisional need the
+        three-way answer (mesh._block_to_apply)."""
+        return self._validity.get(block_id)
 
     # --- vote encoding -------------------------------------------------
 

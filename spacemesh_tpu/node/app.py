@@ -167,6 +167,8 @@ class App:
             threshold=cfg.hare.committee_size // 2 + 1,
             layers_per_epoch=cfg.layers_per_epoch,
             beacon_getter=self.beacon.get)
+
+        self.certifier.on_certificate = self._adopt_full_certificate
         self.miners = [miner_mod.ProposalBuilder(
             signer=s, db=self.state, cache=self.cache,
             oracle=self.oracle, tortoise=self.tortoise, cstate=self.cstate,
@@ -652,6 +654,7 @@ class App:
                 if await self.certifier.validate_certificate(layer, cert):
                     with self.state.tx():
                         miscstore.add_certificate(self.state, layer, cert)
+                    self._adopt_full_certificate(layer, block_id)
                     return True
                 self.fetch.report_failure(peer, 3)
             return False
@@ -809,6 +812,14 @@ class App:
                 self.peersync = None
             await self.host.stop()
             self.host = None
+
+    def _adopt_full_certificate(self, layer: int, block_id: bytes) -> None:
+        """A threshold certificate is the committee's decision for the
+        layer; a node whose own hare missed it (clock skew, late join)
+        must ADOPT it or diverge permanently when the tortoise margin
+        never crosses on a small committee (round-5 chaos flake). Fires
+        on gossip-assembled AND sync-fetched certificates."""
+        self.mesh.adopt_certified(layer, block_id)
 
     def _on_fork(self, divergent_layer: int) -> None:
         """Fork finder hit (reference syncer/find_fork.go): a peer's

@@ -14,7 +14,10 @@ from __future__ import annotations
 
 import asyncio
 import enum
+import logging
 from typing import Awaitable, Callable
+
+_log = logging.getLogger("sync")
 
 from .fetch import (
     Fetch,
@@ -230,6 +233,8 @@ class Syncer:
             # ingest the dissenting chain's data over the divergent span
             # (bounded per pass) so the tortoise can weigh it: the
             # dissenter's own layer opinion first, then the union view
+            _log.info("fork: divergence at layer %d (frontier %d), "
+                      "ingesting dissenting span", lo, frontier)
             await self._ingest_span(peer, lo, frontier)
             self.on_fork(lo)
             acted = True
@@ -265,6 +270,18 @@ class Syncer:
                 await self.fetch.get_hashes(HINT_BLOCK, blocks)
             if ballots:
                 await self.fetch.get_hashes(HINT_BALLOT, ballots)
+            # the divergent span's CERTIFICATES too: a layer this node
+            # applied differently (e.g. empty, on a skewed clock) heals
+            # through validated cert adoption, not just ballot weight —
+            # the same processor the normal sync path uses
+            for d in datas:
+                if d.certified != bytes(32) \
+                        or getattr(d, "cert_candidates", []):
+                    try:
+                        await self.process_layer(layer, d)
+                        break  # adopted from this view
+                    except Exception:  # noqa: BLE001 — try the next view
+                        continue
 
     async def _sync_beacon(self, epoch: int) -> None:
         """Adopt peers' beacon for the epoch (late joiners never ran the

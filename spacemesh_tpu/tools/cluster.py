@@ -204,6 +204,14 @@ class Cluster:
         for node in nodes or self.nodes:
             while True:
                 if not node.alive():
+                    # a node that EXITED CLEANLY ran through its
+                    # configured until_layer — if that covers the
+                    # requested layer, it reached it (its API is just
+                    # gone); anything else is a real death
+                    if node.proc is not None and node.proc.poll() == 0 \
+                            and self.until_layer is not None \
+                            and self.until_layer >= layer:
+                        break
                     raise RuntimeError(f"{node.name} died "
                                        f"(log: {node.log_path})")
                 try:
@@ -223,6 +231,25 @@ class Cluster:
         for node in nodes or self.nodes:
             info = node.api(f"/v1/mesh/layer/{layer}")
             out[node.name] = info.get("state_hash")
+        return out
+
+    def db_state_hashes(self, layer: int,
+                        nodes: list[NodeProc] | None = None
+                        ) -> dict[str, str | None]:
+        """State hashes straight from each node's state.db — the
+        post-mortem convergence check once nodes have exited cleanly
+        and their APIs are gone (WAL files persist the applied state)."""
+        from ..storage import db as dbmod
+        from ..storage import layers as layerstore
+
+        out: dict[str, str | None] = {}
+        for node in nodes or self.nodes:
+            d = dbmod.open_state(node.dir / "state.db")
+            try:
+                h = layerstore.state_hash(d, layer)
+                out[node.name] = h.hex() if h else None
+            finally:
+                d.close()
         return out
 
     def converged(self, layer: int,

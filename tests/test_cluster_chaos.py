@@ -23,9 +23,13 @@ LAYER_SEC = 1.0
 
 @pytest.fixture(scope="module")
 def cluster(tmp_path_factory):
+    # until_layer sizes the healing window: after the timeskew resets,
+    # the skewed node must fork-find + resync BEFORE its clean exit —
+    # under full-suite load that takes a while (round-5 flake: 7*LPE
+    # left it diverged at exit)
     c = Cluster(tmp_path_factory.mktemp("chaos"), N, smeshers=SMESHERS,
                 layer_sec=LAYER_SEC, lpe=LPE, spinup=90.0,
-                until_layer=7 * LPE)  # nodes must outlive every assertion
+                until_layer=14 * LPE)
     c.start()
     try:
         c.wait_api(timeout=210)
@@ -56,21 +60,32 @@ def test_timeskew_then_kill_then_converge(cluster):
     survivors = [n for n in c.nodes if n is not victim]
     target = 3 * LPE + 1
     c.wait_layer(target + 1, timeout=180, nodes=survivors)
+    # On a machine loaded with the rest of the suite, the survivors can
+    # reach until_layer and EXIT (cleanly) while this loop is still
+    # polling — at which point every API call is connection-refused
+    # (the one full-suite flake of round 5). A clean exit is not a
+    # failure: the final verdict then comes from the nodes' databases.
     deadline = time.time() + 180
     ok = False
+    hashes: dict = {}
     while time.time() < deadline and not ok:
+        if all(not n.alive() and n.proc.poll() == 0 for n in survivors):
+            hashes = c.db_state_hashes(target, nodes=survivors)
+            vals = set(hashes.values())
+            ok = len(vals) == 1 and None not in vals
+            break
         try:
             ok = c.converged(target, nodes=survivors)
         except OSError:  # a node mid-restart/poll race: retry
             ok = False
         time.sleep(LAYER_SEC / 2)
-    assert ok, c.state_hashes(target, nodes=survivors)
+    assert ok, hashes or "no convergence while nodes were live"
 
 
 def test_survivors_exit_clean(cluster):
     c = cluster
     victim = c.nodes[-2]
-    deadline = time.time() + c.spinup + 8 * LPE * LAYER_SEC + 240
+    deadline = time.time() + c.spinup + 15 * LPE * LAYER_SEC + 240
     for node in c.nodes:
         if node is victim:
             continue
