@@ -1,16 +1,28 @@
 """Headline benchmark: POST init labels/sec on one chip (mainnet N=8192).
 
-Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": "labels/s", "vs_baseline": N}
+Prints TWO JSON lines. The headline first:
+  {"metric": "post_init_labels_per_sec...", "value": N, "unit": "labels/s",
+   "vs_baseline": N}
+then the compile cost, tracked separately from steady-state throughput:
+  {"metric": "post_init_compile_s", "value": N, "unit": "s", ...}
+
+Steady state is measured pipelined — all reps dispatched back-to-back and
+synced once at the end, the way the streaming initializer drives the
+device — so inter-rep host sync gaps don't pollute the number. Compiled
+executables are reused across reps and across runs: the persistent
+compilation cache (utils/accel.py) makes the 17-26s per-shape compile a
+once-per-machine cost, so `post_init_compile_s` on a warm host drops to
+the cache-deserialize time.
 
 vs_baseline is the speedup over the reference CPU labeling path measured
 in-process (hashlib.scrypt = OpenSSL scrypt, the same labeling function the
 reference's CPU provider computes; the reference publishes no numbers of
 its own — BASELINE.md). Progress goes to stderr; stdout carries only the
-JSON line.
+JSON lines.
 
 Env knobs: BENCH_BATCH (label lanes per program), BENCH_N (scrypt N),
-BENCH_REPS, BENCH_CPU_LABELS.
+BENCH_REPS, BENCH_CPU_LABELS, SPACEMESH_JAX_CACHE (cache dir, `off` to
+disable).
 """
 
 import hashlib
@@ -48,11 +60,18 @@ def main() -> None:
 
     from spacemesh_tpu.utils import accel
 
+    cache_dir = accel.enable_persistent_cache()
+    log(f"persistent compile cache: {cache_dir or 'disabled'}")
+
     fallback = ""
     if not accel.ensure_usable_platform():
         log("accelerator unreachable; falling back to CPU platform")
         fallback = "_cpufallback"
+        # big batches only waste compile time on host CPU; add a smaller
+        # candidate the TPU sweep skips (cache-friendlier ROMix scratch)
         batches = [b for b in batches if b <= 2048] or [1024]
+        if 512 not in batches:
+            batches.append(512)
 
     import jax
     import jax.numpy as jnp
@@ -64,6 +83,7 @@ def main() -> None:
     log(f"device: {dev} platform={dev.platform}")
 
     cw = jnp.asarray(scrypt.commitment_to_words(commitment))
+    compile_times: dict[int, float] = {}
 
     def measure(batch: int) -> float:
         idx = np.arange(batch, dtype=np.uint64)
@@ -72,15 +92,17 @@ def main() -> None:
         t0 = time.perf_counter()
         out = scrypt.scrypt_labels_jit(cw, lo, hi, n=n)
         out.block_until_ready()
-        log(f"batch={batch}: compile+first run "
-            f"{time.perf_counter() - t0:.1f}s")
-        rate = 0.0
-        for _ in range(reps):
-            t0 = time.perf_counter()
-            out = scrypt.scrypt_labels_jit(cw, lo, hi, n=n)
-            out.block_until_ready()
-            rate = max(rate, batch / (time.perf_counter() - t0))
-        return rate
+        compile_s = time.perf_counter() - t0
+        compile_times.setdefault(batch, compile_s)
+        log(f"batch={batch}: compile+first run {compile_s:.1f}s")
+        # steady state: the compiled executable is reused for every rep,
+        # all reps enqueued back-to-back, one sync at the end (pipelined,
+        # as post/initializer.py drives the device)
+        t0 = time.perf_counter()
+        outs = [scrypt.scrypt_labels_jit(cw, lo, hi, n=n)
+                for _ in range(reps)]
+        jax.block_until_ready(outs)
+        return reps * batch / (time.perf_counter() - t0)
 
     best_rate, best_batch = 0.0, 0
     for batch in batches:
@@ -121,6 +143,14 @@ def main() -> None:
         "value": round(best_rate, 1),
         "unit": "labels/s",
         "vs_baseline": round(best_rate / cpu_rate, 2),
+    }))
+    # compile cost of the winning shape, reported separately: near-zero on
+    # a warm persistent cache, the full XLA compile on a cold one
+    print(json.dumps({
+        "metric": "post_init_compile_s",
+        "value": round(compile_times.get(best_batch, 0.0), 2),
+        "unit": "s",
+        "cache_dir": cache_dir or "",
     }))
 
 

@@ -187,6 +187,106 @@ def scrypt_labels_jit(commitment_words, idx_lo, idx_hi, *, n: int):
     return _stage_finish(inner_mid, outer_mid, blk)
 
 
+# --- on-device VRF-nonce scan ----------------------------------------------
+#
+# The VRF nonce is the index of the numerically smallest LE-u128 label seen
+# during init. Doing that scan on host (np.lexsort per batch) forces a full
+# device->host round trip before every disk write; here it is a jitted
+# argmin reduction that runs right after the label batch, device-side, and
+# folds into a tiny running-minimum carry. The carry is donated, so across
+# batches the scan is a single rolling (6,) u32 buffer:
+#
+#   carry = [k3, k2, k1, k0, idx_hi, idx_lo]
+#
+# where k3..k0 are the u32 limbs of the LE-u128 label key, MOST significant
+# first (so lexicographic limb compare == u128 compare), and idx is the u64
+# global label index of that minimum. Ties keep the earlier index — same
+# first-occurrence semantics as np.lexsort.
+
+VRF_CARRY_WORDS = 6
+_U32_MAX = 0xFFFFFFFF
+
+
+def vrf_carry_init(best: tuple[int, int] | None = None,
+                   index: int = 0) -> np.ndarray:
+    """Fresh (or resumed) host-side carry. ``best`` is the (hi, lo) u64
+    halves of the current minimum label value, as stored in metadata."""
+    c = np.full((VRF_CARRY_WORDS,), _U32_MAX, dtype=np.uint32)
+    if best is not None:
+        hi, lo = best
+        c[0] = hi >> 32
+        c[1] = hi & _U32_MAX
+        c[2] = lo >> 32
+        c[3] = lo & _U32_MAX
+        c[4] = index >> 32
+        c[5] = index & _U32_MAX
+    return c
+
+
+def vrf_carry_decode(carry) -> tuple[int, tuple[int, int]] | None:
+    """Carry -> (index, (hi, lo)) or None when no label has been scanned."""
+    c = np.asarray(carry)
+    hi = int(c[0]) << 32 | int(c[1])
+    lo = int(c[2]) << 32 | int(c[3])
+    if hi == (_U32_MAX << 32 | _U32_MAX) and lo == hi:
+        return None
+    return int(c[4]) << 32 | int(c[5]), (hi, lo)
+
+
+@functools.partial(jax.jit, donate_argnums=(3,))
+def _stage_minscan(words, idx_lo, idx_hi, carry):
+    """Fold one label batch into the running LE-u128 minimum.
+
+    Returns ``(new_carry, snapshot)``: the donated rolling carry plus an
+    independently-buffered copy of the same value, so callers can retain a
+    per-batch snapshot while the carry buffer keeps rotating.
+    """
+    # LE-u128 key limbs, most significant first (labels are LE bytes; the
+    # (4, B) words are BE within each 4-byte group, so byteswap gives the
+    # LE u32 limbs and word order gives significance).
+    l3 = byteswap32(words[3])
+    l2 = byteswap32(words[2])
+    l1 = byteswap32(words[1])
+    l0 = byteswap32(words[0])
+    ff = jnp.uint32(_U32_MAX)
+    m3 = jnp.min(l3)
+    eq = l3 == m3
+    m2 = jnp.min(jnp.where(eq, l2, ff))
+    eq = eq & (l2 == m2)
+    m1 = jnp.min(jnp.where(eq, l1, ff))
+    eq = eq & (l1 == m1)
+    m0 = jnp.min(jnp.where(eq, l0, ff))
+    eq = eq & (l0 == m0)
+    b = l3.shape[0]
+    lane = jnp.min(jnp.where(eq, jnp.arange(b, dtype=jnp.int32),
+                             jnp.int32(b)))
+    batch = jnp.stack([m3, m2, m1, m0, idx_hi[lane], idx_lo[lane]])
+    c3, c2, c1, c0 = carry[0], carry[1], carry[2], carry[3]
+    lt = ((m3 < c3)
+          | ((m3 == c3) & ((m2 < c2)
+             | ((m2 == c2) & ((m1 < c1)
+                | ((m1 == c1) & (m0 < c0)))))))
+    new = jnp.where(lt, batch, carry)
+    return new, new + jnp.uint32(0)
+
+
+def scrypt_labels_with_min(commitment_words, idx_lo, idx_hi, carry, *,
+                           n: int):
+    """Label batch + running VRF minimum, fully device-side.
+
+    One host call enqueues the whole chain (PBKDF2 expand, ROMix, finish,
+    min-scan; the pipeline stays split into a few XLA programs — see the
+    compile note above — but no data returns to host). Returns
+    ``(words, new_carry, snapshot)``; ``carry`` is donated.
+    """
+    inner_mid, outer_mid, blk = _stage_expand(commitment_words, idx_lo,
+                                              idx_hi)
+    blk = _stage_romix(blk, n=n)
+    words = _stage_finish(inner_mid, outer_mid, blk)
+    new_carry, snapshot = _stage_minscan(words, idx_lo, idx_hi, carry)
+    return words, new_carry, snapshot
+
+
 def commitment_to_words(commitment: bytes) -> np.ndarray:
     if len(commitment) != 32:
         raise ValueError("commitment must be 32 bytes")

@@ -12,5 +12,7 @@ ICI. See mesh.py.
 from .mesh import (  # noqa: F401
     data_mesh,
     init_step_sharded,
+    labels_with_min_sharded,
+    replicate,
     scrypt_labels_sharded,
 )

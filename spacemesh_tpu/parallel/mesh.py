@@ -42,6 +42,32 @@ def _lane_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P(None, DATA_AXIS))
 
 
+def replicate(mesh: Mesh, value) -> jax.Array:
+    """Place ``value`` replicated across every device in the mesh (the
+    VRF-scan carry lives like this between sharded batches)."""
+    return jax.device_put(jnp.asarray(value), NamedSharding(mesh, P()))
+
+
+def labels_with_min_sharded(mesh: Mesh, commitment_words, idx_lo, idx_hi,
+                            carry, *, n: int):
+    """Sharded label batch chained to the on-device VRF min-scan.
+
+    Lane axis sharded over the mesh; the (6,) running-minimum carry is
+    replicated and donated, and the argmin reduction lowers to ICI
+    all-reduces under GSPMD. Returns ``(words, new_carry, snapshot)`` like
+    scrypt.scrypt_labels_with_min, with ``words`` lane-sharded so the host
+    can fetch and stripe each device's shard to disk independently.
+    """
+    bs = _batch_sharding(mesh)
+    idx_lo = jax.device_put(jnp.asarray(idx_lo), bs)
+    idx_hi = jax.device_put(jnp.asarray(idx_hi), bs)
+    cw = jnp.asarray(commitment_words)
+    if cw.ndim == 2:
+        cw = jax.device_put(cw, _lane_sharding(mesh))
+    return scrypt.scrypt_labels_with_min(cw, idx_lo, idx_hi,
+                                         replicate(mesh, carry), n=n)
+
+
 def scrypt_labels_sharded(mesh: Mesh, commitment_words, idx_lo, idx_hi,
                           *, n: int):
     """Label batch sharded over the mesh. Batch size must divide evenly.
@@ -63,8 +89,9 @@ def _init_step(commitment_words, idx_lo, idx_hi, threshold, *, n: int):
     words = scrypt.scrypt_labels_jit(commitment_words, idx_lo, idx_hi, n=n)
     # init statistics, all-reduced across the mesh by XLA:
     #  - how many labels fall under the proving threshold (K1 calibration)
-    #  - running minimum of the labels' top-64-bit keys (VRF-nonce scan;
-    #    exact LE-u128 argmin stays host-side in post/initializer.py)
+    #  - running minimum of the labels' top-64-bit keys (coarse scan; the
+    #    exact LE-u128 argmin is the device carry in ops/scrypt.py
+    #    _stage_minscan, used by labels_with_min_sharded above)
     k_hi = byteswap32(words[3]).astype(jnp.uint32)
     k_lo = byteswap32(words[2]).astype(jnp.uint32)
     qualifying = jnp.sum((words[0] < threshold).astype(jnp.int32))

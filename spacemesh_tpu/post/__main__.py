@@ -42,14 +42,19 @@ def cmd_init(a) -> int:
         a.data_dir, node_id=a.node_id_hex, commitment=a.commitment_hex,
         num_units=a.num_units, labels_per_unit=a.labels_per_unit,
         scrypt_n=a.scrypt_n, max_file_size=a.max_file_size,
-        batch_size=a.batch, progress=progress)
+        batch_size=a.batch, progress=progress,
+        inflight=a.inflight, writers=a.writers)
     print("", file=sys.stderr)
-    print(json.dumps({
+    out = {
         "labels_written": res.labels_written,
         "vrf_nonce": res.vrf_nonce,
         "labels_per_s": round(res.labels_per_s, 1),
         "elapsed_s": round(res.elapsed_s, 2),
-    }))
+    }
+    if a.stage_timings and res.stats is not None:
+        out["stages"] = {k: round(v, 3) if isinstance(v, float) else v
+                         for k, v in res.stats.as_dict().items()}
+    print(json.dumps(out))
     return 0
 
 
@@ -90,19 +95,24 @@ def cmd_benchmark(a) -> int:
     import numpy as np
 
     from ..ops import scrypt
+    from ..utils import accel
 
+    accel.enable_persistent_cache()
     dev = jax.devices()[0]
     cw = jnp.asarray(scrypt.commitment_to_words(bytes(32)))
     idx = np.arange(a.batch, dtype=np.uint64)
     lo_, hi_ = scrypt.split_indices(idx)
     lo, hi = jnp.asarray(lo_), jnp.asarray(hi_)
+    t0 = time.perf_counter()
     scrypt.scrypt_labels_jit(cw, lo, hi, n=a.scrypt_n).block_until_ready()
+    compile_s = time.perf_counter() - t0
     t0 = time.perf_counter()
     scrypt.scrypt_labels_jit(cw, lo, hi, n=a.scrypt_n).block_until_ready()
     dt = time.perf_counter() - t0
     print(json.dumps({
         "device": str(dev), "batch": a.batch, "scrypt_n": a.scrypt_n,
         "labels_per_s": round(a.batch / dt, 1),
+        "compile_s": round(compile_s, 2),
     }))
     return 0
 
@@ -119,8 +129,11 @@ def cmd_serve(a) -> int:
     """
     import asyncio
 
+    from ..utils import accel
     from .prover import ProofParams
     from .remote import WorkerServer, discover_identities
+
+    accel.enable_persistent_cache()
 
     params = ProofParams(k1=a.k1, k2=a.k2, k3=a.k3,
                          pow_difficulty=bytes.fromhex(a.pow_difficulty))
@@ -173,6 +186,14 @@ def main(argv=None) -> int:
     pi.add_argument("--scrypt-n", type=int, default=8192)
     pi.add_argument("--max-file-size", type=int, default=64 * 1024 * 1024)
     pi.add_argument("--batch", type=int, default=1 << 13)
+    pi.add_argument("--inflight", type=int, default=None,
+                    help="device batches in flight (default: "
+                    "SPACEMESH_INFLIGHT or 3)")
+    pi.add_argument("--writers", type=int, default=None,
+                    help="background disk-writer threads (default: "
+                    "SPACEMESH_WRITERS or 2)")
+    pi.add_argument("--stage-timings", action="store_true",
+                    help="include per-stage pipeline timings in the output")
     pi.set_defaults(fn=cmd_init)
 
     pp = sub.add_parser("prove", help="generate a proof over the challenge")

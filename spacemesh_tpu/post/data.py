@@ -13,6 +13,9 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
+import queue
+import threading
+import time
 from pathlib import Path
 
 from ..ops.scrypt import LABEL_BYTES
@@ -65,18 +68,30 @@ class LabelStore:
         return self.dir / f"postdata_{i}.bin"
 
     def write_labels(self, start_index: int, labels: bytes) -> None:
-        """Append ``labels`` (concatenated 16B records) at ``start_index``."""
+        """Write ``labels`` (concatenated 16B records) at ``start_index``.
+
+        Thread-safe: O_CREAT without O_TRUNC plus positioned pwrite, so
+        concurrent writers (the background pool, per-shard stripes) landing
+        in the same file never truncate or clobber each other's ranges.
+        """
         lpf = self.meta.labels_per_file
         idx = start_index
         off = 0
         while off < len(labels):
             fi, within = divmod(idx, lpf)
             take = min(len(labels) - off, (lpf - within) * LABEL_BYTES)
-            with open(self._file(fi), "r+b" if self._file(fi).exists() else "wb") as f:
-                f.seek(within * LABEL_BYTES)
-                f.write(labels[off:off + take])
+            fd = os.open(self._file(fi), os.O_CREAT | os.O_WRONLY, 0o644)
+            try:
+                os.pwrite(fd, labels[off:off + take], within * LABEL_BYTES)
+            finally:
+                os.close(fd)
             off += take
             idx += take // LABEL_BYTES
+
+    def start_writer(self, threads: int = 2,
+                     queue_depth: int = 8) -> "LabelWriter":
+        """A background writer pool bound to this store."""
+        return LabelWriter(self, threads=threads, queue_depth=queue_depth)
 
     def read_labels(self, start_index: int, count: int) -> bytes:
         lpf = self.meta.labels_per_file
@@ -96,3 +111,119 @@ class LabelStore:
             idx += take
             remaining -= take
         return bytes(out)
+
+
+class LabelWriter:
+    """Bounded-queue background writer pool over one LabelStore.
+
+    The streaming initializer hands fetched label bytes here instead of
+    writing inline, so disk IO overlaps accelerator compute and PCIe
+    fetches. The bounded queue gives backpressure: when disk falls behind,
+    ``submit`` blocks the dispatch loop (a visible stall, counted by the
+    caller) instead of buffering unboundedly.
+
+    Durability ordering: ``durable()`` is the label index up to which ALL
+    bytes are contiguously on disk (writes may complete out of order across
+    pool threads and mesh shard stripes). The initializer never persists a
+    metadata cursor beyond this point — that is the crash-consistency
+    contract the resume path relies on.
+    """
+
+    _STOP = object()
+
+    def __init__(self, store: LabelStore, threads: int = 2,
+                 queue_depth: int = 8):
+        self.store = store
+        self._q: queue.Queue = queue.Queue(maxsize=max(queue_depth, 1))
+        self._lock = threading.Lock()
+        self._idle = threading.Condition(self._lock)
+        self._done: dict[int, int] = {}   # completed start -> end
+        self._durable = store.meta.labels_written
+        self._inflight = 0
+        self._error: BaseException | None = None
+        self._closed = False
+        self.labels_submitted = 0
+        self.bytes_written = 0
+        self.write_seconds = 0.0
+        self._threads = [
+            threading.Thread(target=self._worker, daemon=True,
+                             name=f"label-writer-{i}")
+            for i in range(max(threads, 1))]
+        for t in self._threads:
+            t.start()
+
+    # -- dispatch side ------------------------------------------------------
+
+    def submit(self, start_index: int, labels: bytes) -> None:
+        """Enqueue one write; blocks when the queue is full (backpressure)."""
+        self._raise_if_failed()
+        if self._closed:
+            raise RuntimeError("writer is closed")
+        with self._lock:
+            self._inflight += 1
+        self.labels_submitted += len(labels) // LABEL_BYTES
+        self._q.put((start_index, labels))
+
+    def durable(self) -> int:
+        """Highest label index with every prior label contiguously on disk."""
+        with self._lock:
+            return self._durable
+
+    def queue_depth(self) -> int:
+        return self._q.qsize()
+
+    def drain(self) -> None:
+        """Block until every submitted write has hit the filesystem."""
+        with self._idle:
+            while self._inflight > 0 and self._error is None:
+                self._idle.wait(timeout=0.1)
+        self._raise_if_failed()
+
+    def close(self, drain: bool = True) -> None:
+        if self._closed:
+            return
+        try:
+            if drain and self._error is None:
+                self.drain()
+        finally:
+            # a drain() error must still stop the pool: workers keep
+            # consuming the queue even after a write failure, so the STOP
+            # sentinels always get through
+            self._closed = True
+            for _ in self._threads:
+                self._q.put(self._STOP)
+            for t in self._threads:
+                t.join(timeout=10)
+
+    def _raise_if_failed(self) -> None:
+        if self._error is not None:
+            raise RuntimeError("background label writer failed") \
+                from self._error
+
+    # -- pool side ----------------------------------------------------------
+
+    def _worker(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is self._STOP:
+                return
+            start, labels = item
+            t0 = time.perf_counter()
+            try:
+                self.store.write_labels(start, labels)
+            except BaseException as e:  # noqa: BLE001 — surfaced to caller
+                with self._idle:
+                    if self._error is None:
+                        self._error = e
+                    self._inflight -= 1
+                    self._idle.notify_all()
+                continue
+            count = len(labels) // LABEL_BYTES
+            with self._idle:
+                self.write_seconds += time.perf_counter() - t0
+                self.bytes_written += len(labels)
+                self._done[start] = start + count
+                while self._durable in self._done:
+                    self._durable = self._done.pop(self._durable)
+                self._inflight -= 1
+                self._idle.notify_all()

@@ -2,18 +2,29 @@
 
 The PostSetupManager equivalent (reference activation/post.go:185-449 drives
 CGo `initialization.Initialize`; here the labeler is the JAX kernel in
-ops/scrypt.py). Design:
+ops/scrypt.py). The init loop is a streaming pipeline with three decoupled
+stages (docs/POST_PIPELINE.md):
 
-- the label space [0, total_labels) is processed in device-sized batches;
-- dispatch is double-buffered: batch k+1 is enqueued on the accelerator
-  before batch k's bytes are fetched to host and written to disk, so disk
-  and TPU overlap;
-- after every flushed batch the resume metadata is atomically rewritten
-  (labels_written cursor + running VRF-nonce minimum), matching the
-  reference's NumLabelsWritten resume semantics;
-- the VRF nonce is the index of the numerically smallest label seen
-  (little-endian u128 compare), tracked on the fly as post-rs does during
-  init.
+  dispatch  — enqueue up to K label batches on the accelerator, each chained
+              to an on-device LE-u128 argmin that folds the batch into a
+              donated running-minimum carry (the VRF-nonce scan; no host
+              lexsort on the per-batch path);
+  fetch     — pop the oldest in-flight batch, copy its bytes to host (this
+              is the only per-batch device sync), per-shard when the batch
+              was sharded over a device mesh;
+  write     — hand the bytes to a bounded-queue background writer pool
+              (post/data.py LabelWriter), so disk, PCIe and compute overlap.
+
+Resume metadata is rewritten on a time/label interval rather than per
+batch, with one ordering rule: the persisted ``labels_written`` cursor is
+the writer pool's *durable* cursor (contiguous bytes on disk), never the
+dispatch frontier. The VRF scan may run ahead of the cursor — that is safe
+because labels are deterministic: resume recomputes them and the min-merge
+is idempotent.
+
+When more than one device is visible, batches route through
+parallel/mesh.py (data-parallel lane sharding) and each device shard's
+bytes are striped to the writer pool independently.
 
 Progress/status mirrors the reference's state machine
 (NotStarted/InProgress/Complete — activation/post.go:128-137).
@@ -23,17 +34,26 @@ from __future__ import annotations
 
 import dataclasses
 import enum
+import os
 import time
+from collections import deque
 from pathlib import Path
 from typing import Callable
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
 from ..ops import scrypt
-from .data import LabelStore, PostMetadata
+from ..utils import metrics
+from .data import LabelStore, LabelWriter, PostMetadata
 
 DEFAULT_BATCH = 1 << 13  # 8192 labels = 8 MiB ROMix scratch per 1k... tuned in bench
+DEFAULT_INFLIGHT = 3     # device batches in flight before the oldest is fetched
+DEFAULT_WRITERS = 2      # background writer threads
+DEFAULT_WRITER_QUEUE = 8  # pending writes before dispatch backpressure
+DEFAULT_META_INTERVAL_S = 5.0
+DEFAULT_META_INTERVAL_LABELS = 1 << 20
 
 
 class Status(enum.Enum):
@@ -45,39 +65,94 @@ class Status(enum.Enum):
 
 
 @dataclasses.dataclass
+class PipelineStats:
+    """Host-side per-stage accounting for one run (tools/profiler.py
+    --pipeline dumps this; the same numbers feed utils/metrics.py)."""
+
+    batches: int = 0
+    shards: int = 0
+    dispatch_s: float = 0.0   # host time spent enqueueing device work
+    fetch_s: float = 0.0      # blocked on device->host label copies
+    write_stall_s: float = 0.0  # blocked on writer-pool backpressure
+    write_s: float = 0.0      # filesystem time inside the writer pool
+    save_s: float = 0.0       # metadata rewrites
+    meta_saves: int = 0
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
 class InitResult:
     labels_written: int
     vrf_nonce: int
     elapsed_s: float
     labels_per_s: float
-
-
-def _le128_min(labels: np.ndarray) -> tuple[int, tuple[int, int]]:
-    """Index + (hi, lo) u64 pair of the numerically smallest LE-u128 label."""
-    flat = np.ascontiguousarray(labels)
-    lo = flat[:, :8].copy().view("<u8").ravel()
-    hi = flat[:, 8:].copy().view("<u8").ravel()
-    k = int(np.lexsort((lo, hi))[0])
-    return k, (int(hi[k]), int(lo[k]))
+    stats: PipelineStats | None = None
 
 
 class Initializer:
     """Fills (or resumes) one identity's POST data directory."""
 
+    # ``progress(done, total)`` reports the FETCH frontier — labels whose
+    # bytes reached the host and were handed to the writer pool. Up to the
+    # writer queue may still be in flight to disk; the durable cursor is
+    # what metadata persists (docs/POST_PIPELINE.md ordering rule).
     def __init__(self, data_dir: str | Path, meta: PostMetadata,
                  batch_size: int = DEFAULT_BATCH,
-                 progress: Callable[[int, int], None] | None = None):
+                 progress: Callable[[int, int], None] | None = None,
+                 inflight: int | None = None,
+                 writers: int | None = None,
+                 writer_queue: int = DEFAULT_WRITER_QUEUE,
+                 meta_interval_s: float = DEFAULT_META_INTERVAL_S,
+                 meta_interval_labels: int = DEFAULT_META_INTERVAL_LABELS,
+                 mesh="auto"):
         self.store = LabelStore(data_dir, meta)
         self.meta = meta
         self.batch = batch_size
         self.progress = progress
+        self.inflight = max(int(
+            inflight if inflight is not None
+            else os.environ.get("SPACEMESH_INFLIGHT", DEFAULT_INFLIGHT)), 1)
+        self.writers = max(int(
+            writers if writers is not None
+            else os.environ.get("SPACEMESH_WRITERS", DEFAULT_WRITERS)), 1)
+        self.writer_queue = writer_queue
+        self.meta_interval_s = meta_interval_s
+        self.meta_interval_labels = meta_interval_labels
+        self._mesh_arg = mesh
         self.status = (Status.COMPLETE
                        if meta.labels_written >= meta.total_labels
                        else Status.NOT_STARTED)
         self._stop = False
 
     def stop(self) -> None:
+        """Request stop. The run loop checks this BEFORE dispatching the
+        next batch, so stop latency is one fetch+drain, not a full batch
+        compute; the durable cursor of already-flushed batches is always
+        persisted on the way out."""
         self._stop = True
+
+    # -- mesh routing -------------------------------------------------------
+
+    def _resolve_mesh(self):
+        if self._mesh_arg is None:
+            return None
+        if self._mesh_arg != "auto":
+            return self._mesh_arg if self._mesh_arg.size > 1 else None
+        env = os.environ.get("SPACEMESH_MESH", "")
+        if env in ("0", "off"):
+            return None
+        if jax.device_count() <= 1:
+            return None
+        if jax.default_backend() == "cpu" and env not in ("1", "on"):
+            # virtual host devices (tests force 8): lane-sharding buys no
+            # real parallelism but costs an SPMD compile per shape
+            return None
+        from ..parallel import mesh as pmesh
+        return pmesh.data_mesh()
+
+    # -- the pipeline -------------------------------------------------------
 
     def run(self) -> InitResult:
         meta = self.meta
@@ -86,78 +161,179 @@ class Initializer:
         self.status = Status.IN_PROGRESS
         t0 = time.monotonic()
         written0 = meta.labels_written
+        stats = PipelineStats()
+        mesh = self._resolve_mesh()
+        cw = scrypt.commitment_to_words(commitment)
 
-        self._vrf = meta.vrf_nonce
-        self._vrf_key = None
-        if meta.vrf_nonce_value is not None:
+        # resumed (or fresh) running-minimum carry for the VRF scan
+        resumed = None
+        if meta.vrf_nonce_value is not None and meta.vrf_nonce is not None:
             v = bytes.fromhex(meta.vrf_nonce_value)
-            self._vrf_key = (int.from_bytes(v[8:], "little"),
-                             int.from_bytes(v[:8], "little"))
+            resumed = (int.from_bytes(v[8:], "little"),
+                       int.from_bytes(v[:8], "little"))
+        carry_host = scrypt.vrf_carry_init(resumed, meta.vrf_nonce or 0)
+        if mesh is not None:
+            from ..parallel import mesh as pmesh
+            carry = pmesh.replicate(mesh, carry_host)
+        else:
+            carry = jnp.asarray(carry_host)
+        # last snapshot whose batch has been retired; valid for saves even
+        # while the donated carry buffer keeps rotating on device
+        self._snapshot = carry_host
 
-        def batches():
-            start = meta.labels_written
-            while start < total:
-                count = min(self.batch, total - start)
-                idx = np.arange(start, start + count, dtype=np.uint64)
-                lo, hi = scrypt.split_indices(idx)
-                words = scrypt.scrypt_labels_jit(
-                    jnp.asarray(scrypt.commitment_to_words(commitment)),
-                    jnp.asarray(lo), jnp.asarray(hi), n=meta.scrypt_n)
-                yield start, count, words
-                start += count
-
-        # double buffer: batch k+1 is already enqueued on the device while
-        # batch k is fetched and written to disk
-        pending = None
-        for nxt in batches():
-            if pending is not None:
-                self._flush(pending)
+        writer = self.store.start_writer(self.writers, self.writer_queue)
+        pending: deque = deque()  # (start, count, words, snapshot)
+        self._last_save_t = time.monotonic()
+        self._last_save_labels = written0
+        try:
+            dispatched = written0
+            while dispatched < total and not self._stop:
+                count = min(self.batch, total - dispatched)
+                td = time.perf_counter()
+                words, carry, snap = self._dispatch(
+                    mesh, cw, dispatched, count, carry)
+                stats.dispatch_s += time.perf_counter() - td
+                stats.batches += 1
+                metrics.post_pipeline_dispatched.inc()
+                pending.append((dispatched, count, words, snap))
+                dispatched += count
+                metrics.post_pipeline_inflight.set(len(pending))
+                if len(pending) >= self.inflight:
+                    self._retire(pending.popleft(), writer, stats)
+                    self._maybe_save(writer, stats)
+            while pending and not self._stop:  # drain (stop still honored)
+                self._retire(pending.popleft(), writer, stats)
+                if pending:
+                    self._maybe_save(writer, stats)
             if self._stop:
                 self.status = Status.STOPPED
-                pending = None
-                break
-            pending = nxt
-        if pending is not None:
-            self._flush(pending)
+                pending.clear()  # discard in-flight device work
+            tw = time.perf_counter()
+            writer.drain()
+            stats.write_stall_s += time.perf_counter() - tw
+            self._save_meta(writer, stats)
+        finally:
+            stats.write_s = writer.write_seconds
+            writer.close(drain=False)
+            metrics.post_pipeline_inflight.set(0)
+            metrics.post_pipeline_queue_depth.set(0)
 
         if meta.labels_written >= total:
             self.status = Status.COMPLETE
         elapsed = time.monotonic() - t0
         done = meta.labels_written - written0
+        rate = done / elapsed if elapsed > 0 else 0.0
+        metrics.post_pipeline_labels_per_sec.set(rate)
+        for stage, secs in (("dispatch", stats.dispatch_s),
+                            ("fetch", stats.fetch_s),
+                            ("write", stats.write_s),
+                            ("stall", stats.write_stall_s)):
+            metrics.post_pipeline_stage_seconds.inc(secs, stage=stage)
         return InitResult(
             labels_written=meta.labels_written,
-            vrf_nonce=self._vrf if self._vrf is not None else -1,
+            vrf_nonce=meta.vrf_nonce if meta.vrf_nonce is not None else -1,
             elapsed_s=elapsed,
-            labels_per_s=done / elapsed if elapsed > 0 else 0.0,
+            labels_per_s=rate,
+            stats=stats,
         )
 
-    def _flush(self, item) -> None:
-        start, count, words = item
-        labels = np.frombuffer(scrypt.labels_to_bytes(words), dtype=np.uint8)
-        labels = labels.reshape(count, scrypt.LABEL_BYTES)
-        k, key = _le128_min(labels)
-        if self._vrf_key is None or key < self._vrf_key:
-            self._vrf = start + k
-            self._vrf_key = key
-        self.store.write_labels(start, labels.tobytes())
-        self.meta.labels_written = start + count
-        self.meta.vrf_nonce = self._vrf
-        hi, lo = self._vrf_key
-        self.meta.vrf_nonce_value = (
-            lo.to_bytes(8, "little") + hi.to_bytes(8, "little")).hex()
-        self.meta.save(self.store.dir)
+    def _dispatch(self, mesh, cw, start: int, count: int, carry):
+        """Enqueue one batch + min-scan on device; returns immediately."""
+        n = self.meta.scrypt_n
+        if mesh is not None:
+            from ..parallel import mesh as pmesh
+            # pad to a multiple of the mesh size by repeating the last
+            # index — duplicates cannot perturb the min scan (same value,
+            # first-occurrence index wins) and the pad lanes are trimmed
+            # before the bytes reach disk
+            pad = (-count) % mesh.size
+            idx = np.arange(start, start + count + pad, dtype=np.uint64)
+            idx[count:] = start + count - 1
+            lo, hi = scrypt.split_indices(idx)
+            return pmesh.labels_with_min_sharded(mesh, cw, lo, hi, carry, n=n)
+        idx = np.arange(start, start + count, dtype=np.uint64)
+        lo, hi = scrypt.split_indices(idx)
+        return scrypt.scrypt_labels_with_min(
+            jnp.asarray(cw), jnp.asarray(lo), jnp.asarray(hi), carry, n=n)
+
+    def _retire(self, item, writer: LabelWriter, stats: PipelineStats) -> None:
+        """Fetch the oldest in-flight batch and hand it to the writers."""
+        start, count, words, snap = item
+        shards = []  # (global start, (4, lanes) ndarray, valid lane count)
+        tf = time.perf_counter()
+        if len(getattr(words.sharding, "device_set", ())) > 1:
+            for shard in words.addressable_shards:
+                lane0 = shard.index[1].start or 0
+                if lane0 >= count:
+                    continue  # pure padding shard
+                arr = np.asarray(shard.data)
+                shards.append((start + lane0, arr,
+                               min(count - lane0, arr.shape[1])))
+        else:
+            shards.append((start, np.asarray(words), count))
+        stats.shards += len(shards)
+        stall = 0.0
+        for shard_start, arr, valid in shards:
+            # byte conversion is host fetch-side work; only the submit()
+            # wait is writer backpressure
+            data = scrypt.labels_to_bytes(arr)[:valid * scrypt.LABEL_BYTES]
+            ts = time.perf_counter()
+            writer.submit(shard_start, data)
+            stall += time.perf_counter() - ts
+        stats.fetch_s += time.perf_counter() - tf - stall
+        stats.write_stall_s += stall
+        if stall > 0:
+            metrics.post_pipeline_stall_seconds.inc(stall)
+        metrics.post_pipeline_queue_depth.set(writer.queue_depth())
+        self._snapshot = snap
         if self.progress:
-            self.progress(self.meta.labels_written, self.meta.total_labels)
+            self.progress(start + count, self.meta.total_labels)
+
+    # -- metadata durability -------------------------------------------------
+
+    def _maybe_save(self, writer: LabelWriter, stats: PipelineStats) -> None:
+        now = time.monotonic()
+        durable = writer.durable()
+        if (now - self._last_save_t < self.meta_interval_s
+                and durable - self._last_save_labels
+                < self.meta_interval_labels):
+            return
+        self._save_meta(writer, stats)
+
+    def _save_meta(self, writer: LabelWriter, stats: PipelineStats) -> None:
+        """Persist resume metadata. Ordering rule: the cursor is the
+        writer's durable (contiguous-on-disk) label count — never the
+        dispatch or fetch frontier."""
+        meta = self.meta
+        t0 = time.perf_counter()
+        durable = writer.durable()
+        decoded = scrypt.vrf_carry_decode(self._snapshot)
+        meta.labels_written = durable
+        if decoded is not None:
+            idx, (hi, lo) = decoded
+            meta.vrf_nonce = idx
+            meta.vrf_nonce_value = (
+                lo.to_bytes(8, "little") + hi.to_bytes(8, "little")).hex()
+        meta.save(self.store.dir)
+        stats.meta_saves += 1
+        stats.save_s += time.perf_counter() - t0
+        metrics.post_pipeline_meta_saves.inc()
+        self._last_save_t = time.monotonic()
+        self._last_save_labels = durable
 
 
 def initialize(data_dir: str | Path, *, node_id: bytes, commitment: bytes,
                num_units: int, labels_per_unit: int, scrypt_n: int = 8192,
                max_file_size: int = 64 * 1024 * 1024,
                batch_size: int = DEFAULT_BATCH,
-               progress: Callable[[int, int], None] | None = None
-               ) -> tuple[PostMetadata, InitResult]:
+               progress: Callable[[int, int], None] | None = None,
+               **pipeline_opts) -> tuple[PostMetadata, InitResult]:
     """Create-or-resume an init session (the `PostSetupManager.StartSession`
-    equivalent). Returns final metadata + timing."""
+    equivalent). Returns final metadata + timing. ``pipeline_opts`` pass
+    through to Initializer (inflight, writers, mesh, meta intervals)."""
+    from ..utils import accel
+
+    accel.enable_persistent_cache()
     dir_ = Path(data_dir)
     if (dir_ / "postdata_metadata.json").exists():
         meta = PostMetadata.load(dir_)
@@ -175,6 +351,7 @@ def initialize(data_dir: str | Path, *, node_id: bytes, commitment: bytes,
             node_id=node_id.hex(), commitment=commitment.hex(),
             scrypt_n=scrypt_n, num_units=num_units,
             labels_per_unit=labels_per_unit, max_file_size=max_file_size)
-    init = Initializer(dir_, meta, batch_size=batch_size, progress=progress)
+    init = Initializer(dir_, meta, batch_size=batch_size, progress=progress,
+                       **pipeline_opts)
     res = init.run()
     return meta, res

@@ -157,7 +157,8 @@ class RemotePostClient:
             node_id=bytes.fromhex(i["node_id"]),
             commitment=bytes.fromhex(i["commitment"]),
             num_units=i["num_units"], labels_per_unit=i["labels_per_unit"],
-            scrypt_n=i["scrypt_n"], vrf_nonce=i["vrf_nonce"])
+            scrypt_n=i["scrypt_n"], vrf_nonce=i["vrf_nonce"],
+            labels_written=i.get("labels_written", 0))
 
     def proof(self, challenge: bytes) -> tuple[Proof, PostMetadata]:
         d = self._call({"method": "proof", "node_id": self.node_id.hex(),

@@ -27,6 +27,10 @@ class PostInfo:
     labels_per_unit: int
     scrypt_n: int
     vrf_nonce: int
+    # durable labels on disk; < num_units * labels_per_unit while a
+    # streaming init is still in flight (interval metadata saves mean this
+    # advances during init, not just at the end)
+    labels_written: int = 0
 
 
 class PostClient:
@@ -49,6 +53,7 @@ class PostClient:
             labels_per_unit=meta.labels_per_unit,
             scrypt_n=meta.scrypt_n,
             vrf_nonce=meta.vrf_nonce if meta.vrf_nonce is not None else -1,
+            labels_written=meta.labels_written,
         )
 
     def proof(self, challenge: bytes) -> tuple[Proof, PostMetadata]:

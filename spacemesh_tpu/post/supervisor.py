@@ -53,6 +53,13 @@ class PostSupervisor:
         env = dict(os.environ if self.env is None else self.env)
         repo_root = str(Path(__file__).resolve().parent.parent.parent)
         env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+        # every (re)spawned worker shares the machine's persistent XLA
+        # compile cache — a crash-restart must not pay the per-shape
+        # compile again (utils/accel.py enable_persistent_cache)
+        if "SPACEMESH_JAX_CACHE" not in env:
+            cache = os.environ.get("SPACEMESH_JAX_CACHE")
+            if cache is not None:
+                env["SPACEMESH_JAX_CACHE"] = cache
         # keep the worker's port stable across restarts so clients reconnect
         listen = self.listen
         if self.address is not None:

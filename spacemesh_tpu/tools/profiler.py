@@ -17,7 +17,11 @@ The TPU-native equivalents:
 Usage:
   python -m spacemesh_tpu.tools.profiler --providers
   python -m spacemesh_tpu.tools.profiler --n 8192 --batches 1024,2048
-Prints ONE JSON document on stdout; progress goes to stderr.
+  python -m spacemesh_tpu.tools.profiler --pipeline --n 8192   # per-stage
+Prints ONE JSON document on stdout; progress goes to stderr. --pipeline
+runs a real (tiny) init through the streaming pipeline and dumps per-stage
+host seconds (dispatch/fetch/write/stall) so stalls are visible without a
+full profile (docs/POST_PIPELINE.md).
 """
 
 from __future__ import annotations
@@ -150,6 +154,43 @@ def benchmark(n: int, batches: list[int], reps: int,
             "recommendation": recommendation}
 
 
+def pipeline_benchmark(n: int, labels: int, batch: int,
+                       inflight: int | None = None,
+                       writers: int | None = None,
+                       probe: bool = True) -> dict:
+    """Per-stage timings of the streaming init pipeline (dispatch/fetch/
+    write/stall), so an operator can see where a slow init spends its time
+    without a full profile. Runs a real (tiny) init through
+    post/initializer.py and dumps its PipelineStats."""
+    import tempfile
+
+    from ..post import initializer
+    from ..utils import accel
+
+    if probe and not accel.ensure_usable_platform():
+        _log("accelerator unreachable; JAX restricted to CPU")
+    node = hashlib.sha256(b"profiler-pipe-node").digest()
+    commit = hashlib.sha256(b"profiler-pipe-commit").digest()
+    with tempfile.TemporaryDirectory() as d:
+        _, res = initializer.initialize(
+            d, node_id=node, commitment=commit, num_units=1,
+            labels_per_unit=labels, scrypt_n=n,
+            max_file_size=64 * 1024 * 1024, batch_size=batch,
+            inflight=inflight, writers=writers)
+    stats = res.stats.as_dict() if res.stats else {}
+    doc = {
+        "scrypt_n": n, "labels": labels, "batch": batch,
+        "labels_per_sec": round(res.labels_per_s, 1),
+        "elapsed_s": round(res.elapsed_s, 2),
+        "stages": {k: (round(v, 4) if isinstance(v, float) else v)
+                   for k, v in stats.items()},
+    }
+    busiest = max(("dispatch_s", "fetch_s", "write_stall_s"),
+                  key=lambda k: stats.get(k, 0.0))
+    doc["bottleneck"] = busiest
+    return doc
+
+
 def verify_benchmark(counts: list[int], reps: int = 2,
                      probe: bool = True) -> dict:
     """Proof-verification throughput (BASELINE config 3: batch of NIPoST
@@ -209,6 +250,16 @@ def main(argv=None) -> int:
                     help="benchmark proof verification instead of labels")
     ap.add_argument("--verify-batches", default="100,1000",
                     help="comma-separated proof batch sizes for --verify")
+    ap.add_argument("--pipeline", action="store_true",
+                    help="profile the streaming init pipeline per stage "
+                    "(dispatch/fetch/write/stall)")
+    ap.add_argument("--pipeline-labels", type=int, default=4096,
+                    help="labels for the --pipeline run")
+    ap.add_argument("--pipeline-batch", type=int, default=1024)
+    ap.add_argument("--inflight", type=int, default=None,
+                    help="in-flight device batches for --pipeline")
+    ap.add_argument("--writers", type=int, default=None,
+                    help="writer threads for --pipeline")
     ap.add_argument("--n", type=int, default=8192, help="scrypt N")
     ap.add_argument("--batches", default="1024,2048,4096",
                     help="comma-separated label lanes per program")
@@ -222,6 +273,12 @@ def main(argv=None) -> int:
     if a.providers:
         print(json.dumps({"providers": providers(probe=not a.no_probe)},
                          indent=2))
+        return 0
+    if a.pipeline:
+        doc = pipeline_benchmark(
+            a.n, a.pipeline_labels, a.pipeline_batch,
+            inflight=a.inflight, writers=a.writers, probe=not a.no_probe)
+        print(json.dumps(doc, indent=2))
         return 0
     if a.verify:
         doc = verify_benchmark(

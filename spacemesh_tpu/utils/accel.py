@@ -17,6 +17,42 @@ import sys
 
 PROBE_TIMEOUT_S = 120
 
+DEFAULT_CACHE_DIR = "~/.cache/spacemesh_tpu/jax_cache"
+_cache_enabled: str | None = None
+
+
+def enable_persistent_cache(path: str | None = None) -> str | None:
+    """Point JAX's persistent compilation cache at a per-machine directory.
+
+    The labeler pays 17-26s of XLA compile per (batch, N) shape; the cache
+    makes that a once-per-machine cost — a second bench/init run on the
+    same host deserializes the executable in well under a second. Knob:
+    ``SPACEMESH_JAX_CACHE`` (a directory, or ``off``/``0`` to disable);
+    an explicit ``path`` argument wins. Idempotent; returns the directory
+    in effect (None when disabled)."""
+    global _cache_enabled
+    env = os.environ.get("SPACEMESH_JAX_CACHE")
+    if path is None and env in ("0", "off", "none"):
+        return None
+    dir_ = os.path.expanduser(path or env or DEFAULT_CACHE_DIR)
+    if _cache_enabled == dir_:
+        return dir_
+    try:
+        os.makedirs(dir_, exist_ok=True)
+    except OSError as e:
+        # the cache is an optimization: an unwritable HOME (read-only
+        # container, sandboxed CI) must not break init/bench/tests
+        print(f"persistent compile cache disabled ({e})", file=sys.stderr)
+        return None
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", dir_)
+    # the tiny per-test compiles are worth caching too — loading beats
+    # recompiling well below the 1s default threshold
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)
+    _cache_enabled = dir_
+    return dir_
+
 
 def accelerator_reachable(timeout_s: int = PROBE_TIMEOUT_S) -> bool:
     """``jax.devices()`` in a SUBPROCESS with a hard timeout."""

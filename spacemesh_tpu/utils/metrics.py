@@ -141,3 +141,24 @@ sync_state_gauge = REGISTRY.gauge(
 tortoise_mode_gauge = REGISTRY.gauge(
     "tortoise_mode", "0 verifying, 1 full (reference tortoise/metrics.go)")
 applied_gauge = REGISTRY.gauge("mesh_last_applied_layer", "applied frontier")
+
+# POST init streaming pipeline (post/initializer.py). Stage seconds carry a
+# stage label (dispatch/fetch/write/stall) so an operator can see where a
+# slow init is actually spending its time without a full profile.
+post_pipeline_dispatched = REGISTRY.counter(
+    "post_pipeline_batches_dispatched_total",
+    "label batches enqueued on the accelerator")
+post_pipeline_inflight = REGISTRY.gauge(
+    "post_pipeline_inflight_batches", "device batches currently in flight")
+post_pipeline_queue_depth = REGISTRY.gauge(
+    "post_pipeline_write_queue_depth", "label writes queued for disk")
+post_pipeline_stall_seconds = REGISTRY.counter(
+    "post_pipeline_stall_seconds_total",
+    "dispatch-loop seconds blocked on writer backpressure")
+post_pipeline_stage_seconds = REGISTRY.counter(
+    "post_pipeline_stage_seconds_total",
+    "host seconds per pipeline stage (label=stage)")
+post_pipeline_meta_saves = REGISTRY.counter(
+    "post_pipeline_meta_saves_total", "interval resume-metadata rewrites")
+post_pipeline_labels_per_sec = REGISTRY.gauge(
+    "post_pipeline_labels_per_sec", "labels/s of the last init session")

@@ -24,3 +24,10 @@ if "xla_force_host_platform_device_count" not in _flags:
 import jax  # noqa: E402  (import order is the point here)
 
 jax.config.update("jax_platforms", "cpu")
+
+# persistent XLA compile cache: the suite's jit compiles are paid once per
+# machine, not once per pytest invocation (utils/accel.py; SPACEMESH_JAX_CACHE
+# still wins, =off disables)
+from spacemesh_tpu.utils import accel  # noqa: E402
+
+accel.enable_persistent_cache()

@@ -7,9 +7,21 @@ import numpy as np
 import pytest
 
 from spacemesh_tpu.ops import proving, scrypt
-from spacemesh_tpu.parallel import data_mesh, init_step_sharded, scrypt_labels_sharded
+from spacemesh_tpu.parallel import (
+    data_mesh,
+    init_step_sharded,
+    labels_with_min_sharded,
+    scrypt_labels_sharded,
+)
 
 COMMIT = hashlib.sha256(b"c").digest()
+
+
+def _host_min(labels: np.ndarray) -> tuple[int, bytes]:
+    lo = labels[:, :8].copy().view("<u8").ravel()
+    hi = labels[:, 8:].copy().view("<u8").ravel()
+    k = int(np.lexsort((lo, hi))[0])
+    return k, bytes(labels[k])
 
 
 def test_mesh_has_8_devices():
@@ -65,3 +77,59 @@ def test_init_step_stats():
             | labels[:, 13].astype(np.uint64) << 8
             | labels[:, 12].astype(np.uint64))
     assert int(min_hi) == int(k_hi.min())
+
+
+@pytest.mark.parametrize("ndev", [1, 2, 4])
+def test_labels_with_min_sharded_matches_single_device(ndev):
+    """scrypt_labels over a 1/2/4-device mesh is bit-identical to the
+    single-device path, and the on-device VRF scan lands on the same
+    first-occurrence LE-u128 minimum as the host lexsort."""
+    total = 512
+    idx = np.arange(total, dtype=np.uint64)
+    want = scrypt.scrypt_labels(COMMIT, idx, n=4)
+    want_k, want_val = _host_min(want)
+
+    mesh = data_mesh(jax.devices()[:ndev])
+    cw = scrypt.commitment_to_words(COMMIT)
+    carry = scrypt.vrf_carry_init()
+    got = []
+    for start in range(0, total, 128):  # batched, carry chained across
+        lo, hi = scrypt.split_indices(idx[start:start + 128])
+        words, carry, snap = labels_with_min_sharded(
+            mesh, cw, lo, hi, carry, n=4)
+        got.append(np.frombuffer(
+            scrypt.labels_to_bytes(np.asarray(words)),
+            dtype=np.uint8).reshape(-1, 16))
+    assert np.array_equal(np.concatenate(got), want)
+    decoded = scrypt.vrf_carry_decode(snap)
+    assert decoded is not None
+    k, (hi_, lo_) = decoded
+    assert k == want_k
+    assert (lo_.to_bytes(8, "little") + hi_.to_bytes(8, "little")) == want_val
+
+
+@pytest.mark.parametrize("ndev", [2, 4])
+def test_initializer_sharded_equals_single_device(tmp_path, ndev):
+    """A full streaming init over a sub-mesh produces bit-identical label
+    files and the same VRF nonce as the single-device init — including a
+    final partial batch that does not divide the mesh (pad+trim path)."""
+    from spacemesh_tpu.post import initializer
+    from spacemesh_tpu.post.data import LabelStore
+
+    node = hashlib.sha256(b"mesh-node").digest()
+    total, batch = 649, 256  # final batch of 137 labels: pad+trim on 2 and 4
+
+    def run(sub, mesh):
+        d = tmp_path / sub
+        meta, _ = initializer.initialize(
+            d, node_id=node, commitment=COMMIT, num_units=1,
+            labels_per_unit=total, scrypt_n=4, max_file_size=1 << 20,
+            batch_size=batch, mesh=mesh)
+        store = LabelStore(d, meta)
+        return meta, store.read_labels(0, total)
+
+    meta1, bytes1 = run("single", None)
+    meshed, bytesn = run(f"mesh{ndev}", data_mesh(jax.devices()[:ndev]))
+    assert bytes1 == bytesn
+    assert meta1.vrf_nonce == meshed.vrf_nonce
+    assert meta1.vrf_nonce_value == meshed.vrf_nonce_value

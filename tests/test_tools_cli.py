@@ -291,3 +291,22 @@ def test_profiler_verify_benchmark(capsys):
     rates = doc["verify"]
     assert [r["batch"] for r in rates] == [10, 20]
     assert all(r["proofs_per_sec"] > 0 for r in rates)
+
+
+def test_profiler_pipeline_stage_timings(capsys):
+    """--pipeline dumps per-stage (dispatch/fetch/write/stall) host
+    seconds of a real streaming init, so a stalled stage is visible
+    without a full profile (docs/POST_PIPELINE.md)."""
+    import json as _json
+
+    from spacemesh_tpu.tools import profiler
+
+    assert profiler.main(["--pipeline", "--n", "2",
+                          "--pipeline-labels", "512",
+                          "--pipeline-batch", "256", "--no-probe"]) == 0
+    doc = _json.loads(capsys.readouterr().out)
+    assert doc["labels_per_sec"] > 0
+    assert set(doc["stages"]) >= {"dispatch_s", "fetch_s",
+                                  "write_stall_s", "write_s"}
+    assert doc["stages"]["batches"] == 2
+    assert doc["bottleneck"] in ("dispatch_s", "fetch_s", "write_stall_s")
