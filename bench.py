@@ -20,9 +20,18 @@ reference's CPU provider computes; the reference publishes no numbers of
 its own — BASELINE.md). Progress goes to stderr; stdout carries only the
 JSON lines.
 
+After the POST metrics, a verification benchmark (ISSUE 2) runs a mixed
+workload (ed25519 sigs + VRF proofs + POST proofs + poet memberships,
+>=10% invalid) through the inline serial path and through the
+verification farm (spacemesh_tpu/verify/), emitting:
+  {"metric": "verify_serial_s", ...}
+  {"metric": "verify_batched_s", ..., "speedup": serial/batched}
+Both paths are warmed first so the numbers compare steady-state
+throughput, not XLA compile time; decisions are asserted bit-identical.
+
 Env knobs: BENCH_BATCH (label lanes per program), BENCH_N (scrypt N),
-BENCH_REPS, BENCH_CPU_LABELS, SPACEMESH_JAX_CACHE (cache dir, `off` to
-disable).
+BENCH_REPS, BENCH_CPU_LABELS, BENCH_VERIFY_ITEMS (0 disables the verify
+bench), SPACEMESH_JAX_CACHE (cache dir, `off` to disable).
 """
 
 import hashlib
@@ -47,6 +56,48 @@ def cpu_labels_per_sec(commitment: bytes, n: int, count: int) -> float:
 
 # probe + CPU fallback shared with tools/profiler.py — ONE copy of the
 # wedged-tunnel handling (spacemesh_tpu/utils/accel.py)
+
+
+def verify_bench(total_items: int) -> None:
+    """Serial vs farm-batched verification over one mixed workload."""
+    import tempfile
+
+    from spacemesh_tpu.verify import workload
+
+    # composition: POST-heavy (the workload this repo accelerates) plus
+    # the gossip sig/VRF/membership mix, ~12% invalid/malformed spread
+    # across every kind. POST requests replicate 24 distinct proofs
+    # (~8x, the gossip re-delivery fanout) — the farm's dedup is part of
+    # what is being measured and is reported in the output.
+    posts = max(total_items // 2, 8)
+    vrfs = max(total_items // 8, 8)
+    mems = max(total_items // 8, 8)
+    sigs = max(total_items - posts - vrfs - mems, 16)
+    with tempfile.TemporaryDirectory() as d:
+        log(f"verify workload: {sigs} sigs + {vrfs} vrfs + {mems} "
+            f"memberships + {posts} post proofs ...")
+        w = workload.build(d, sigs=sigs, vrfs=vrfs, posts=posts,
+                           memberships=mems, post_challenges=24)
+        doc = workload.compare_serial_vs_farm(w)
+
+    stats = doc["stats"]
+    log(f"verify: serial {doc['serial_s']:.2f}s, "
+        f"farm {doc['batched_s']:.2f}s "
+        f"({doc['items']} items, {doc['rejected']} rejected, "
+        f"occupancy<= {stats['max_occupancy']}, "
+        f"dedup {stats['dedup_hits']})")
+    print(json.dumps({
+        "metric": "verify_serial_s", "value": round(doc["serial_s"], 3),
+        "unit": "s", "items": doc["items"], "rejected": doc["rejected"],
+    }))
+    print(json.dumps({
+        "metric": "verify_batched_s", "value": round(doc["batched_s"], 3),
+        "unit": "s", "items": doc["items"],
+        "speedup": doc["speedup"],
+        "batches": stats["batches"],
+        "max_occupancy": stats["max_occupancy"],
+        "dedup_hits": stats["dedup_hits"],
+    }))
 
 
 def main() -> None:
@@ -152,6 +203,10 @@ def main() -> None:
         "unit": "s",
         "cache_dir": cache_dir or "",
     }))
+
+    verify_items = int(os.environ.get("BENCH_VERIFY_ITEMS", 512))
+    if verify_items > 0:
+        verify_bench(verify_items)
 
 
 if __name__ == "__main__":

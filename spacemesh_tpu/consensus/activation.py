@@ -42,6 +42,7 @@ from ..storage import atxs as atxstore
 from ..storage import misc as miscstore
 from ..storage.cache import AtxCache, AtxInfo
 from ..storage.db import Database
+from ..verify.farm import Lane, MembershipRequest, PostRequest, SigRequest
 from .poet import PoetService, verify_membership
 
 
@@ -95,7 +96,8 @@ class Handler:
                  golden_atx: bytes, post_params: ProofParams,
                  labels_per_unit: int, scrypt_n: int, pubsub: PubSub,
                  on_atx: Optional[Callable[[ActivationTx], None]] = None,
-                 now: Optional[Callable[[], float]] = None):
+                 now: Optional[Callable[[], float]] = None,
+                 farm=None):
         import time as _time
 
         self.now = now or _time.time  # the NODE's clock domain: receipt
@@ -108,6 +110,9 @@ class Handler:
         self.labels_per_unit = labels_per_unit
         self.scrypt_n = scrypt_n
         self.on_atx = on_atx
+        # verification farm (verify/farm.py); None = synchronous inline
+        # verification, the contract unit tests and tools rely on
+        self.farm = farm
         pubsub.register(TOPIC_ATX, self._gossip)
 
     async def _gossip(self, peer: bytes, data: bytes) -> bool:
@@ -115,7 +120,12 @@ class Handler:
             atx = ActivationTx.from_bytes(data)
         except (codec.DecodeError, ValueError):
             return False
-        return self.process(atx)
+        return await self.process_async(atx, lane=Lane.GOSSIP)
+
+    # NOTE: process() and process_async() are the same validation
+    # sequence — sync/inline vs farm-batched. tests/test_atx_v2.py::
+    # test_v1_process_async_parity_with_inline pins their decisions to
+    # each other; edit them together.
 
     def process(self, atx: ActivationTx) -> bool:
         if atxstore.has(self.db, atx.id):
@@ -135,24 +145,59 @@ class Handler:
         poet = miscstore.poet_proof(self.db, atx.nipost.post_metadata.challenge)
         if poet is None:
             return False
-        prev = atx.prev_atx
-        challenge = nipost_challenge(prev, atx.publish_epoch)
+        challenge = nipost_challenge(atx.prev_atx, atx.publish_epoch)
         if not verify_membership(challenge, atx.nipost.membership, poet.root,
                                  leaf_count=self._leaf_count(poet)):
             return False
         # POST proof: recompute labels at spot-checked indices
-        commitment = commitment_of(atx.node_id, self.golden_atx)
-        item = post_verifier.VerifyItem(
+        if not post_verifier.verify(self._verify_item(atx, poet, challenge),
+                                    self.post_params):
+            return False
+        return self._finish(atx, poet)
+
+    async def process_async(self, atx: ActivationTx,
+                            lane: Lane = Lane.GOSSIP) -> bool:
+        """process(), with every crypto check routed through the farm's
+        micro-batches; falls back to the inline path when no farm runs."""
+        if self.farm is None:
+            return self.process(atx)
+        if atxstore.has(self.db, atx.id):
+            return True
+        if not await self.farm.submit(
+                SigRequest(int(Domain.ATX), atx.node_id,
+                           atx.signed_bytes(), atx.signature), lane=lane):
+            return False
+        if atx.vrf_public_key != atx.node_id:
+            return False
+        poet = miscstore.poet_proof(self.db, atx.nipost.post_metadata.challenge)
+        if poet is None:
+            return False
+        challenge = nipost_challenge(atx.prev_atx, atx.publish_epoch)
+        if not await self.farm.submit(
+                MembershipRequest(challenge, atx.nipost.membership,
+                                  poet.root, self._leaf_count(poet)),
+                lane=lane):
+            return False
+        if not await self.farm.submit(
+                PostRequest(self._verify_item(atx, poet, challenge)),
+                lane=lane):
+            return False
+        return self._finish(atx, poet)
+
+    def _verify_item(self, atx: ActivationTx, poet,
+                     challenge: bytes) -> post_verifier.VerifyItem:
+        return post_verifier.VerifyItem(
             proof=PostProof(nonce=atx.nipost.post.nonce,
                             indices=list(atx.nipost.post.indices),
                             pow_nonce=atx.nipost.post.pow_nonce,
                             k2=self.post_params.k2),
             challenge=post_challenge(poet.root, challenge),
-            node_id=atx.node_id, commitment=commitment,
+            node_id=atx.node_id,
+            commitment=commitment_of(atx.node_id, self.golden_atx),
             scrypt_n=self.scrypt_n,
             total_labels=atx.num_units * self.labels_per_unit)
-        if not post_verifier.verify(item, self.post_params):
-            return False
+
+    def _finish(self, atx: ActivationTx, poet) -> bool:
         # double-publish detection (same node, same epoch, different atx)
         existing = atxstore.by_node_in_epoch(self.db, atx.node_id,
                                              atx.publish_epoch)

@@ -22,6 +22,7 @@ Design notes (TPU framework, not a wire copy):
 
 from __future__ import annotations
 
+import asyncio
 import dataclasses
 from typing import Optional
 
@@ -42,6 +43,7 @@ from ..storage import atxs as atxstore
 from ..storage import misc as miscstore
 from ..storage.cache import AtxCache, AtxInfo
 from ..storage.db import Database
+from ..verify.farm import Lane, MembershipRequest, PostRequest, SigRequest
 from .activation import commitment_of, nipost_challenge, post_challenge
 from .poet import verify_membership
 
@@ -54,7 +56,8 @@ class HandlerV2:
     def __init__(self, *, db: Database, cache: AtxCache,
                  verifier: EdVerifier, golden_atx: bytes,
                  post_params: ProofParams, labels_per_unit: int,
-                 scrypt_n: int, pubsub=None, on_atx=None, now=None):
+                 scrypt_n: int, pubsub=None, on_atx=None, now=None,
+                 farm=None):
         import time as _time
 
         self.now = now or _time.time
@@ -66,6 +69,8 @@ class HandlerV2:
         self.labels_per_unit = labels_per_unit
         self.scrypt_n = scrypt_n
         self.on_atx = on_atx
+        # verification farm (verify/farm.py); None = inline verification
+        self.farm = farm
         if pubsub is not None:
             pubsub.register(TOPIC_ATX_V2, self._gossip)
 
@@ -74,7 +79,7 @@ class HandlerV2:
             atx2 = ActivationTxV2.from_bytes(data)
         except (codec.DecodeError, ValueError):
             return False
-        return self.process(atx2)
+        return await self.process_async(atx2, lane=Lane.GOSSIP)
 
     def _married_to_primary(self, atx2: ActivationTxV2) -> set[bytes]:
         """Identities allowed inside this envelope: the primary, partners
@@ -86,6 +91,50 @@ class HandlerV2:
         if recorded is not None:
             allowed.update(miscstore.married_set(self.db, recorded))
         return allowed
+
+    # NOTE: process() and process_async() are the same validation
+    # sequence — sync/inline vs farm-batched (the per-subpost structure
+    # is shared via _subpost_prepare). tests/test_atx_v2.py::
+    # test_process_async_parity_with_inline pins their decisions to
+    # each other; edit them together.
+
+    def _equivocates(self, sp, atx2: ActivationTxV2) -> bool:
+        """Per-identity double-publish guard (marks malicious on hit)."""
+        existing = atxstore.by_node_in_epoch(self.db, sp.node_id,
+                                             atx2.publish_epoch)
+        if existing is not None and \
+                existing.id != atx2.identity_atx_id(sp.node_id):
+            self.cache.set_malicious(sp.node_id)
+            return True
+        return False
+
+    def _subpost_prepare(self, sp, atx2: ActivationTxV2):
+        """Structural per-subpost validation shared by both paths:
+        double-publish guard, poet lookup, VerifyItem + height math.
+        Returns (poet, challenge, item, prev_height) or None to reject.
+        Membership + POST verification stay with the caller (inline vs
+        farm-batched)."""
+        if self._equivocates(sp, atx2):
+            return None
+        poet = miscstore.poet_proof(self.db,
+                                    sp.nipost.post_metadata.challenge)
+        if poet is None:
+            return None
+        challenge = nipost_challenge(sp.prev_atx, atx2.publish_epoch)
+        item = post_verifier.VerifyItem(
+            proof=PostProof(nonce=sp.nipost.post.nonce,
+                            indices=list(sp.nipost.post.indices),
+                            pow_nonce=sp.nipost.post.pow_nonce,
+                            k2=self.post_params.k2),
+            challenge=post_challenge(poet.root, challenge),
+            node_id=sp.node_id,
+            commitment=commitment_of(sp.node_id, self.golden_atx),
+            scrypt_n=self.scrypt_n,
+            total_labels=sp.num_units * self.labels_per_unit)
+        prev_height = 0
+        if sp.prev_atx != EMPTY32:
+            prev_height = atxstore.tick_height(self.db, sp.prev_atx) or 0
+        return poet, challenge, item, prev_height
 
     def process(self, atx2: ActivationTxV2) -> bool:
         if not atx2.subposts:
@@ -112,40 +161,79 @@ class HandlerV2:
             if sp.node_id not in allowed or sp.node_id in seen_ids:
                 return False
             seen_ids.add(sp.node_id)
-            # per-identity double-publish guard
-            existing = atxstore.by_node_in_epoch(self.db, sp.node_id,
-                                                 atx2.publish_epoch)
-            if existing is not None and \
-                    existing.id != atx2.identity_atx_id(sp.node_id):
-                self.cache.set_malicious(sp.node_id)
+            prep = self._subpost_prepare(sp, atx2)
+            if prep is None:
                 return False
-            poet = miscstore.poet_proof(self.db,
-                                        sp.nipost.post_metadata.challenge)
-            if poet is None:
-                return False
-            challenge = nipost_challenge(sp.prev_atx, atx2.publish_epoch)
+            poet, challenge, item, prev_height = prep
             if not verify_membership(challenge, sp.nipost.membership,
                                      poet.root,
                                      leaf_count=self._leaf_count(poet)):
                 return False
-            commitment = commitment_of(sp.node_id, self.golden_atx)
-            items.append(post_verifier.VerifyItem(
-                proof=PostProof(nonce=sp.nipost.post.nonce,
-                                indices=list(sp.nipost.post.indices),
-                                pow_nonce=sp.nipost.post.pow_nonce,
-                                k2=self.post_params.k2),
-                challenge=post_challenge(poet.root, challenge),
-                node_id=sp.node_id, commitment=commitment,
-                scrypt_n=self.scrypt_n,
-                total_labels=sp.num_units * self.labels_per_unit))
-            prev_height = 0
-            if sp.prev_atx != EMPTY32:
-                prev_height = atxstore.tick_height(self.db, sp.prev_atx) or 0
+            items.append(item)
             ticks[sp.node_id] = prev_height + poet.ticks
             heights[sp.node_id] = (prev_height, poet.ticks)
         # ONE batched POST verification across every covered identity
         if not all(post_verifier.verify_many(items, self.post_params)):
             return False
+        self._store(atx2, ticks, heights)
+        return True
+
+    async def process_async(self, atx2: ActivationTxV2,
+                            lane: Lane = Lane.GOSSIP) -> bool:
+        """process(), with every crypto check routed through the farm —
+        a merged ATX's subposts batch not just with each other but with
+        every OTHER in-flight ATX's proofs. Falls back to the inline
+        path when no farm runs."""
+        if self.farm is None:
+            return self.process(atx2)
+        if not atx2.subposts:
+            return False
+        if atxstore.has(self.db,
+                        atx2.identity_atx_id(atx2.subposts[0].node_id)):
+            return True
+        if not await self.farm.submit(
+                SigRequest(int(Domain.ATX), atx2.node_id,
+                           atx2.signed_bytes(), atx2.signature), lane=lane):
+            return False
+        for cert in atx2.marriages:
+            if not await self.farm.submit(
+                    SigRequest(int(Domain.ATX), cert.partner_id,
+                               MarriageCert.message(atx2.node_id),
+                               cert.signature), lane=lane):
+                return False
+        allowed = self._married_to_primary(atx2)
+        seen_ids: set[bytes] = set()
+        items: list[post_verifier.VerifyItem] = []
+        ticks: dict[bytes, int] = {}
+        heights: dict[bytes, tuple[int, int]] = {}
+        for sp in atx2.subposts:
+            if sp.node_id not in allowed or sp.node_id in seen_ids:
+                return False
+            seen_ids.add(sp.node_id)
+            prep = self._subpost_prepare(sp, atx2)
+            if prep is None:
+                return False
+            poet, challenge, item, prev_height = prep
+            if not await self.farm.submit(
+                    MembershipRequest(challenge, sp.nipost.membership,
+                                      poet.root, self._leaf_count(poet)),
+                    lane=lane):
+                return False
+            items.append(item)
+            ticks[sp.node_id] = prev_height + poet.ticks
+            heights[sp.node_id] = (prev_height, poet.ticks)
+        verdicts = await asyncio.gather(
+            *(self.farm.submit(PostRequest(it), lane=lane)
+              for it in items))
+        if not all(verdicts):
+            return False
+        # re-run the double-publish guard with NO awaits before the
+        # store: a conflicting envelope may have landed while the crypto
+        # checks above coalesced in the farm (the sync path can't
+        # interleave, so only this path needs the recheck)
+        for sp in atx2.subposts:
+            if self._equivocates(sp, atx2):
+                return False
         self._store(atx2, ticks, heights)
         return True
 

@@ -82,7 +82,7 @@ class Certifier:
     def __init__(self, *, db: Database, signer: EdSigner,
                  verifier: EdVerifier, pubsub: PubSub, oracle,
                  committee_size: int, threshold: int,
-                 layers_per_epoch: int, beacon_getter):
+                 layers_per_epoch: int, beacon_getter, farm=None):
         self.db = db
         self.signer = signer
         self.verifier = verifier
@@ -92,10 +92,24 @@ class Certifier:
         self.threshold = threshold
         self.layers_per_epoch = layers_per_epoch
         self.beacon_getter = beacon_getter
+        # verification farm (verify/farm.py); certificates are
+        # block-critical, so their checks ride the BLOCK lane — a sync
+        # flood must never delay certificate assembly
+        self.farm = farm
         self._pending: dict[tuple[int, bytes], list[CertifyMessage]] = {}
         # callback(layer, block_id) on every ASSEMBLED threshold cert
         self.on_certificate = None
         pubsub.register(TOPIC_CERTIFY, self._gossip)
+
+    async def _verify_sig(self, node_id: bytes, msg: bytes,
+                          sig: bytes) -> bool:
+        if self.farm is not None:
+            from ..verify.farm import Lane, SigRequest
+
+            return await self.farm.submit(
+                SigRequest(int(Domain.CERTIFY), node_id, msg, sig),
+                lane=Lane.BLOCK)
+        return self.verifier.verify(Domain.CERTIFY, node_id, msg, sig)
 
     CERT_ROUND = 250  # distinct VRF round tag for certifier eligibility
 
@@ -139,8 +153,8 @@ class Certifier:
             if msg.node_id in seen:
                 return False
             seen.add(msg.node_id)
-            if not self.verifier.verify(Domain.CERTIFY, msg.node_id,
-                                        msg.signed_bytes(), msg.signature):
+            if not await self._verify_sig(msg.node_id, msg.signed_bytes(),
+                                          msg.signature):
                 return False
             info = self.oracle.cache.get(epoch, msg.atx_id)
             if info is None or info.node_id != msg.node_id:
@@ -157,8 +171,8 @@ class Certifier:
             msg = CertifyMessage.from_bytes(data)
         except (codec.DecodeError, ValueError):
             return False
-        if not self.verifier.verify(Domain.CERTIFY, msg.node_id,
-                                    msg.signed_bytes(), msg.signature):
+        if not await self._verify_sig(msg.node_id, msg.signed_bytes(),
+                                      msg.signature):
             return False
         epoch = msg.layer // self.layers_per_epoch
         # the certifier must actually hold the committee seats it claims:

@@ -100,7 +100,7 @@ class Handler:
                  verifier: EdVerifier, pubsub: PubSub,
                  tortoise=None,
                  on_malicious: Optional[Callable[[bytes], None]] = None,
-                 post_checker=None):
+                 post_checker=None, farm=None):
         self.db = db
         self.cache = cache
         self.verifier = verifier
@@ -111,6 +111,8 @@ class Handler:
         # at that position does NOT qualify (InvalidPostIndex validation;
         # wired by the node with its POST params)
         self.post_checker = post_checker
+        # verification farm (verify/farm.py); None = inline verification
+        self.farm = farm
         pubsub.register(TOPIC_MALFEASANCE, self._gossip)
 
     def validate(self, proof: MalfeasanceProof) -> bool:
@@ -146,11 +148,55 @@ class Handler:
             return False
         return bool(self.post_checker(atx, index_pos))
 
+    async def validate_async(self, proof: MalfeasanceProof, lane) -> bool:
+        """validate(), with the signature pair farm-batched (the two
+        checks of one proof dispatch concurrently, and batch with every
+        other in-flight verification)."""
+        from ..verify.farm import SigRequest
+
+        if proof.domain == DOMAIN_INVALID_POST:
+            # post_checker recomputes ONE label inline (k2=1) — cheap
+            # enough that routing it through the farm buys nothing
+            return self._validate_invalid_post(proof)
+        if proof.msg1 == proof.msg2:
+            return False
+        dom = Domain(proof.domain) if proof.domain in set(Domain) else None
+        if dom is None:
+            return False
+        import asyncio
+
+        ok1, ok2 = await asyncio.gather(
+            self.farm.submit(SigRequest(int(dom), proof.node_id,
+                                        proof.msg1, proof.sig1), lane=lane),
+            self.farm.submit(SigRequest(int(dom), proof.node_id,
+                                        proof.msg2, proof.sig2), lane=lane))
+        if not (ok1 and ok2):
+            return False
+        return _conflicting(proof.domain, proof.msg1, proof.msg2)
+
     def process(self, proof: MalfeasanceProof) -> bool:
         if miscstore.is_malicious(self.db, proof.node_id):
             return True  # already known; don't regossip storms
         if not self.validate(proof):
             return False
+        return self._condemn(proof)
+
+    async def process_async(self, proof: MalfeasanceProof,
+                            lane=None) -> bool:
+        """process() with farm-batched signature checks; inline when no
+        farm runs (the sync-fallback contract, docs/VERIFY_FARM.md)."""
+        if self.farm is None:
+            return self.process(proof)
+        from ..verify.farm import Lane
+
+        lane = Lane.GOSSIP if lane is None else lane
+        if miscstore.is_malicious(self.db, proof.node_id):
+            return True
+        if not await self.validate_async(proof, lane):
+            return False
+        return self._condemn(proof)
+
+    def _condemn(self, proof: MalfeasanceProof) -> bool:
         # the whole equivocation set falls with any member (reference
         # married identities share fate, handler_v2.go/sql/marriage)
         condemned = [proof.node_id]
@@ -174,8 +220,8 @@ class Handler:
             proof = MalfeasanceProof.from_bytes(data)
         except (codec.DecodeError, ValueError):
             return False
-        return self.process(proof)
+        return await self.process_async(proof)
 
     async def publish(self, proof: MalfeasanceProof) -> None:
-        if self.process(proof):
+        if await self.process_async(proof):
             await self.pubsub.publish(TOPIC_MALFEASANCE, proof.to_bytes())

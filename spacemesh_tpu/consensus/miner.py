@@ -162,13 +162,15 @@ class ProposalHandler:
                  tortoise: Tortoise, store: ProposalStore,
                  verifier: EdVerifier, pubsub: PubSub,
                  layers_per_epoch: int, beacon_getter,
-                 on_malfeasance=None):
+                 on_malfeasance=None, farm=None):
         self.db = db
         self.cache = cache
         self.oracle = oracle
         self.tortoise = tortoise
         self.store = store
         self.verifier = verifier
+        # verification farm (verify/farm.py); None = inline verification
+        self.farm = farm
         self.layers_per_epoch = layers_per_epoch
         self.beacon_getter = beacon_getter
         self.on_malfeasance = on_malfeasance
@@ -212,14 +214,31 @@ class ProposalHandler:
             total = declared_set_weight(self.db, self.cache, epoch, root)
         return total
 
-    async def ingest_ballot(self, ballot) -> bool:
+    async def _verify_sig(self, public_key: bytes, msg: bytes, sig: bytes,
+                          lane) -> bool:
+        """Ballot-domain signature check, farm-batched when a farm runs
+        (verify/farm.py), inline otherwise — same verdict either way."""
+        if self.farm is not None:
+            from ..verify.farm import SigRequest
+
+            return await self.farm.submit(
+                SigRequest(int(Domain.BALLOT), public_key, msg, sig),
+                lane=lane)
+        return self.verifier.verify(Domain.BALLOT, public_key, msg, sig)
+
+    async def ingest_ballot(self, ballot, lane=None) -> bool:
         """Full ballot validation + store + tortoise feed. ONE path for
         gossip proposals and synced ballots — sync must not be a weaker
-        copy of the gossip checks. Returns False (rejected), True
-        (ingested), or BAD_BEACON (ingested, truthy, but the ballot's
-        beacon mismatches ours — its proposal must not feed hare)."""
-        if not self.verifier.verify(Domain.BALLOT, ballot.node_id,
-                                    ballot.signed_bytes(), ballot.signature):
+        copy of the gossip checks (sync callers pass lane=Lane.SYNC so
+        backfill floods queue behind live gossip in the farm). Returns
+        False (rejected), True (ingested), or BAD_BEACON (ingested,
+        truthy, but the ballot's beacon mismatches ours — its proposal
+        must not feed hare)."""
+        from ..verify.farm import Lane
+
+        lane = Lane.GOSSIP if lane is None else lane
+        if not await self._verify_sig(ballot.node_id, ballot.signed_bytes(),
+                                      ballot.signature, lane):
             return False
         epoch = ballot.layer // self.layers_per_epoch
         info = self.cache.get(epoch, ballot.atx_id)
@@ -300,10 +319,12 @@ class ProposalHandler:
         return True if not bad_beacon else BAD_BEACON
 
     async def process(self, proposal: Proposal) -> bool:
+        from ..verify.farm import Lane
+
         ballot = proposal.ballot
-        if not self.verifier.verify(Domain.BALLOT, ballot.node_id,
-                                    proposal.signed_bytes(),
-                                    proposal.signature):
+        if not await self._verify_sig(ballot.node_id,
+                                      proposal.signed_bytes(),
+                                      proposal.signature, Lane.GOSSIP):
             return False
         ok = await self.ingest_ballot(ballot)
         if not ok:

@@ -22,13 +22,20 @@ from __future__ import annotations
 
 import enum
 import hashlib
+import os
 
-from cryptography.hazmat.primitives.asymmetric.ed25519 import (
-    Ed25519PrivateKey,
-    Ed25519PublicKey,
-)
-from cryptography.hazmat.primitives import serialization
-from cryptography.exceptions import InvalidSignature
+try:  # the fast path: OpenSSL ed25519 via pyca/cryptography
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+        Ed25519PrivateKey,
+        Ed25519PublicKey,
+    )
+    from cryptography.exceptions import InvalidSignature
+
+    _HAVE_CRYPTOGRAPHY = True
+except ModuleNotFoundError:  # pure-Python ed25519 over the VRF's curve
+    # code below (RFC 8032); containers without `cryptography` must not
+    # lose the whole identity layer
+    _HAVE_CRYPTOGRAPHY = False
 
 PUBLIC_KEY_SIZE = 32
 PRIVATE_KEY_SIZE = 64  # seed || public, like the reference's ed25519
@@ -60,14 +67,21 @@ class Domain(enum.IntEnum):
 class EdSigner:
     def __init__(self, seed: bytes | None = None, prefix: bytes = b""):
         if seed is None:
-            self._sk = Ed25519PrivateKey.generate()
-        else:
-            if len(seed) not in (32, 64):
-                raise ValueError("seed must be 32 (seed) or 64 (seed||pub) bytes")
-            self._sk = Ed25519PrivateKey.from_private_bytes(seed[:32])
+            seed = os.urandom(32)
+        elif len(seed) not in (32, 64):
+            raise ValueError("seed must be 32 (seed) or 64 (seed||pub) bytes")
+        self._seed = seed[:32]
         self.prefix = prefix
-        self._pub = self._sk.public_key().public_bytes(
-            serialization.Encoding.Raw, serialization.PublicFormat.Raw)
+        if _HAVE_CRYPTOGRAPHY:
+            self._sk = Ed25519PrivateKey.from_private_bytes(self._seed)
+            from cryptography.hazmat.primitives import serialization
+
+            self._pub = self._sk.public_key().public_bytes(
+                serialization.Encoding.Raw, serialization.PublicFormat.Raw)
+        else:
+            self._sk = None
+            self._scalar, self._nonce_prefix = _expand_key(self._seed)
+            self._pub = _pt_encode(_pt_mul_base(self._scalar))
 
     @property
     def node_id(self) -> bytes:
@@ -78,19 +92,25 @@ class EdSigner:
         return self._pub
 
     def private_bytes(self) -> bytes:
-        seed = self._sk.private_bytes(
-            serialization.Encoding.Raw, serialization.PrivateFormat.Raw,
-            serialization.NoEncryption())
-        return seed + self._pub
+        return self._seed + self._pub
 
     def sign(self, domain: Domain, msg: bytes) -> bytes:
-        return self._sk.sign(self.prefix + bytes([domain]) + msg)
+        data = self.prefix + bytes([domain]) + msg
+        if self._sk is not None:
+            return self._sk.sign(data)
+        # RFC 8032 EdDSA over the VRF's curve arithmetic
+        r = int.from_bytes(
+            hashlib.sha512(self._nonce_prefix + data).digest(),
+            "little") % _Q
+        r_enc = _pt_encode(_pt_mul_base(r))
+        k = int.from_bytes(
+            hashlib.sha512(r_enc + self._pub + data).digest(),
+            "little") % _Q
+        s = (r + k * self._scalar) % _Q
+        return r_enc + s.to_bytes(32, "little")
 
     def vrf_signer(self) -> "VrfSigner":
-        seed = self._sk.private_bytes(
-            serialization.Encoding.Raw, serialization.PrivateFormat.Raw,
-            serialization.NoEncryption())
-        return VrfSigner(seed, self._pub)
+        return VrfSigner(self._seed, self._pub)
 
 
 class EdVerifier:
@@ -101,12 +121,24 @@ class EdVerifier:
                sig: bytes) -> bool:
         if len(sig) != SIGNATURE_SIZE or len(public_key) != PUBLIC_KEY_SIZE:
             return False
-        try:
-            Ed25519PublicKey.from_public_bytes(public_key).verify(
-                sig, self.prefix + bytes([domain]) + msg)
-            return True
-        except (InvalidSignature, ValueError):
-            return False
+        data = self.prefix + bytes([domain]) + msg
+        if _HAVE_CRYPTOGRAPHY:
+            try:
+                Ed25519PublicKey.from_public_bytes(public_key).verify(
+                    sig, data)
+                return True
+            except (InvalidSignature, ValueError):
+                return False
+        return _ed_verify_cached(public_key, data, sig)
+
+    def verify_many(self, items) -> list[bool]:
+        """Batch-verify ``(domain, public_key, msg, sig)`` tuples —
+        decisions identical to per-item verify(), but one random-linear-
+        combination multi-scalar check instead of N ladders (the
+        verification farm's sig backend; see ed25519_batch_verify)."""
+        return ed25519_batch_verify([
+            (pk, self.prefix + bytes([dom]) + msg, sig)
+            for dom, pk, msg, sig in items])
 
 
 # --- edwards25519 arithmetic (for the VRF) --------------------------------
@@ -147,6 +179,192 @@ def _pt_mul(s: int, p):
         p = _pt_add(p, p)
         s >>= 1
     return out
+
+
+_B_DOUBLES: list | None = None
+
+
+def _pt_mul_base(s: int):
+    """s*B via a cached table of B's doublings — the ed25519 fallback
+    signs/verifies against the base point constantly; halving the adds
+    matters when this is the only ed25519 in the container."""
+    global _B_DOUBLES
+    if _B_DOUBLES is None:
+        table, p = [], _B
+        for _ in range(256):
+            table.append(p)
+            p = _pt_add(p, p)
+        _B_DOUBLES = table
+    out = _ID
+    i = 0
+    while s:
+        if s & 1:
+            out = _pt_add(out, _B_DOUBLES[i])
+        s >>= 1
+        i += 1
+    return out
+
+
+def _ed_verify_py(public_key: bytes, data: bytes, sig: bytes) -> bool:
+    """RFC 8032 verify (cofactorless, like OpenSSL): s*B == R + k*A."""
+    a_pt = _pt_decode(public_key)
+    r_pt = _pt_decode(sig[:32])
+    if a_pt is None or r_pt is None:
+        return False
+    s = int.from_bytes(sig[32:], "little")
+    if s >= _Q:
+        return False
+    k = int.from_bytes(
+        hashlib.sha512(sig[:32] + public_key + data).digest(),
+        "little") % _Q
+    return _pt_eq(_pt_mul_base(s), _pt_add(r_pt, _pt_mul(k, a_pt)))
+
+
+def _pt_neg(p):
+    return ((-p[0]) % _P, p[1], p[2], (-p[3]) % _P)
+
+
+# verdict LRU for the pure-Python path: a multi-identity node (and the
+# in-proc multinode tests) verifies the SAME gossip signature once per
+# consumer; at ~3 ms per Python ladder that repeat work dominates. The
+# reference caches verified objects by id for the same reason. OpenSSL
+# (~50 us) skips this — the cache churn would cost more than it saves.
+_VERIFY_CACHE: dict = {}
+_VERIFY_CACHE_MAX = 8192
+_VERIFY_CACHE_LOCK = None  # created lazily; farm backends run in threads
+
+
+def _cache_put(key: bytes, ok: bool) -> None:
+    global _VERIFY_CACHE_LOCK
+    if _VERIFY_CACHE_LOCK is None:
+        import threading
+
+        _VERIFY_CACHE_LOCK = threading.Lock()
+    with _VERIFY_CACHE_LOCK:
+        if len(_VERIFY_CACHE) >= _VERIFY_CACHE_MAX:
+            # dicts iterate in insertion order: evict the oldest half
+            for k in list(_VERIFY_CACHE)[:_VERIFY_CACHE_MAX // 2]:
+                del _VERIFY_CACHE[k]
+        _VERIFY_CACHE[key] = ok
+
+
+def _ed_verify_cached(public_key: bytes, data: bytes, sig: bytes) -> bool:
+    key = hashlib.sha256(public_key + sig + data).digest()
+    hit = _VERIFY_CACHE.get(key)  # GIL-atomic read; misses just recompute
+    if hit is not None:
+        return hit
+    ok = _ed_verify_py(public_key, data, sig)
+    _cache_put(key, ok)
+    return ok
+
+
+def clear_verify_cache() -> None:
+    """Drop cached ed25519 verdicts (benchmarks comparing verification
+    paths must not let one path's warm cache subsidize the other)."""
+    _VERIFY_CACHE.clear()
+
+
+def _msm(pairs):
+    """Multi-scalar multiplication Σ s_i·P_i (Pippenger buckets):
+    ~(N + 2^c) point adds per window instead of N full ladders — the
+    reason batch verification beats N serial verifies. Window width c
+    adapts to the point count so small groups don't pay a 256-bucket
+    constant."""
+    n = len(pairs)
+    c = max(2, min(8, n.bit_length() - 1))
+    result = _ID
+    top = ((256 + c - 1) // c) * c - c
+    for w in range(top, -1, -c):
+        for _ in range(c):
+            result = _pt_add(result, result)
+        buckets: list = [None] * (1 << c)
+        for s, p in pairs:
+            idx = (s >> w) & ((1 << c) - 1)
+            if idx:
+                b = buckets[idx]
+                buckets[idx] = p if b is None else _pt_add(b, p)
+        running = None
+        total = None
+        for i in range((1 << c) - 1, 0, -1):
+            b = buckets[i]
+            if b is not None:
+                running = b if running is None else _pt_add(running, b)
+            if running is not None:
+                total = running if total is None else _pt_add(total, running)
+        if total is not None:
+            result = _pt_add(result, total)
+    return result
+
+
+def ed25519_batch_verify(items: list[tuple[bytes, bytes, bytes]]
+                         ) -> list[bool]:
+    """Batch-verify ``(public_key, data, signature)`` triples.
+
+    The random-linear-combination check (the dalek/ed25519consensus
+    technique): with fresh 128-bit coefficients z_i,
+
+        (Σ z_i·s_i)·B  ==  Σ z_i·R_i + Σ (z_i·k_i)·A_i
+
+    holds for an all-valid batch, and fails with probability 1-2^-128
+    if ANY signature is invalid. One Pippenger multi-scalar
+    multiplication replaces N independent double-scalar ladders. On
+    batch failure every candidate is re-checked individually, so the
+    returned decisions are always EXACTLY the per-item verdicts —
+    callers never observe a semantic difference, only the speed.
+
+    With `cryptography` present, per-item OpenSSL beats the pure-Python
+    MSM and is used instead (it also releases the GIL, so callers can
+    chunk across threads).
+    """
+    results = [False] * len(items)
+    cand = []  # (index, A, R, s, k, cache_key) for plausible sigs
+    for i, (pk, data, sig) in enumerate(items):
+        if len(sig) != SIGNATURE_SIZE or len(pk) != PUBLIC_KEY_SIZE:
+            continue
+        if _HAVE_CRYPTOGRAPHY:
+            try:
+                Ed25519PublicKey.from_public_bytes(pk).verify(sig, data)
+                results[i] = True
+            except (InvalidSignature, ValueError):
+                pass
+            continue
+        key = hashlib.sha256(pk + sig + data).digest()
+        hit = _VERIFY_CACHE.get(key)
+        if hit is not None:  # shares the inline path's verdict LRU
+            results[i] = hit
+            continue
+        a_pt = _pt_decode(pk)
+        r_pt = _pt_decode(sig[:32])
+        s = int.from_bytes(sig[32:], "little")
+        if a_pt is None or r_pt is None or s >= _Q:
+            continue
+        k = int.from_bytes(
+            hashlib.sha512(sig[:32] + pk + data).digest(), "little") % _Q
+        cand.append((i, a_pt, r_pt, s, k, key))
+    if _HAVE_CRYPTOGRAPHY or not cand:
+        return results
+    batched_ok = False
+    if len(cand) >= 8:  # MSM setup overhead beats tiny batches
+        zs = [int.from_bytes(os.urandom(16), "little") | (1 << 127)
+              for _ in cand]
+        lhs = sum(z * g[3] for z, g in zip(zs, cand)) % _Q
+        pairs = []
+        for z, (_, a_pt, r_pt, _, k, _key) in zip(zs, cand):
+            pairs.append((z, r_pt))
+            pairs.append((z * k % _Q, a_pt))
+        batched_ok = _pt_eq(_pt_mul_base(lhs), _msm(pairs))
+        # a failed combo means at least one invalid signature: fall
+        # through to per-item checks so every caller gets its exact
+        # verdict. (Bisecting instead re-verifies the clean halves with
+        # fresh MSMs — for realistic contamination that costs MORE than
+        # one serial pass, so the penalty is kept flat: one wasted MSM,
+        # ~1.3x serial.)
+    for i, a_pt, r_pt, s, k, key in cand:
+        ok = batched_ok or _pt_eq(_pt_mul_base(s),
+                                  _pt_add(r_pt, _pt_mul(k, a_pt)))
+        results[i] = ok
+        _cache_put(key, ok)
+    return results
 
 
 def _pt_eq(p, q) -> bool:

@@ -143,12 +143,21 @@ class App:
         self.post_params = ProofParams(
             k1=cfg.post.k1, k2=cfg.post.k2, k3=cfg.post.k3,
             pow_difficulty=cfg.post.pow_difficulty_bytes)
+        # ONE verification farm per node: every hot verification path
+        # (ATX/ballot/certificate/malfeasance ingest, sync backfill)
+        # submits to it and the scheduler coalesces device-wide batches
+        # (verify/farm.py, docs/VERIFY_FARM.md)
+        from ..verify.farm import VerificationFarm
+
+        self.verify_farm = VerificationFarm(
+            ed_verifier=self.verifier, post_params=self.post_params)
         self.atx_handler = activation.Handler(
             db=self.state, cache=self.cache, verifier=self.verifier,
             golden_atx=self.golden_atx, post_params=self.post_params,
             labels_per_unit=cfg.post.labels_per_unit,
             scrypt_n=cfg.post.scrypt_n, pubsub=self.pubsub,
-            on_atx=self._on_atx, now=self.time_source)
+            on_atx=self._on_atx, now=self.time_source,
+            farm=self.verify_farm)
         from ..consensus import activation_v2
 
         self.atx_handler_v2 = activation_v2.HandlerV2(
@@ -156,7 +165,7 @@ class App:
             golden_atx=self.golden_atx, post_params=self.post_params,
             labels_per_unit=cfg.post.labels_per_unit,
             scrypt_n=cfg.post.scrypt_n, pubsub=self.pubsub,
-            now=self.time_source)
+            now=self.time_source, farm=self.verify_farm)
         self.generator = blocks.Generator(
             mesh=self.mesh, proposals=self.proposal_store, cache=self.cache,
             layers_per_epoch=cfg.layers_per_epoch)
@@ -166,7 +175,7 @@ class App:
             committee_size=cfg.hare.committee_size,
             threshold=cfg.hare.committee_size // 2 + 1,
             layers_per_epoch=cfg.layers_per_epoch,
-            beacon_getter=self.beacon.get)
+            beacon_getter=self.beacon.get, farm=self.verify_farm)
 
         self.certifier.on_certificate = self._adopt_full_certificate
         self.miners = [miner_mod.ProposalBuilder(
@@ -208,7 +217,7 @@ class App:
         self.malfeasance = malfeasance_mod.Handler(
             db=self.state, cache=self.cache, verifier=self.verifier,
             pubsub=self.pubsub, tortoise=self.tortoise,
-            post_checker=post_checker,
+            post_checker=post_checker, farm=self.verify_farm,
             on_malicious=lambda nid: self.events.emit(
                 events_mod.Malfeasance(node_id=nid)))
 
@@ -227,7 +236,7 @@ class App:
             verifier=self.verifier, pubsub=self.pubsub,
             layers_per_epoch=cfg.layers_per_epoch,
             beacon_getter=self.beacon.get,
-            on_malfeasance=on_double_ballot)
+            on_malfeasance=on_double_ballot, farm=self.verify_farm)
         self.hare = hare_mod.Hare(
             signers=self.signers, verifier=self.verifier, oracle=self.oracle,
             pubsub=self.pubsub, committee_size=cfg.hare.committee_size,
@@ -395,14 +404,20 @@ class App:
         # requested id — else one malicious peer could satisfy a fetch with
         # a different (valid-looking) object and the real one is never
         # retried from honest peers.
+        from ..verify.farm import Lane
+
         async def v_atx(h: bytes, blob: bytes) -> bool:
             from ..core.types import ActivationTxV2
 
             try:
-                if ActivationTx.from_bytes(blob).id == h:
-                    return await self.atx_handler._gossip(b"sync", blob)
+                atx = ActivationTx.from_bytes(blob)
             except Exception:  # noqa: BLE001
-                pass
+                atx = None
+            if atx is not None and atx.id == h:
+                # backfill rides the farm's SYNC lane: floods coalesce
+                # into device-wide batches without starving live gossip
+                return await self.atx_handler.process_async(
+                    atx, lane=Lane.SYNC)
             try:  # v2: the id must be one of the envelope's identity ids
                 atx2 = ActivationTxV2.from_bytes(blob)
             except Exception:  # noqa: BLE001
@@ -410,7 +425,8 @@ class App:
             if h not in {atx2.identity_atx_id(sp.node_id)
                          for sp in atx2.subposts}:
                 return False
-            return self.atx_handler_v2.process(atx2)
+            return await self.atx_handler_v2.process_async(
+                atx2, lane=Lane.SYNC)
 
         async def v_ballot(h: bytes, blob: bytes) -> bool:
             try:
@@ -419,7 +435,8 @@ class App:
                 return False
             if ballot.id != h:
                 return False
-            return await self.proposal_handler.ingest_ballot(ballot)
+            return await self.proposal_handler.ingest_ballot(
+                ballot, lane=Lane.SYNC)
 
         async def v_block(h: bytes, blob: bytes) -> bool:
             try:
@@ -465,7 +482,8 @@ class App:
             # a married member's malice is proven by the OFFENDER's proof
             # (the whole equivocation set shares one proof) — accept when
             # processing it actually condemns the requested identity
-            if not self.malfeasance.process(proof):
+            if not await self.malfeasance.process_async(proof,
+                                                        lane=Lane.SYNC):
                 return False
             return (proof.node_id == node_id
                     or miscstore.is_malicious(self.state, node_id))
@@ -742,9 +760,12 @@ class App:
                             or b.layer // self.cfg.layers_per_epoch != epoch
                             or b.node_id in seen_nodes):
                         continue
-                    if not self.verifier.verify(_Domain.BALLOT, b.node_id,
-                                                b.signed_bytes(),
-                                                b.signature):
+                    from ..verify.farm import SigRequest as _SigReq
+
+                    if not await self.verify_farm.submit(
+                            _SigReq(int(_Domain.BALLOT), b.node_id,
+                                    b.signed_bytes(), b.signature),
+                            lane=Lane.SYNC):
                         continue
                     info = self.cache.get(epoch, b.atx_id)
                     if info is None or info.node_id != b.node_id:
@@ -769,7 +790,12 @@ class App:
             layers_per_epoch=self.cfg.layers_per_epoch,
             store_beacon=self.beacon.on_fallback,
             layer_hash=lambda lyr: layerstore.aggregated_hash(self.state, lyr),
-            on_fork=self._on_fork, derive_beacon=derive_beacon)
+            on_fork=self._on_fork, derive_beacon=derive_beacon,
+            # client side of the rs/1 responder above: fingerprint
+            # reconciliation backfills ATX ids the bulk epoch pull
+            # missed; fetched blobs ingest through v_atx on the farm's
+            # SYNC lane
+            rangesync_sets=set_for)
 
     async def start_network(self) -> tuple[str, int]:
         """Open the real transport (TCP by default; QUIC-lite when
@@ -1161,6 +1187,7 @@ class App:
         for t in self._hare_tasks.values():
             t.cancel()
         self._hare_tasks.clear()
+        self.verify_farm.shutdown()
         if self.post_supervisor is not None:
             self.post_supervisor.stop()
         self.state.close()

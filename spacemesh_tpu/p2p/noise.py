@@ -31,13 +31,25 @@ from __future__ import annotations
 import asyncio
 import struct
 
-from cryptography.hazmat.primitives import hashes
-from cryptography.hazmat.primitives.asymmetric.x25519 import (
-    X25519PrivateKey,
-    X25519PublicKey,
-)
-from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
-from cryptography.hazmat.primitives.kdf.hkdf import HKDF
+try:  # the fast path: OpenSSL primitives via pyca/cryptography
+    from cryptography.hazmat.primitives import hashes
+    from cryptography.hazmat.primitives.asymmetric.x25519 import (
+        X25519PrivateKey,
+        X25519PublicKey,
+    )
+    from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+    from cryptography.hazmat.primitives.kdf.hkdf import HKDF
+
+    _HAVE_CRYPTOGRAPHY = True
+except ModuleNotFoundError:
+    # pure-Python shims (bottom of this module): exact RFC 7748 X25519
+    # and RFC 5869 HKDF, plus an hashlib-based encrypt-then-MAC AEAD in
+    # place of ChaCha20-Poly1305 (a pure-Python ChaCha20 is orders of
+    # magnitude too slow for bulk frames). The AEAD substitution makes
+    # this build WIRE-INCOMPATIBLE with OpenSSL-backed peers: a mixed
+    # pair fails frame authentication and the connection closes — every
+    # node in a network must run the same suite.
+    _HAVE_CRYPTOGRAPHY = False
 
 MAX_FRAME = 64 << 20
 
@@ -125,3 +137,145 @@ class NoiseChannel:
         return verifier.verify(
             Domain.TRANSPORT, node_id,
             self.binding + (b"i" if role_initiator else b"r"), sig)
+
+
+# --- pure-Python fallbacks (no `cryptography` in the container) -----------
+
+if not _HAVE_CRYPTOGRAPHY:
+    import hashlib
+    import hmac as _hmac
+    import os as _os
+
+    _P25519 = 2**255 - 19
+    _A24 = 121665
+
+    def _x25519(k_bytes: bytes, u_bytes: bytes) -> bytes:
+        """RFC 7748 X25519 (Montgomery ladder, section 5)."""
+        k = int.from_bytes(k_bytes, "little")
+        k &= (1 << 254) - 8
+        k |= 1 << 254
+        x1 = int.from_bytes(u_bytes, "little") & ((1 << 255) - 1)
+        p = _P25519
+        x2, z2, x3, z3 = 1, 0, x1, 1
+        swap = 0
+        for t in range(254, -1, -1):
+            kt = (k >> t) & 1
+            if swap ^ kt:
+                x2, x3 = x3, x2
+                z2, z3 = z3, z2
+            swap = kt
+            a = (x2 + z2) % p
+            aa = a * a % p
+            b = (x2 - z2) % p
+            bb = b * b % p
+            e = (aa - bb) % p
+            c = (x3 + z3) % p
+            d = (x3 - z3) % p
+            da = d * a % p
+            cb = c * b % p
+            x3 = (da + cb) % p
+            x3 = x3 * x3 % p
+            z3 = x1 * pow(da - cb, 2, p) % p
+            x2 = aa * bb % p
+            z2 = e * ((aa + _A24 * e) % p) % p
+        if swap:
+            x2, x3 = x3, x2
+            z2, z3 = z3, z2
+        return (x2 * pow(z2, p - 2, p) % p).to_bytes(32, "little")
+
+    class X25519PublicKey:  # noqa: F811 — fallback twin
+        def __init__(self, raw: bytes):
+            self._raw = raw
+
+        @classmethod
+        def from_public_bytes(cls, raw: bytes) -> "X25519PublicKey":
+            if len(raw) != 32:
+                raise ValueError("x25519 public keys are 32 bytes")
+            return cls(raw)
+
+        def public_bytes_raw(self) -> bytes:
+            return self._raw
+
+    class X25519PrivateKey:  # noqa: F811 — fallback twin
+        def __init__(self, raw: bytes):
+            self._raw = raw
+
+        @classmethod
+        def generate(cls) -> "X25519PrivateKey":
+            return cls(_os.urandom(32))
+
+        def public_key(self) -> "X25519PublicKey":
+            return X25519PublicKey(
+                _x25519(self._raw, (9).to_bytes(32, "little")))
+
+        def exchange(self, peer: "X25519PublicKey") -> bytes:
+            out = _x25519(self._raw, peer._raw)
+            if out == bytes(32):  # low-order point: contributory check
+                raise ValueError("x25519 shared secret is all zeros")
+            return out
+
+    class hashes:  # noqa: F811, N801 — just enough HKDF surface
+        class SHA256:
+            pass
+
+    class HKDF:  # noqa: F811 — RFC 5869 with SHA-256
+        def __init__(self, *, algorithm, length: int, salt: bytes,
+                     info: bytes):
+            self._length = length
+            self._salt = salt or bytes(32)
+            self._info = info
+
+        def derive(self, ikm: bytes) -> bytes:
+            prk = _hmac.new(self._salt, ikm, hashlib.sha256).digest()
+            okm = b""
+            t = b""
+            i = 1
+            while len(okm) < self._length:
+                t = _hmac.new(prk, t + self._info + bytes([i]),
+                              hashlib.sha256).digest()
+                okm += t
+                i += 1
+            return okm[:self._length]
+
+    class ChaCha20Poly1305:  # noqa: F811 — SHA256-CTR + HMAC substitute
+        """Encrypt-then-MAC AEAD from hashlib/hmac (NOT ChaCha20: see
+        the module-import note on wire compatibility). Keystream blocks
+        are SHA256(key || nonce || counter); the 16-byte tag is
+        HMAC-SHA256(mac_key, nonce || aad || ct) truncated."""
+
+        TAG = 16
+
+        def __init__(self, key: bytes):
+            self._enc = key
+            self._mac = hashlib.sha256(b"smh/fallback-mac" + key).digest()
+
+        def _stream(self, nonce: bytes, n: int) -> bytes:
+            out = bytearray()
+            ctr = 0
+            while len(out) < n:
+                out += hashlib.sha256(
+                    self._enc + nonce + ctr.to_bytes(8, "little")).digest()
+                ctr += 1
+            return bytes(out[:n])
+
+        def _xor(self, nonce: bytes, data: bytes) -> bytes:
+            n = len(data)
+            ks = int.from_bytes(self._stream(nonce, n), "little")
+            return (int.from_bytes(data, "little") ^ ks).to_bytes(
+                n, "little")
+
+        def encrypt(self, nonce: bytes, data: bytes, aad: bytes) -> bytes:
+            ct = self._xor(nonce, data)
+            tag = _hmac.new(self._mac, nonce + (aad or b"") + ct,
+                            hashlib.sha256).digest()[:self.TAG]
+            return ct + tag
+
+        def decrypt(self, nonce: bytes, data: bytes, aad: bytes) -> bytes:
+            if len(data) < self.TAG:
+                raise ValueError("ciphertext too short")
+            ct, tag = data[:-self.TAG], data[-self.TAG:]
+            want = _hmac.new(self._mac, nonce + (aad or b"") + ct,
+                             hashlib.sha256).digest()[:self.TAG]
+            if not _hmac.compare_digest(tag, want):
+                raise ValueError("InvalidTag")
+            return self._xor(nonce, ct)

@@ -14,7 +14,12 @@ IS echoed there; here `deliver_self` controls it, default True to match).
 from __future__ import annotations
 
 import asyncio
+import logging
 from typing import Awaitable, Callable
+
+from ..utils import metrics
+
+_log = logging.getLogger("pubsub")
 
 # reference topic names (p2p/pubsub/pubsub.go:54-81)
 TOPIC_ATX = "ax1"
@@ -55,12 +60,22 @@ class PubSub:
 
     async def deliver(self, topic: str, peer: bytes, data: bytes):
         """Tri-state aggregate over the topic's handlers: False if any
-        rejected, else None if any suppressed relay, else True."""
+        rejected, else None if any suppressed relay, else True.
+
+        One raising handler must not abort delivery to the REMAINING
+        subscribers (nor kill the bus): the exception is counted as a
+        reject, logged, and surfaced in pubsub_handler_drops_total so a
+        silently-crashing validator is visible to operators."""
         ok = True
         for h in self._handlers.get(topic, ()):
             try:
                 r = await h(peer, data)
-            except Exception:  # noqa: BLE001 — a bad message must not kill the bus
+            except asyncio.CancelledError:
+                raise  # shutdown must still propagate
+            except Exception as exc:  # noqa: BLE001 — bad message ≠ dead bus
+                metrics.pubsub_handler_drops.inc(topic=topic)
+                _log.warning("handler %r dropped message on topic %s: %r",
+                             getattr(h, "__qualname__", h), topic, exc)
                 r = False
             if r is False:
                 ok = False

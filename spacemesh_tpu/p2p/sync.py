@@ -46,12 +46,16 @@ class Syncer:
                  store_beacon: Callable[[int, bytes], None] | None = None,
                  layer_hash: Callable[[int], bytes | None] | None = None,
                  on_fork: Callable[[int], None] | None = None,
-                 derive_beacon=None):
+                 derive_beacon=None, rangesync_sets=None):
         self.store_beacon = store_beacon
         # derive_beacon(epoch, ballot_ids): adopt the epoch beacon from
         # synced ballots' signed EpochData (weight-majority) when peer
         # answers alone can't settle it
         self.derive_beacon = derive_beacon
+        # rangesync_sets(name) -> rangesync.OrderedSet | None resolves
+        # the LOCAL set for fingerprint reconciliation ("atx/<epoch>",
+        # "malfeasance"); None disables the rangesync backfill pass
+        self.rangesync_sets = rangesync_sets
         self.fetch = fetch
         self.current_layer = current_layer
         self.processed_layer = processed_layer
@@ -76,6 +80,13 @@ class Syncer:
             if refs:
                 await self.fetch.get_hashes(HINT_POET, refs)
             await self.fetch.get_epoch_atxs(epoch)
+            # fingerprint reconciliation mops up whatever the bulk pull
+            # missed (a peer's epoch index answered before a late ATX
+            # landed): one rs/1 roundtrip per peer when the sets already
+            # match, O(diff * log n) otherwise. Fetched blobs ingest
+            # through the same validators, i.e. the verification farm's
+            # SYNC lane.
+            await self._rangesync_backfill(f"atx/{epoch}", HINT_ATX)
         # 1b) malfeasance proofs (reference syncer/malsync): a node must
         # learn who is malicious before counting their weight
         await self._sync_malfeasance()
@@ -129,6 +140,32 @@ class Syncer:
             self.state = SyncState.NOT_SYNCED
             return False
         return self.state == SyncState.SYNCED
+
+    async def _rangesync_backfill(self, name: str, hint: str,
+                                  peers: int = 2) -> None:
+        """Reconcile one named id set (p2p/rangesync.py) against a few
+        peers and fetch what they have that we lack. Failures are
+        tolerated — the bulk pull remains the primary mechanism and the
+        next pass retries."""
+        if self.rangesync_sets is None:
+            return
+        try:
+            local = self.rangesync_sets(name)
+        except Exception:  # noqa: BLE001 — a bad epoch name must not kill sync
+            return
+        if local is None:
+            return
+        from .rangesync import RangeSyncClient
+
+        missing: set[bytes] = set()
+        for peer in self.fetch.peers()[:peers]:
+            try:
+                client = RangeSyncClient(self.fetch.server, peer, name)
+                missing.update(await client.reconcile(local))
+            except Exception:  # noqa: BLE001 — peer gone / no rs/1 support
+                continue
+        if missing:
+            await self.fetch.get_hashes(hint, sorted(missing))
 
     async def _sync_malfeasance(self) -> None:
         from .fetch import HINT_MALFEASANCE

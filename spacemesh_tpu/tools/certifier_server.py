@@ -34,6 +34,11 @@ def main(argv=None) -> int:
     from ..consensus.certifier import CertifierDaemon, CertifierService
     from ..core.signing import EdSigner
     from ..post.prover import ProofParams
+    from ..utils import accel
+
+    # cert issuance recomputes POST labels (a JIT'd scrypt pass): the
+    # persistent cache turns the per-shape compile into a one-time cost
+    accel.enable_persistent_cache()
 
     signer = EdSigner(seed=bytes.fromhex(a.key_seed) if a.key_seed else None)
     service = CertifierService(

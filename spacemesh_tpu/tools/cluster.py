@@ -151,6 +151,12 @@ class Cluster:
             env.pop("PALLAS_AXON_POOL_IPS", None)
             env["PYTHONPATH"] = str(REPO) + os.pathsep + \
                 env.get("PYTHONPATH", "")
+            # every node compiles the same tiny POST shapes: share one
+            # persistent XLA cache (utils/accel.py honors the override;
+            # the node enables the cache itself inside initialize())
+            env.setdefault("SPACEMESH_JAX_CACHE",
+                           os.path.expanduser(
+                               "~/.cache/spacemesh_tpu/jax_cache"))
             cmd = [sys.executable, "-u", "-m", "spacemesh_tpu.node",
                    "--preset", "standalone", "--config", str(cfg_path),
                    "--listen", node.listen, "--api"]

@@ -31,6 +31,8 @@ def main(argv=None) -> int:
     from ..consensus.poet_remote import PoetServerDaemon
     from ..core.hashing import sum256
 
+    # no persistent-cache wiring here on purpose: the poet's sequential
+    # hash chain is pure hashlib — this process never JITs
     service = PoetService(poet_id=sum256(a.id_seed.encode()),
                           ticks=a.ticks)
 

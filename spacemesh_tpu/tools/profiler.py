@@ -18,6 +18,7 @@ Usage:
   python -m spacemesh_tpu.tools.profiler --providers
   python -m spacemesh_tpu.tools.profiler --n 8192 --batches 1024,2048
   python -m spacemesh_tpu.tools.profiler --pipeline --n 8192   # per-stage
+  python -m spacemesh_tpu.tools.profiler --verify-farm         # farm view
 Prints ONE JSON document on stdout; progress goes to stderr. --pipeline
 runs a real (tiny) init through the streaming pipeline and dumps per-stage
 host seconds (dispatch/fetch/write/stall) so stalls are visible without a
@@ -240,6 +241,39 @@ def verify_benchmark(counts: list[int], reps: int = 2,
     return {"verify": rates}
 
 
+def verify_farm_benchmark(items: int = 256, probe: bool = True) -> dict:
+    """The verification farm (spacemesh_tpu/verify/) against the inline
+    serial path on one mixed workload, with the farm's own telemetry
+    (batch occupancy, per-lane queue peaks, dispatch seconds, dedup
+    hits) so an operator can see the coalescing behavior, not just the
+    end-to-end ratio."""
+    import tempfile
+
+    from ..utils import accel
+    from ..verify import workload
+
+    if probe and not accel.ensure_usable_platform():
+        _log("accelerator unreachable; JAX restricted to CPU")
+    posts = max(items // 8, 4)
+    vrfs = max(items // 16, 4)
+    mems = max(items // 16, 4)
+    sigs = max(items - posts - vrfs - mems, 8)
+    with tempfile.TemporaryDirectory() as d:
+        w = workload.build(d, sigs=sigs, vrfs=vrfs, posts=posts,
+                           memberships=mems, post_challenges=4)
+        doc = workload.compare_serial_vs_farm(w)
+    return {
+        "items": doc["items"],
+        "rejected": doc["rejected"],
+        "decisions_match": True,  # compare_serial_vs_farm raises otherwise
+        "serial_s": round(doc["serial_s"], 3),
+        "batched_s": round(doc["batched_s"], 3),
+        "speedup": doc["speedup"],
+        "farm": {k: (round(v, 4) if isinstance(v, float) else v)
+                 for k, v in doc["stats"].items()},
+    }
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="profiler",
@@ -250,6 +284,11 @@ def main(argv=None) -> int:
                     help="benchmark proof verification instead of labels")
     ap.add_argument("--verify-batches", default="100,1000",
                     help="comma-separated proof batch sizes for --verify")
+    ap.add_argument("--verify-farm", action="store_true",
+                    help="serial vs farm-batched mixed verification + "
+                    "farm telemetry (occupancy, lanes, dedup)")
+    ap.add_argument("--verify-items", type=int, default=256,
+                    help="workload size for --verify-farm")
     ap.add_argument("--pipeline", action="store_true",
                     help="profile the streaming init pipeline per stage "
                     "(dispatch/fetch/write/stall)")
@@ -270,6 +309,12 @@ def main(argv=None) -> int:
                     help="skip the accelerator liveness probe (tests)")
     a = ap.parse_args(argv)
 
+    from ..utils import accel
+
+    # every benchmark below JITs; the persistent cache makes repeat runs
+    # measure steady state instead of XLA compile time
+    accel.enable_persistent_cache()
+
     if a.providers:
         print(json.dumps({"providers": providers(probe=not a.no_probe)},
                          indent=2))
@@ -284,6 +329,10 @@ def main(argv=None) -> int:
         doc = verify_benchmark(
             [int(b) for b in a.verify_batches.split(",")],
             reps=a.reps, probe=not a.no_probe)
+        print(json.dumps(doc, indent=2))
+        return 0
+    if a.verify_farm:
+        doc = verify_farm_benchmark(a.verify_items, probe=not a.no_probe)
         print(json.dumps(doc, indent=2))
         return 0
     doc = benchmark(a.n, [int(b) for b in a.batches.split(",")],

@@ -162,3 +162,29 @@ post_pipeline_meta_saves = REGISTRY.counter(
     "post_pipeline_meta_saves_total", "interval resume-metadata rewrites")
 post_pipeline_labels_per_sec = REGISTRY.gauge(
     "post_pipeline_labels_per_sec", "labels/s of the last init session")
+
+# verification farm (verify/farm.py): the micro-batching admission
+# service for signatures / VRFs / POST proofs / poet membership.
+verify_farm_requests = REGISTRY.counter(
+    "verify_farm_requests_total",
+    "verification requests submitted (labels: kind, lane)")
+verify_farm_dedup_hits = REGISTRY.counter(
+    "verify_farm_dedup_hits_total",
+    "requests coalesced onto an identical in-flight request")
+verify_farm_batches = REGISTRY.counter(
+    "verify_farm_batches_total", "batches dispatched (label: kind)")
+verify_farm_batch_occupancy = REGISTRY.histogram(
+    "verify_farm_batch_occupancy", "requests per dispatched batch",
+    buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, float("inf")))
+verify_farm_dispatch_seconds = REGISTRY.histogram(
+    "verify_farm_dispatch_seconds", "backend seconds per batch",
+    buckets=(0.001, 0.005, 0.02, 0.1, 0.5, 2.0, float("inf")))
+verify_farm_queue_depth = REGISTRY.gauge(
+    "verify_farm_queue_depth", "pending requests (label: lane)")
+
+# pubsub delivery hardening (p2p/pubsub.py): a raising handler is
+# counted + logged, never allowed to abort delivery to the remaining
+# subscribers.
+pubsub_handler_drops = REGISTRY.counter(
+    "pubsub_handler_drops_total",
+    "handler exceptions swallowed during delivery (label: topic)")

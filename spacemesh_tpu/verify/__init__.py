@@ -1,0 +1,17 @@
+"""Batched verification farm: micro-batching admission for crypto checks.
+
+The continuous-batching pattern from inference serving applied to
+verification: callers submit one signature / VRF proof / POST proof /
+poet-membership check and await the verdict; a per-kind scheduler
+coalesces pending requests into device-wide batches (docs/VERIFY_FARM.md).
+"""
+
+from .farm import (  # noqa: F401
+    FarmClosed,
+    Lane,
+    MembershipRequest,
+    PostRequest,
+    SigRequest,
+    VerificationFarm,
+    VrfRequest,
+)

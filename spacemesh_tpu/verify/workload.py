@@ -1,0 +1,241 @@
+"""Mixed verification workloads: one builder for bench.py, the
+profiler's --verify-farm view, and tests/test_verify_farm.py.
+
+A workload is a list of farm requests (signatures, VRF proofs, POST
+proofs, poet memberships) with a controlled invalid/malformed fraction,
+plus the inline oracle that verifies each request exactly the way the
+pre-farm handlers did — the parity target the farm must match
+bit-for-bit (ISSUE 2 acceptance).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import random
+
+from ..core.signing import Domain, EdSigner, EdVerifier, VrfVerifier
+from ..post import verifier as post_verifier
+from ..post.prover import Proof as PostProof, ProofParams, Prover
+from .farm import MembershipRequest, PostRequest, SigRequest, VrfRequest
+
+# tiny-but-real POST geometry (profiler.verify_benchmark uses the same):
+# scrypt N=2 keeps the label recompute sub-second on CPU while running
+# the full batched verify path
+POST_PARAMS = ProofParams(k1=64, k2=16, k3=8,
+                          pow_difficulty=bytes([32]) + bytes([255]) * 31)
+POST_SCRYPT_N = 2
+POST_LABELS = 512
+POST_UNITS = 2
+
+
+@dataclasses.dataclass
+class Workload:
+    requests: list
+    ed: EdVerifier
+    vrf: VrfVerifier
+    post_params: ProofParams
+    post_seed: bytes  # fixed K3 seed: serial and farm must sample alike
+
+    def inline_verify(self, req) -> bool:
+        """The pre-farm serial path: one inline verifier call per item."""
+        if isinstance(req, SigRequest):
+            return self.ed.verify(req.domain, req.public_key, req.msg,
+                                  req.signature)
+        if isinstance(req, VrfRequest):
+            return self.vrf.verify(req.public_key, req.alpha, req.proof)
+        if isinstance(req, MembershipRequest):
+            from ..consensus.poet import verify_membership
+
+            return verify_membership(req.member, req.proof, req.root,
+                                     req.leaf_count)
+        if isinstance(req, PostRequest):
+            return post_verifier.verify(req.item, self.post_params,
+                                        seed=self.post_seed)
+        raise TypeError(f"unknown request {type(req).__name__}")
+
+    def inline_all(self) -> list[bool]:
+        return [self.inline_verify(r) for r in self.requests]
+
+
+def _corrupt(data: bytes, pos: int) -> bytes:
+    return data[:pos] + bytes([data[pos] ^ 0x5A]) + data[pos + 1:]
+
+
+def build(post_dir: str, *, sigs: int = 64, vrfs: int = 8, posts: int = 16,
+          memberships: int = 8, post_challenges: int = 4,
+          invalid_frac: float = 0.125, rng_seed: int = 7) -> Workload:
+    """Build a deterministic mixed workload.
+
+    ``post_dir`` must be an empty (or reusable) directory: a tiny real
+    POST unit is initialized there once and proofs are generated against
+    ``post_challenges`` distinct challenges; ``posts`` requests replicate
+    them (replicated proofs are farm dedup fodder — exactly the gossip
+    re-delivery pattern). Roughly ``invalid_frac`` of every kind is made
+    invalid, including structurally malformed items (wrong-length keys,
+    out-of-range POST indices), which must reject on both paths.
+    """
+    from ..post import initializer
+
+    rng = random.Random(rng_seed)
+    every = max(int(round(1 / invalid_frac)), 2) if invalid_frac > 0 else 0
+
+    def bad(i: int) -> bool:
+        return bool(every) and i % every == 0
+
+    ed = EdVerifier()
+    vrf = VrfVerifier()
+    requests: list = []
+
+    # --- ed25519 signatures ------------------------------------------
+    signers = [EdSigner(seed=hashlib.sha256(
+        b"wl-signer" + k.to_bytes(4, "little")).digest()) for k in range(4)]
+    for i in range(sigs):
+        s = signers[i % len(signers)]
+        msg = b"workload-msg-" + i.to_bytes(4, "little")
+        sig = s.sign(Domain.BALLOT, msg)
+        if bad(i):
+            mode = i % 3
+            if mode == 0:
+                sig = _corrupt(sig, rng.randrange(len(sig)))
+            elif mode == 1:
+                sig = sig[:17]  # malformed: wrong length
+            else:
+                msg = msg + b"!"  # signature over different bytes
+        requests.append(SigRequest(int(Domain.BALLOT), s.public_key, msg,
+                                   sig))
+
+    # --- VRF proofs ---------------------------------------------------
+    vrf_signers = [s.vrf_signer() for s in signers[:2]]
+    for i in range(vrfs):
+        vs = vrf_signers[i % len(vrf_signers)]
+        alpha = b"workload-alpha-" + i.to_bytes(4, "little")
+        proof = vs.prove(alpha)
+        key = vs.public_key
+        if bad(i):
+            mode = i % 3
+            if mode == 0:
+                proof = _corrupt(proof, rng.randrange(len(proof)))
+            elif mode == 1:
+                proof = proof[:31]  # malformed: wrong length
+            else:
+                key = bytes(32)  # not a curve point's honest owner
+        requests.append(VrfRequest(key, alpha, proof))
+
+    # --- poet membership ---------------------------------------------
+    from ..consensus.poet import merkle_path, merkle_root
+
+    members = [b"member-" + k.to_bytes(4, "little") for k in range(16)]
+    root = merkle_root(members)
+    for i in range(memberships):
+        idx = i % len(members)
+        member = members[idx]
+        proof = merkle_path(members, idx)
+        if bad(i):
+            if i % 2:
+                member = b"not-a-member-" + i.to_bytes(4, "little")
+            else:
+                proof = dataclasses.replace(
+                    proof, nodes=[_corrupt(n, 0) for n in proof.nodes])
+        requests.append(MembershipRequest(member, proof, root,
+                                          len(members)))
+
+    # --- POST proofs --------------------------------------------------
+    if posts > 0:
+        node = hashlib.sha256(b"wl-post-node").digest()
+        commit = hashlib.sha256(b"wl-post-commit").digest()
+        meta, _ = initializer.initialize(
+            post_dir, node_id=node, commitment=commit,
+            num_units=POST_UNITS, labels_per_unit=POST_LABELS,
+            scrypt_n=POST_SCRYPT_N, max_file_size=4096, batch_size=256)
+        prover = Prover(post_dir, POST_PARAMS, batch_labels=512)
+        proofs = []
+        for c in range(max(post_challenges, 1)):
+            challenge = hashlib.sha256(
+                b"wl-challenge" + c.to_bytes(4, "little")).digest()
+            proofs.append((challenge, prover.prove(challenge)))
+        for i in range(posts):
+            challenge, proof = proofs[i % len(proofs)]
+            indices = list(proof.indices)
+            if bad(i):
+                mode = i % 3
+                if mode == 0:
+                    # in-range but wrong label: fails the device recompute
+                    indices[i % len(indices)] = \
+                        (indices[i % len(indices)] + 1) \
+                        % meta.total_labels
+                elif mode == 1:
+                    indices[0] = meta.total_labels + 17  # out of range
+                else:
+                    indices = indices[:1]  # too few indices (< k2)
+            requests.append(PostRequest(post_verifier.VerifyItem(
+                proof=PostProof(nonce=proof.nonce, indices=indices,
+                                pow_nonce=proof.pow_nonce,
+                                k2=POST_PARAMS.k2),
+                challenge=challenge, node_id=node, commitment=commit,
+                scrypt_n=POST_SCRYPT_N,
+                total_labels=meta.total_labels)))
+
+    rng.shuffle(requests)
+    return Workload(requests=requests, ed=ed, vrf=vrf,
+                    post_params=POST_PARAMS,
+                    post_seed=hashlib.sha256(b"wl-k3-seed").digest())
+
+
+def compare_serial_vs_farm(w: Workload) -> dict:
+    """One workload through the inline serial path and a fresh farm.
+
+    The shared harness behind bench.py's verify metrics and the
+    profiler's --verify-farm view — the warm-up rules and cache clears
+    are correctness-sensitive (neither path may ride the other's warm
+    ed25519 verdict cache, and per-shape XLA compiles are a
+    once-per-machine cost, not throughput), so they live in ONE place.
+    Raises if the farm's decisions diverge from the serial path's.
+    Returned stats cover the timed farm phase only.
+    """
+    import asyncio
+    import time
+
+    from ..core.signing import clear_verify_cache
+    from .farm import VerificationFarm
+
+    reqs = w.requests
+    warm = next((r for r in reqs if isinstance(r, PostRequest)), None)
+    if warm is not None:
+        w.inline_verify(warm)  # pay the serial path's compile once
+    clear_verify_cache()
+    t0 = time.perf_counter()
+    expected = w.inline_all()
+    serial_s = time.perf_counter() - t0
+    clear_verify_cache()
+
+    async def run():
+        farm = VerificationFarm(
+            ed_verifier=w.ed, vrf_verifier=w.vrf,
+            post_params=w.post_params, post_seed=w.post_seed)
+        post_reqs = [r for r in reqs if isinstance(r, PostRequest)]
+        await asyncio.gather(*(farm.submit(r) for r in post_reqs))
+        base = {k: v for k, v in farm.stats.items()
+                if isinstance(v, (int, float))}
+        farm.stats["max_occupancy"] = 0  # warm-up burst must not leak
+        t0 = time.perf_counter()
+        got = await asyncio.gather(*(farm.submit(r) for r in reqs))
+        dt = time.perf_counter() - t0
+        stats = {k: (farm.stats[k] - base[k]
+                     if k in base and k != "max_occupancy"
+                     else farm.stats[k])
+                 for k in farm.stats}
+        await farm.aclose()
+        return got, dt, stats
+
+    got, batched_s, stats = asyncio.run(run())
+    if got != expected:
+        raise RuntimeError("farm decisions diverged from serial path")
+    return {
+        "items": len(reqs),
+        "rejected": len(reqs) - sum(expected),
+        "serial_s": serial_s,
+        "batched_s": batched_s,
+        "speedup": round(serial_s / batched_s, 2) if batched_s else None,
+        "stats": stats,
+    }

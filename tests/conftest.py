@@ -31,3 +31,10 @@ jax.config.update("jax_platforms", "cpu")
 from spacemesh_tpu.utils import accel  # noqa: E402
 
 accel.enable_persistent_cache()
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: tier-2 heavyweight scenarios (multi-process clusters); "
+        "the tier-1 command runs -m 'not slow'")

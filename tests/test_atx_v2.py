@@ -119,6 +119,48 @@ def test_forged_marriage_cert_rejected(world):
     assert not handler.process(bad)
 
 
+def test_process_async_parity_with_inline(world):
+    """HandlerV2.process (inline) and process_async (verification farm)
+    must return identical verdicts on the same envelopes — valid,
+    bad-signature, and tampered-POST. The 'edit them together' comments
+    in consensus/activation_v2.py point here."""
+    from spacemesh_tpu.verify.farm import VerificationFarm
+
+    primary, partner, db, atx2 = world
+    bad_sig = dataclasses.replace(atx2, signature=bytes(64))
+    sp0 = atx2.subposts[0]
+    # out-of-range indices: deterministic reject on both paths (an
+    # in-range shift could still pass the K3 spot check for one path —
+    # the seeded device-path parity lives in tests/test_verify_farm.py)
+    tampered = dataclasses.replace(atx2, subposts=[
+        dataclasses.replace(sp0, nipost=dataclasses.replace(
+            sp0.nipost, post=dataclasses.replace(
+                sp0.nipost.post,
+                indices=[LPU + 1 + i
+                         for i in sp0.nipost.post.indices]))),
+        atx2.subposts[1]], signature=bytes(64))
+    tampered = dataclasses.replace(
+        tampered, signature=primary.sign(Domain.ATX,
+                                         tampered.signed_bytes()))
+    envelopes = [atx2, bad_sig, tampered]
+
+    async def farm_verdicts():
+        farm = VerificationFarm(ed_verifier=EdVerifier(prefix=GEN),
+                                post_params=PARAMS)
+        h, _ = _handler(db)
+        h.farm = farm
+        out = [await h.process_async(e) for e in envelopes]
+        await farm.aclose()
+        return out
+
+    # farm path first (full validation incl. store of the valid one);
+    # the inline pass then re-derives every verdict on the same state
+    got = asyncio.run(farm_verdicts())
+    h2, _ = _handler(db)
+    expected = [h2.process(e) for e in envelopes]
+    assert got == expected == [True, False, False]
+
+
 def test_marriage_condemns_whole_set(world):
     """One married identity equivocates -> the WHOLE set is malicious."""
     primary, partner, db, atx2 = world
@@ -148,6 +190,100 @@ def test_marriage_condemns_whole_set(world):
     assert miscstore.is_malicious(db, primary.node_id), \
         "married primary must fall with the equivocating partner"
     assert cache.is_malicious(primary.node_id)
+
+
+@pytest.fixture(scope="module")
+def v1_world(tmp_path_factory):
+    """One identity with a REAL v1 ATX (own poet round + POST proof)."""
+    from spacemesh_tpu.consensus.activation import (
+        nipost_challenge, post_challenge, store_poet_blob)
+    from spacemesh_tpu.consensus.poet import PoetBlob
+    from spacemesh_tpu.core.types import (
+        EMPTY32, ActivationTx, NIPost, Post, PostMetadataWire)
+
+    tmp = tmp_path_factory.mktemp("atxv1")
+    s = EdSigner(prefix=GEN)
+    initializer.initialize(
+        tmp / "post", node_id=s.node_id,
+        commitment=commitment_of(s.node_id, GOLDEN),
+        num_units=1, labels_per_unit=LPU, scrypt_n=2, batch_size=128)
+    client = PostClient(tmp / "post", PARAMS)
+    db = dbmod.open_state(":memory:")
+    poet = PoetService(poet_id=sum256(b"poet-v1", GEN), ticks=64)
+    challenge = nipost_challenge(EMPTY32, 1)
+
+    async def run_round():
+        await poet.register("1", challenge)
+        return await poet.execute_round("1")
+
+    result = asyncio.run(run_round())
+    store_poet_blob(db, PoetBlob(proof=result.proof,
+                                 member_count=len(result.members)))
+    proof, _meta = client.proof(post_challenge(result.proof.root,
+                                               challenge))
+    info = client.info()
+    atx = ActivationTx(
+        publish_epoch=1, prev_atx=EMPTY32, pos_atx=GOLDEN,
+        commitment_atx=commitment_of(s.node_id, GOLDEN),
+        initial_post=None,
+        nipost=NIPost(
+            membership=result.membership(challenge),
+            post=Post(nonce=proof.nonce, indices=proof.indices,
+                      pow_nonce=proof.pow_nonce),
+            post_metadata=PostMetadataWire(
+                challenge=result.proof.id,
+                labels_per_unit=info.labels_per_unit)),
+        num_units=info.num_units, vrf_nonce=info.vrf_nonce,
+        vrf_public_key=s.vrf_signer().public_key, coinbase=bytes(24),
+        node_id=s.node_id, signature=bytes(64))
+    atx = dataclasses.replace(
+        atx, signature=s.sign(Domain.ATX, atx.signed_bytes()))
+    return s, db, atx
+
+
+def test_v1_process_async_parity_with_inline(v1_world):
+    """activation.Handler.process (inline) vs process_async (farm):
+    identical verdicts for valid, bad-signature, wrong-VRF-key, and
+    tampered-POST envelopes. The 'edit them together' comment in
+    consensus/activation.py points here."""
+    from spacemesh_tpu.consensus import activation
+    from spacemesh_tpu.verify.farm import VerificationFarm
+
+    s, db, atx = v1_world
+    bad_sig = dataclasses.replace(atx, signature=bytes(64))
+    bad_vrf = dataclasses.replace(atx, vrf_public_key=bytes(32),
+                                  signature=bytes(64))
+    bad_vrf = dataclasses.replace(
+        bad_vrf, signature=s.sign(Domain.ATX, bad_vrf.signed_bytes()))
+    tampered = dataclasses.replace(
+        atx, nipost=dataclasses.replace(
+            atx.nipost, post=dataclasses.replace(
+                atx.nipost.post,  # out of range: deterministic reject
+                indices=[LPU + 1 + i for i in atx.nipost.post.indices])),
+        signature=bytes(64))
+    tampered = dataclasses.replace(
+        tampered, signature=s.sign(Domain.ATX, tampered.signed_bytes()))
+    envelopes = [atx, bad_sig, bad_vrf, tampered]
+
+    def handler(farm):
+        return activation.Handler(
+            db=db, cache=AtxCache(), verifier=EdVerifier(prefix=GEN),
+            golden_atx=GOLDEN, post_params=PARAMS, labels_per_unit=LPU,
+            scrypt_n=2, pubsub=PubSub(), farm=farm)
+
+    async def farm_verdicts():
+        farm = VerificationFarm(ed_verifier=EdVerifier(prefix=GEN),
+                                post_params=PARAMS)
+        h = handler(farm)
+        out = [await h.process_async(e) for e in envelopes]
+        await farm.aclose()
+        return out
+
+    # farm path first (full validation incl. store of the valid one);
+    # the inline pass then re-derives every verdict on the same state
+    got = asyncio.run(farm_verdicts())
+    expected = [handler(None).process(e) for e in envelopes]
+    assert got == expected == [True, False, False, False]
 
 
 def test_checkpoint_roundtrips_v2_atxs(world):

@@ -15,6 +15,10 @@ import pytest
 
 from spacemesh_tpu.tools.cluster import Cluster
 
+# tier-2: a five-subprocess cluster needs minutes of real wall clock
+# (POST init + jit warmup per node); tier-1 (-m 'not slow') skips it
+pytestmark = pytest.mark.slow
+
 N = 5
 SMESHERS = 2
 LPE = 3

@@ -28,6 +28,10 @@ LAYER_SEC = 1.0
 UNTIL = 8
 PREPARE_BUDGET = 50  # seconds for the smesher's POST init + jit warmup
 
+# tier-2: three real OS-process nodes ride wall-clock layer timing —
+# minutes per run and flaky on loaded machines; tier-1 skips it
+pytestmark = pytest.mark.slow
+
 REPO = Path(__file__).resolve().parent.parent
 
 

@@ -286,6 +286,7 @@ def test_quic_impersonation_rejected():
 # --- multi-process cluster + chaos over QUIC --------------------------------
 
 
+@pytest.mark.slow  # tier-2 like its TCP twin (test_process_net.py)
 def test_quic_three_process_cluster_with_kill(tmp_path):
     """The process-net scenario over QUIC: three OS processes, UDP-only
     traffic, one SIGKILLed mid-run; survivors converge (the TCP twin is

@@ -1,0 +1,415 @@
+"""Verification farm (spacemesh_tpu/verify/): adversarial batches,
+lanes, dedup, cancellation, deadline-expiry, backpressure, and the
+sync-fallback contract (ISSUE 2).
+
+The core acceptance property: a farm dispatch mixing valid, invalid,
+and structurally malformed proofs must resolve EVERY future with
+exactly the accept/reject decision the inline verifier gives for that
+item — batching is a scheduling change, never a semantic one.
+"""
+
+import asyncio
+import dataclasses
+import threading
+import time
+
+import pytest
+
+from spacemesh_tpu.consensus import malfeasance
+from spacemesh_tpu.core import types
+from spacemesh_tpu.core.signing import Domain, EdSigner, EdVerifier
+from spacemesh_tpu.p2p.pubsub import PubSub
+from spacemesh_tpu.storage import db as dbmod
+from spacemesh_tpu.storage.cache import AtxCache
+from spacemesh_tpu.verify import workload
+from spacemesh_tpu.verify.farm import (
+    FarmClosed,
+    Lane,
+    SigRequest,
+    VerificationFarm,
+)
+
+
+@pytest.fixture(scope="module")
+def wl(tmp_path_factory):
+    """One small mixed workload (includes malformed items) per module —
+    the POST init + proofs inside are the expensive part."""
+    d = tmp_path_factory.mktemp("verify-wl")
+    return workload.build(str(d), sigs=20, vrfs=6, posts=10,
+                          memberships=8, post_challenges=2)
+
+
+def _farm_for(wl, **kw):
+    kw.setdefault("ed_verifier", wl.ed)
+    kw.setdefault("vrf_verifier", wl.vrf)
+    kw.setdefault("post_params", wl.post_params)
+    kw.setdefault("post_seed", wl.post_seed)
+    return VerificationFarm(**kw)
+
+
+def _sig_reqs(n, valid=True, salt=b""):
+    s = EdSigner(seed=bytes(31) + b"\x01")
+    out = []
+    for i in range(n):
+        msg = b"m" + salt + i.to_bytes(4, "little")
+        sig = s.sign(Domain.HARE, msg)
+        if not valid:
+            sig = bytes(64)
+        out.append(SigRequest(int(Domain.HARE), s.public_key, msg, sig))
+    return out
+
+
+class _BlockingBackend:
+    """Wrap farm._run_backend so the FIRST dispatch blocks on an event
+    (simulating a slow device pass) while later dispatches run live."""
+
+    def __init__(self, farm, block_first=1, sleep_s=0.0):
+        self.real = farm._run_backend
+        self.gate = threading.Event()
+        self.block_left = block_first
+        self.sleep_s = sleep_s
+        self.lock = threading.Lock()
+        farm._run_backend = self  # type: ignore[method-assign]
+
+    def __call__(self, kind, reqs):
+        with self.lock:
+            blocked = self.block_left > 0
+            self.block_left -= 1 if blocked else 0
+        if blocked:
+            assert self.gate.wait(30), "test gate never released"
+        if self.sleep_s:
+            time.sleep(self.sleep_s)
+        return self.real(kind, reqs)
+
+
+# --- decision parity ------------------------------------------------------
+
+
+def test_adversarial_batch_matches_inline(wl):
+    """Valid + invalid + malformed, all lanes, one farm: bit-identical
+    accept/reject decisions vs the inline verifiers."""
+    expected = wl.inline_all()
+    assert 0 < sum(expected) < len(expected), "workload must be mixed"
+
+    async def main():
+        farm = _farm_for(wl)
+        lanes = [Lane.BLOCK, Lane.GOSSIP, Lane.SYNC]
+        got = await asyncio.gather(
+            *(farm.submit(r, lane=lanes[i % 3])
+              for i, r in enumerate(wl.requests)))
+        await farm.aclose()
+        return got
+
+    got = asyncio.run(main())
+    assert got == expected
+
+
+def test_parity_across_repeat_submission(wl):
+    """Same workload a second time through one farm (dedup entries from
+    resolved batches must not leak stale verdicts)."""
+
+    async def main():
+        farm = _farm_for(wl)
+        first = await asyncio.gather(*(farm.submit(r)
+                                       for r in wl.requests))
+        second = await asyncio.gather(*(farm.submit(r)
+                                        for r in wl.requests))
+        await farm.aclose()
+        return first, second
+
+    first, second = asyncio.run(main())
+    assert first == second == wl.inline_all()
+
+
+# --- scheduler behavior ---------------------------------------------------
+
+
+def test_dedup_shares_one_verdict():
+    async def main():
+        farm = VerificationFarm()
+        bb = _BlockingBackend(farm)
+        [req] = _sig_reqs(1)
+        t1 = asyncio.ensure_future(farm.submit(req))
+        await asyncio.sleep(0.05)  # t1's batch is now blocked in dispatch
+        t2 = asyncio.ensure_future(farm.submit(req))
+        t3 = asyncio.ensure_future(farm.submit(req))
+        await asyncio.sleep(0.05)
+        bb.gate.set()
+        got = await asyncio.gather(t1, t2, t3)
+        stats = dict(farm.stats)
+        await farm.aclose()
+        return got, stats
+
+    got, stats = asyncio.run(main())
+    assert got == [True, True, True]
+    assert stats["dedup_hits"] >= 2
+    assert stats["items"] == 1  # one request ever reached a backend
+
+
+def test_dedup_promotes_to_higher_priority_lane():
+    """A BLOCK-lane submit that dedups onto a queued SYNC twin must pull
+    the entry into the BLOCK lane — not inherit SYNC's queue position."""
+
+    async def main():
+        farm = VerificationFarm(max_inflight=1)
+        bb = _BlockingBackend(farm)
+        first = asyncio.ensure_future(farm.submit(_sig_reqs(1)[0]))
+        await asyncio.sleep(0.05)  # dispatch blocked; cap=1 saturated
+        [req] = _sig_reqs(1, salt=b"pm")
+        sync_t = asyncio.ensure_future(farm.submit(req, lane=Lane.SYNC))
+        await asyncio.sleep(0.02)  # queued, held by the in-flight cap
+        t0 = time.perf_counter()
+        # without promotion this waits on the capped SYNC entry until
+        # the gate opens; with it, BLOCK bypasses the cap at its deadline
+        ok = await asyncio.wait_for(farm.submit(req, lane=Lane.BLOCK), 5)
+        latency = time.perf_counter() - t0
+        bb.gate.set()
+        assert await sync_t is True  # the shared verdict reached both
+        assert await first is True
+        await farm.aclose()
+        return ok, latency
+
+    ok, latency = asyncio.run(main())
+    assert ok is True
+    assert latency < 1.0, latency
+
+
+def test_deadline_dispatches_partial_batch():
+    """With the backend busy, queued requests must dispatch when the
+    lane's max-latency deadline expires — NOT wait for max_batch."""
+
+    async def main():
+        farm = VerificationFarm(max_batch=10_000)
+        bb = _BlockingBackend(farm)
+        first = asyncio.ensure_future(farm.submit(_sig_reqs(1)[0]))
+        await asyncio.sleep(0.05)  # first dispatch now blocked
+        reqs = _sig_reqs(5, salt=b"dl")
+        t0 = time.perf_counter()
+        got = await asyncio.gather(*(farm.submit(r) for r in reqs))
+        latency = time.perf_counter() - t0
+        stats = dict(farm.stats)
+        bb.gate.set()
+        assert await first is True
+        await farm.aclose()
+        return got, latency, stats
+
+    got, latency, stats = asyncio.run(main())
+    assert got == [True] * 5
+    # 5ms gossip deadline, generous CI margin — the point is "well under
+    # forever", since max_batch can never fill
+    assert latency < 5.0
+    assert stats["max_occupancy"] >= 5  # the 5 coalesced into one batch
+
+
+def test_block_lane_not_starved_by_sync_flood():
+    """Acceptance: a saturated sync lane never delays block-critical
+    dispatch beyond its deadline (the BLOCK lane bypasses the in-flight
+    cap and is drained first)."""
+
+    async def main():
+        farm = VerificationFarm(max_batch=8, max_inflight=2)
+        _BlockingBackend(farm, block_first=0, sleep_s=0.15)
+        flood = [asyncio.ensure_future(farm.submit(r, lane=Lane.SYNC))
+                 for r in _sig_reqs(160, salt=b"fl")]
+        await asyncio.sleep(0.05)  # flood is mid-dispatch, lanes deep
+        t0 = time.perf_counter()
+        ok = await farm.submit(_sig_reqs(1, salt=b"blk")[0],
+                               lane=Lane.BLOCK)
+        block_latency = time.perf_counter() - t0
+        still_pending = sum(1 for f in flood if not f.done())
+        await asyncio.gather(*flood)
+        await farm.aclose()
+        return ok, block_latency, still_pending
+
+    ok, block_latency, still_pending = asyncio.run(main())
+    assert ok is True
+    # 160 sync items at 0.15s per 8-item batch ≈ seconds of flood; the
+    # block item must not ride out the whole flood
+    assert block_latency < 1.0, block_latency
+    assert still_pending > 16, still_pending  # flood genuinely mid-drain
+
+
+def test_sync_backpressure_bounds_queue():
+    async def main():
+        farm = VerificationFarm(lane_bounds={Lane.SYNC: 4})
+        bb = _BlockingBackend(farm, block_first=100)
+        tasks = [asyncio.ensure_future(farm.submit(r, lane=Lane.SYNC))
+                 for r in _sig_reqs(12, salt=b"bp")]
+        await asyncio.sleep(0.1)
+        peak = farm.stats["queue_peak"]["sync"]
+        bb.gate.set()
+        bb.block_left = 0
+        got = await asyncio.gather(*tasks)
+        await farm.aclose()
+        return peak, got
+
+    peak, got = asyncio.run(main())
+    assert peak <= 4  # submitters beyond the bound BLOCKED, not queued
+    assert got == [True] * 12  # and everyone still got a verdict
+
+
+def test_cancelled_caller_leaves_batch_intact():
+    async def main():
+        farm = VerificationFarm()
+        bb = _BlockingBackend(farm)
+        first = asyncio.ensure_future(farm.submit(_sig_reqs(1)[0]))
+        await asyncio.sleep(0.05)
+        reqs = _sig_reqs(3, salt=b"cx")
+        tasks = [asyncio.ensure_future(farm.submit(r)) for r in reqs]
+        await asyncio.sleep(0)
+        tasks[1].cancel()
+        bb.gate.set()
+        results = await asyncio.gather(*tasks, return_exceptions=True)
+        assert await first is True
+        await farm.aclose()
+        return results
+
+    r = asyncio.run(main())
+    assert r[0] is True and r[2] is True
+    assert isinstance(r[1], asyncio.CancelledError)
+
+
+def test_close_fails_pending_with_farm_closed():
+    async def main():
+        # max_inflight=1: with the first dispatch blocked, later submits
+        # stay QUEUED (the cap holds them) instead of dispatching at the
+        # deadline — the state aclose() must fail fast
+        farm = VerificationFarm(max_inflight=1)
+        bb = _BlockingBackend(farm)
+        inflight = asyncio.ensure_future(farm.submit(_sig_reqs(1)[0]))
+        await asyncio.sleep(0.05)
+        queued = asyncio.ensure_future(
+            farm.submit(_sig_reqs(1, salt=b"q")[0]))
+        await asyncio.sleep(0.02)
+        closer = asyncio.ensure_future(farm.aclose())
+        await asyncio.sleep(0.02)
+        with pytest.raises(FarmClosed):
+            await queued  # queued-but-undispatched work fails fast
+        bb.gate.set()  # let the in-flight dispatch finish
+        assert await inflight is True  # already-dispatched work completes
+        await closer
+        with pytest.raises(FarmClosed):
+            await farm.submit(_sig_reqs(1, salt=b"z")[0])
+
+    asyncio.run(main())
+
+
+# --- handler integration: farm path == inline path ------------------------
+
+
+def _signed_ballot(signer, layer, salt=0):
+    b = types.Ballot(
+        layer=layer, atx_id=bytes([salt]) * 32, epoch_data=None,
+        ref_ballot=bytes(32), eligibilities=[],
+        opinion=types.Opinion(base=bytes(32), support=[], against=[],
+                              abstain=[]),
+        node_id=signer.node_id, signature=bytes(64))
+    return dataclasses.replace(
+        b, signature=signer.sign(Domain.BALLOT, b.signed_bytes()))
+
+
+def test_malfeasance_handler_parity_and_fallback():
+    """The same proofs through (a) the sync fallback (farm=None) and
+    (b) the farm path produce identical decisions; the fallback needs
+    no event-loop machinery beyond the caller's."""
+    prefix = b"vf-test"
+    s = EdSigner(prefix=prefix)
+    good = malfeasance.proof_from_ballots(_signed_ballot(s, 5, 1),
+                                          _signed_ballot(s, 5, 2))
+    bad = malfeasance.proof_from_ballots(_signed_ballot(s, 5, 1),
+                                         _signed_ballot(s, 6, 2))
+    forged = dataclasses.replace(good, sig2=bytes(64))
+
+    def handler(farm):
+        # fresh db per proof: condemning the identity once would make
+        # every later proof short-circuit to "already known"
+        return malfeasance.Handler(
+            db=dbmod.open_state(), cache=AtxCache(),
+            verifier=EdVerifier(prefix=prefix), pubsub=PubSub(),
+            farm=farm)
+
+    expected = [asyncio.run(handler(None).process_async(p))
+                for p in (good, bad, forged)]
+    assert expected == [True, False, False]
+
+    async def main():
+        farm = VerificationFarm(ed_verifier=EdVerifier(prefix=prefix))
+        got = [await handler(farm).process_async(p)
+               for p in (good, bad, forged)]
+        await farm.aclose()
+        return got
+
+    assert asyncio.run(main()) == expected
+
+
+# --- ed25519 batch verification (core/signing.py) -------------------------
+
+
+def test_ed25519_rfc8032_vector():
+    """RFC 8032 test vector 2 (msg = 0x72): pins the pure-Python
+    fallback and the OpenSSL path to the same wire signatures, so nodes
+    on containers with and without `cryptography` interoperate."""
+    from spacemesh_tpu.core import signing
+
+    seed = bytes.fromhex("4ccd089b28ff96da9db6c346ec114e0f"
+                         "5b8a319f35aba624da8cf6ed4fb8a6fb")
+    pk = bytes.fromhex("3d4017c3e843895a92b70aa74d1b7ebc"
+                       "9c982ccf2ec4968cc0cd55f12af4660c")
+    sig = bytes.fromhex(
+        "92a009a9f0d4cab8720e820b5f642540"
+        "a2b27b5416503f8fb3762223ebdb69da"
+        "085ac1e43e15996e458f3613d0f11d8c"
+        "387b2eaeb4302aeeb00d291612bb0c00")
+    s = signing.EdSigner(seed=seed)  # prefix b"": raw RFC message
+    assert s.public_key == pk
+    # domain byte 0x72 + empty msg == the vector's one-byte message
+    assert s.sign(0x72, b"") == sig
+    v = signing.EdVerifier()
+    assert v.verify(0x72, pk, b"", sig)
+    assert not v.verify(0x72, pk, b"x", sig)
+
+
+def test_ed25519_batch_verify_matches_serial():
+    from spacemesh_tpu.core.signing import Domain, EdSigner, EdVerifier
+
+    v = EdVerifier(prefix=b"bt")
+    signers = [EdSigner(prefix=b"bt") for _ in range(3)]
+    items = []
+    for i in range(24):
+        s = signers[i % 3]
+        msg = b"bmsg" + i.to_bytes(2, "little")
+        sig = s.sign(Domain.HARE, msg)
+        if i % 5 == 0:
+            sig = bytes(64) if i % 2 else sig[:40]  # invalid / malformed
+        items.append((int(Domain.HARE), s.public_key, msg, sig))
+    serial = [v.verify(d, p, m, g) for d, p, m, g in items]
+    assert 0 < sum(serial) < len(serial)
+    assert v.verify_many(items) == serial
+    # all-valid fast path too (no fallback pass)
+    valid = [it for it, ok in zip(items, serial) if ok]
+    assert v.verify_many(valid) == [True] * len(valid)
+
+
+# --- pubsub hardening (satellite) -----------------------------------------
+
+
+def test_pubsub_raising_handler_does_not_block_others():
+    from spacemesh_tpu.utils.metrics import pubsub_handler_drops
+
+    ps = PubSub()
+    seen = []
+
+    async def bad(peer, data):
+        raise RuntimeError("boom")
+
+    async def good(peer, data):
+        seen.append(data)
+        return True
+
+    ps.register("t1", bad)
+    ps.register("t1", good)
+    before = sum(pubsub_handler_drops._values.values())
+    # a raising handler counts as a REJECT but must not stop delivery
+    assert asyncio.run(ps.deliver("t1", b"p", b"payload")) is False
+    assert seen == [b"payload"]
+    assert sum(pubsub_handler_drops._values.values()) == before + 1
