@@ -205,8 +205,41 @@ def _pt_mul_base(s: int):
     return out
 
 
+def _mul8(p):
+    """8*P via three doublings (the unified add formula doubles too)."""
+    p = _pt_add(p, p)
+    p = _pt_add(p, p)
+    return _pt_add(p, p)
+
+
+def _ed_check(a_pt, r_pt, s: int, k: int) -> bool:
+    """The COFACTORED verification equation: 8*(s*B) == 8*(R + k*A)
+    (ZIP-215 / ed25519consensus style, except encodings stay canonical).
+
+    Cofactored — not RFC 8032's cofactorless s*B == R + k*A — because
+    the batch path must be decision-identical to this check: under the
+    cofactorless equation an adversarial signature whose R carries a
+    small-order torsion component fails per-item but slips through a
+    random-linear-combination batch with probability ~1/8 (z_i ≡ 0
+    mod 8 annihilates the defect), so batch acceptance would not imply
+    per-item acceptance. Multiplying by the cofactor maps every term
+    into the prime-order subgroup, where the random 128-bit z_i make
+    batch and per-item verdicts agree except with probability 2^-128.
+    Honest signatures (torsion-free R, A) verify identically under both
+    equations; only adversarial small-order components see the OpenSSL
+    path (cofactorless) diverge — and mixed-backend networks already
+    require a uniform suite (see p2p/noise.py's module note).
+
+    8*(s*B) folds to (8s mod Q)*B since B generates the prime-order
+    subgroup; R and k*A may carry torsion, so the right side must
+    double the POINT three times.
+    """
+    return _pt_eq(_pt_mul_base(8 * s % _Q),
+                  _mul8(_pt_add(r_pt, _pt_mul(k, a_pt))))
+
+
 def _ed_verify_py(public_key: bytes, data: bytes, sig: bytes) -> bool:
-    """RFC 8032 verify (cofactorless, like OpenSSL): s*B == R + k*A."""
+    """Pure-Python ed25519 verify (cofactored — see _ed_check)."""
     a_pt = _pt_decode(public_key)
     r_pt = _pt_decode(sig[:32])
     if a_pt is None or r_pt is None:
@@ -217,7 +250,7 @@ def _ed_verify_py(public_key: bytes, data: bytes, sig: bytes) -> bool:
     k = int.from_bytes(
         hashlib.sha512(sig[:32] + public_key + data).digest(),
         "little") % _Q
-    return _pt_eq(_pt_mul_base(s), _pt_add(r_pt, _pt_mul(k, a_pt)))
+    return _ed_check(a_pt, r_pt, s, k)
 
 
 def _pt_neg(p):
@@ -303,10 +336,15 @@ def ed25519_batch_verify(items: list[tuple[bytes, bytes, bytes]]
     The random-linear-combination check (the dalek/ed25519consensus
     technique): with fresh 128-bit coefficients z_i,
 
-        (Σ z_i·s_i)·B  ==  Σ z_i·R_i + Σ (z_i·k_i)·A_i
+        8·(Σ z_i·s_i)·B  ==  8·(Σ z_i·R_i + Σ (z_i·k_i)·A_i)
 
     holds for an all-valid batch, and fails with probability 1-2^-128
-    if ANY signature is invalid. One Pippenger multi-scalar
+    if ANY signature is invalid. Both sides are multiplied by the
+    cofactor — and per-item verification uses the same cofactored
+    equation (_ed_check) — because a cofactorLESS batch is unsound
+    against torsion: a signature with a small-order defect in R passes
+    the combination with probability ~1/8, so batch acceptance would
+    not imply per-item acceptance. One Pippenger multi-scalar
     multiplication replaces N independent double-scalar ladders. On
     batch failure every candidate is re-checked individually, so the
     returned decisions are always EXACTLY the per-item verdicts —
@@ -352,7 +390,8 @@ def ed25519_batch_verify(items: list[tuple[bytes, bytes, bytes]]
         for z, (_, a_pt, r_pt, _, k, _key) in zip(zs, cand):
             pairs.append((z, r_pt))
             pairs.append((z * k % _Q, a_pt))
-        batched_ok = _pt_eq(_pt_mul_base(lhs), _msm(pairs))
+        batched_ok = _pt_eq(_pt_mul_base(8 * lhs % _Q),
+                            _mul8(_msm(pairs)))
         # a failed combo means at least one invalid signature: fall
         # through to per-item checks so every caller gets its exact
         # verdict. (Bisecting instead re-verifies the clean halves with
@@ -360,8 +399,7 @@ def ed25519_batch_verify(items: list[tuple[bytes, bytes, bytes]]
         # one serial pass, so the penalty is kept flat: one wasted MSM,
         # ~1.3x serial.)
     for i, a_pt, r_pt, s, k, key in cand:
-        ok = batched_ok or _pt_eq(_pt_mul_base(s),
-                                  _pt_add(r_pt, _pt_mul(k, a_pt)))
+        ok = batched_ok or _ed_check(a_pt, r_pt, s, k)
         results[i] = ok
         _cache_put(key, ok)
     return results

@@ -241,13 +241,23 @@ if not _HAVE_CRYPTOGRAPHY:
         """Encrypt-then-MAC AEAD from hashlib/hmac (NOT ChaCha20: see
         the module-import note on wire compatibility). Keystream blocks
         are SHA256(key || nonce || counter); the 16-byte tag is
-        HMAC-SHA256(mac_key, nonce || aad || ct) truncated."""
+        HMAC-SHA256(mac_key, nonce || len(aad) || aad || ct) truncated
+        — the aad length prefix frames the MAC input (mirroring
+        Poly1305's aad/ct length block), so distinct (aad, ct) splits
+        of one byte string never authenticate identically."""
 
         TAG = 16
 
         def __init__(self, key: bytes):
             self._enc = key
             self._mac = hashlib.sha256(b"smh/fallback-mac" + key).digest()
+
+        def _tag(self, nonce: bytes, aad: bytes, ct: bytes) -> bytes:
+            aad = aad or b""
+            return _hmac.new(
+                self._mac,
+                nonce + len(aad).to_bytes(8, "little") + aad + ct,
+                hashlib.sha256).digest()[:self.TAG]
 
         def _stream(self, nonce: bytes, n: int) -> bytes:
             out = bytearray()
@@ -266,16 +276,12 @@ if not _HAVE_CRYPTOGRAPHY:
 
         def encrypt(self, nonce: bytes, data: bytes, aad: bytes) -> bytes:
             ct = self._xor(nonce, data)
-            tag = _hmac.new(self._mac, nonce + (aad or b"") + ct,
-                            hashlib.sha256).digest()[:self.TAG]
-            return ct + tag
+            return ct + self._tag(nonce, aad, ct)
 
         def decrypt(self, nonce: bytes, data: bytes, aad: bytes) -> bytes:
             if len(data) < self.TAG:
                 raise ValueError("ciphertext too short")
             ct, tag = data[:-self.TAG], data[-self.TAG:]
-            want = _hmac.new(self._mac, nonce + (aad or b"") + ct,
-                             hashlib.sha256).digest()[:self.TAG]
-            if not _hmac.compare_digest(tag, want):
+            if not _hmac.compare_digest(tag, self._tag(nonce, aad, ct)):
                 raise ValueError("InvalidTag")
             return self._xor(nonce, ct)

@@ -247,13 +247,9 @@ class VerificationFarm:
         if st.worker is None or st.worker.done():
             st.worker = self._loop.create_task(self._worker(kind))
 
-    async def aclose(self) -> None:
-        """Stop workers and fail pending requests with FarmClosed."""
-        self._closed = True
-        workers = [st.worker for st in self._kinds.values()
-                   if st.worker is not None]
-        for w in workers:
-            w.cancel()
+    def _fail_pending(self) -> None:
+        """Fail every queued request and backpressure waiter with
+        FarmClosed (the bound loop must still be alive)."""
         for st in self._kinds.values():
             st.arrived.set()
             for q in st.lanes.values():
@@ -266,14 +262,28 @@ class VerificationFarm:
                 w = waiters.popleft()
                 if not w.done():
                     w.set_exception(FarmClosed("farm closed"))
+
+    async def aclose(self) -> None:
+        """Stop workers and fail pending requests with FarmClosed."""
+        self._closed = True
+        workers = [st.worker for st in self._kinds.values()
+                   if st.worker is not None]
+        for w in workers:
+            w.cancel()
+        self._fail_pending()
         await asyncio.gather(*workers, return_exceptions=True)
         inflight = [t for st in self._kinds.values() for t in st.inflight]
         await asyncio.gather(*inflight, return_exceptions=True)
         self.shutdown()
 
     def shutdown(self) -> None:
-        """Synchronous teardown (App.close runs after the loop exits):
-        drop scheduler state and the worker pool. Safe to call twice."""
+        """Synchronous teardown: drop scheduler state and the worker
+        pool. Safe to call twice. Normally App.close runs this after
+        the loop exits, but error-path teardown can reach it with the
+        loop still alive — then pending futures and backpressure
+        waiters must fail with FarmClosed, or handler coroutines
+        awaiting submit() hang forever (only aclose() would otherwise
+        resolve them)."""
         self._closed = True
         for st in self._kinds.values():
             if st.worker is not None:
@@ -281,6 +291,8 @@ class VerificationFarm:
                     st.worker.cancel()
                 except RuntimeError:  # task's loop already torn down
                     pass
+        if self._loop is not None and not self._loop.is_closed():
+            self._fail_pending()
         if self._pool is not None:
             self._pool.shutdown(wait=False, cancel_futures=True)
             self._pool = None
@@ -314,8 +326,15 @@ class VerificationFarm:
             try:
                 await waiter
             except asyncio.CancelledError:
-                if waiter in self._lane_waiters[lane]:
+                try:
                     self._lane_waiters[lane].remove(waiter)
+                except ValueError:
+                    # already popped by _release_lane: it granted us a
+                    # slot we will never use — hand the wakeup to the
+                    # next waiter, or the freed slot is silently lost
+                    # and survivors can park forever on a drained lane
+                    if waiter.done() and not waiter.cancelled():
+                        self._wake_next(lane)
                 raise
             if self._closed:
                 raise FarmClosed("farm closed")
@@ -429,12 +448,17 @@ class VerificationFarm:
         self._lane_count[lane] -= 1
         metrics.verify_farm_queue_depth.set(self._lane_count[lane],
                                             lane=lane.name.lower())
+        self._wake_next(lane)
+
+    def _wake_next(self, lane: Lane) -> None:
+        """Grant a freed lane slot to the next live backpressure waiter
+        (woken submitters re-check the bound in submit's while loop)."""
         waiters = self._lane_waiters[lane]
         while waiters and self._lane_count[lane] < self.lane_bounds[lane]:
             w = waiters.popleft()
             if not w.done():
                 w.set_result(None)
-                break
+                return
 
     def _on_taken(self, batch: list[_Pending]) -> None:
         for p in batch:

@@ -295,3 +295,28 @@ def test_wire_traffic_is_encrypted():
         await b.stop()
 
     asyncio.run(asyncio.wait_for(go(), 30))
+
+
+def test_fallback_aead_mac_is_length_framed():
+    """The no-`cryptography` AEAD must not authenticate distinct
+    (aad, ct) splits of the same byte string: the tag input frames the
+    aad with a length prefix, so shifting a byte across the aad/ct
+    boundary invalidates the tag (it previously verified, decrypting
+    to garbage that the MAC was supposed to gate)."""
+    from spacemesh_tpu.p2p import noise
+
+    if noise._HAVE_CRYPTOGRAPHY:
+        pytest.skip("real ChaCha20-Poly1305 in use; fallback not built")
+    aead = noise.ChaCha20Poly1305(b"k" * 32)
+    nonce = bytes(12)
+    aad = b"header"
+    blob = aead.encrypt(nonce, b"payload-bytes", aad)
+    ct, tag = blob[:-aead.TAG], blob[-aead.TAG:]
+    assert aead.decrypt(nonce, blob, aad) == b"payload-bytes"
+    # move the first ciphertext byte into the aad: same concatenation,
+    # different split — must NOT authenticate
+    with pytest.raises(ValueError):
+        aead.decrypt(nonce, ct[1:] + tag, aad + ct[:1])
+    # and vice versa: last aad byte moved into the ciphertext
+    with pytest.raises(ValueError):
+        aead.decrypt(nonce, aad[-1:] + ct + tag, aad[:-1])

@@ -269,6 +269,63 @@ def test_cancelled_caller_leaves_batch_intact():
     assert isinstance(r[1], asyncio.CancelledError)
 
 
+def test_cancelled_waiter_hands_freed_slot_to_next():
+    """A backpressure waiter cancelled AFTER _release_lane resolved it
+    (but before its submit resumed) must pass the freed slot on —
+    otherwise the grant is lost and surviving waiters can park forever
+    once the lane drains with no further releases."""
+
+    async def main():
+        farm = VerificationFarm()
+        assert await farm.submit(_sig_reqs(1, salt=b"w0")[0]) is True
+        lane = Lane.SYNC
+        farm._lane_count[lane] = farm.lane_bounds[lane]  # lane "full"
+        b = asyncio.ensure_future(
+            farm.submit(_sig_reqs(1, salt=b"wb")[0], lane=lane))
+        c = asyncio.ensure_future(
+            farm.submit(_sig_reqs(1, salt=b"wc")[0], lane=lane))
+        for _ in range(3):
+            await asyncio.sleep(0)
+        assert len(farm._lane_waiters[lane]) == 2
+        farm._release_lane(lane)  # frees one slot: resolves b's waiter
+        b.cancel()                # ...which b will never consume
+        with pytest.raises(asyncio.CancelledError):
+            await b
+        ok = await asyncio.wait_for(c, 5)  # hangs without the handoff
+        await farm.aclose()
+        return ok
+
+    assert asyncio.run(main()) is True
+
+
+def test_sync_shutdown_with_live_loop_fails_pending():
+    """App.close() runs the SYNC shutdown(); on error-path teardown the
+    loop may still be alive — queued requests and backpressure waiters
+    must then fail with FarmClosed instead of hanging forever."""
+
+    async def main():
+        farm = VerificationFarm(max_inflight=1,
+                                lane_bounds={Lane.SYNC: 1})
+        bb = _BlockingBackend(farm)
+        inflight = asyncio.ensure_future(farm.submit(_sig_reqs(1)[0]))
+        await asyncio.sleep(0.05)  # dispatched and blocked in backend
+        queued = asyncio.ensure_future(
+            farm.submit(_sig_reqs(1, salt=b"q")[0], lane=Lane.SYNC))
+        waiting = asyncio.ensure_future(
+            farm.submit(_sig_reqs(1, salt=b"w")[0], lane=Lane.SYNC))
+        await asyncio.sleep(0.02)  # queued fills the lane; waiting parks
+        farm.shutdown()  # the sync path, loop still running
+        with pytest.raises(FarmClosed):
+            await asyncio.wait_for(queued, 5)
+        with pytest.raises(FarmClosed):
+            await asyncio.wait_for(waiting, 5)
+        bb.gate.set()  # already-dispatched work still completes
+        assert await inflight is True
+        await farm.aclose()
+
+    asyncio.run(main())
+
+
 def test_close_fails_pending_with_farm_closed():
     async def main():
         # max_inflight=1: with the first dispatch blocked, later submits
@@ -388,6 +445,74 @@ def test_ed25519_batch_verify_matches_serial():
     # all-valid fast path too (no fallback pass)
     valid = [it for it, ok in zip(items, serial) if ok]
     assert v.verify_many(valid) == [True] * len(valid)
+
+
+def test_ed25519_torsion_defect_single_batch_parity():
+    """An adversarial signature whose R carries a small-order torsion
+    component: under the old cofactorless-single / RLC-batch split the
+    batch accepted it with probability ~1/8 while single verify always
+    rejected — nondeterministic farm-vs-inline divergence. Both paths
+    are now cofactored (signing._ed_check) and must agree,
+    deterministically, and accept it."""
+    import hashlib
+
+    from spacemesh_tpu.core import signing
+
+    if signing._HAVE_CRYPTOGRAPHY:
+        pytest.skip("OpenSSL backend (cofactorless) in use; this pins "
+                    "the pure-Python cofactored path")
+
+    # project an arbitrary curve point onto the torsion subgroup: Q*P
+    # is P's small-order component (nonzero for ~7/8 of points)
+    t8 = None
+    i = 0
+    while t8 is None:
+        pt = signing._pt_decode(
+            hashlib.sha256(b"torsion%d" % i).digest())
+        i += 1
+        if pt is None:
+            continue
+        cand = signing._pt_mul(signing._Q, pt)
+        if not signing._pt_eq(cand, signing._ID):
+            t8 = cand
+
+    # forge: honest (r, s) but publish R' = R + T — the prime-order
+    # part of the equation holds, the torsion part does not
+    seed = bytes(31) + b"\x07"
+    scalar, nonce_prefix = signing._expand_key(seed)
+    pub = signing._pt_encode(signing._pt_mul_base(scalar))
+    msg = b"torsion-msg"
+    data = bytes([int(Domain.ATX)]) + msg
+    r = int.from_bytes(
+        hashlib.sha512(nonce_prefix + data).digest(),
+        "little") % signing._Q
+    r_enc = signing._pt_encode(
+        signing._pt_add(signing._pt_mul_base(r), t8))
+    k = int.from_bytes(
+        hashlib.sha512(r_enc + pub + data).digest(),
+        "little") % signing._Q
+    s = (r + k * scalar) % signing._Q
+    forged = r_enc + s.to_bytes(32, "little")
+
+    v = EdVerifier()
+    honest = EdSigner(seed=bytes(31) + b"\x09")
+    items = [(int(Domain.ATX), pub, msg, forged)]
+    for j in range(9):  # ≥8 candidates so the MSM batch path engages
+        m = b"hm%d" % j
+        items.append((int(Domain.ATX), honest.public_key, m,
+                      honest.sign(Domain.ATX, m)))
+    for _ in range(3):  # the old divergence was probabilistic
+        signing.clear_verify_cache()
+        batch = v.verify_many(items)
+        signing.clear_verify_cache()
+        serial = [v.verify(d, p, m, g) for d, p, m, g in items]
+        assert batch == serial
+        assert serial[0] is True  # pins the cofactored equation
+    # a genuinely invalid signature still fails both paths
+    bad = list(items[1])
+    bad[3] = bytes(64)
+    signing.clear_verify_cache()
+    assert v.verify_many(items + [tuple(bad)])[-1] is False
 
 
 # --- pubsub hardening (satellite) -----------------------------------------
