@@ -29,9 +29,20 @@ verification farm (spacemesh_tpu/verify/), emitting:
 Both paths are warmed first so the numbers compare steady-state
 throughput, not XLA compile time; decisions are asserted bit-identical.
 
+Between the init and verify benchmarks, the PROVE side (ISSUE 3) measures
+the streaming prover against the legacy serial scan over one shared
+reduced-parameter store, emitting:
+  {"metric": "post_prove_labels_per_sec", ..., "serial": N, "speedup": N}
+Both provers must produce bit-identical proofs (asserted) and the
+pipelined proof must verify; the rate is store labels covered per second
+until the winning nonce is decided — the streaming pipeline's sound early
+exit plus read/compute overlap is what the speedup measures
+(docs/POST_PROVING.md).
+
 Env knobs: BENCH_BATCH (label lanes per program), BENCH_N (scrypt N),
 BENCH_REPS, BENCH_CPU_LABELS, BENCH_VERIFY_ITEMS (0 disables the verify
-bench), SPACEMESH_JAX_CACHE (cache dir, `off` to disable).
+bench), BENCH_PROVE_LABELS (store size; 0 disables the prove bench),
+BENCH_PROVE_BATCH, SPACEMESH_JAX_CACHE (cache dir, `off` to disable).
 """
 
 import hashlib
@@ -56,6 +67,43 @@ def cpu_labels_per_sec(commitment: bytes, n: int, count: int) -> float:
 
 # probe + CPU fallback shared with tools/profiler.py — ONE copy of the
 # wedged-tunnel handling (spacemesh_tpu/utils/accel.py)
+
+
+def prove_bench(labels: int, batch: int, reps: int = 3) -> None:
+    """Streaming vs legacy-serial proving over one shared store.
+
+    The deterministic reduced-parameter fixture lives in
+    spacemesh_tpu/post/workload.py (ONE copy, shared with the profiler's
+    --prove view); it asserts the two paths' proofs are bit-identical and
+    verifiable before this reports a number.
+    """
+    import tempfile
+
+    from spacemesh_tpu.post import workload
+
+    with tempfile.TemporaryDirectory() as d:
+        log(f"prove store: {labels} labels (scrypt N=2) ...")
+        prover = workload.build(d, labels, batch)
+        doc = workload.compare_serial_vs_pipelined(prover, reps=reps)
+
+    serial_rate = labels / doc["serial_s"]
+    pipe_rate = labels / doc["pipelined_s"]
+    stats = doc["stats"]
+    log(f"prove: serial {doc['serial_s'] * 1e3:.1f}ms, pipelined "
+        f"{doc['pipelined_s'] * 1e3:.1f}ms ({doc['speedup']:.2f}x, "
+        f"nonce {doc['proof'].nonce}, "
+        f"early_exit={stats.get('early_exited')})")
+    print(json.dumps({
+        "metric": "post_prove_labels_per_sec",
+        "value": round(pipe_rate, 1),
+        "unit": "labels/s",
+        "serial": round(serial_rate, 1),
+        "speedup": round(pipe_rate / serial_rate, 2),
+        "labels": labels, "batch": batch,
+        "proof_nonce": doc["proof"].nonce,
+        "early_exited": bool(stats.get("early_exited")),
+        "verified": True,
+    }))
 
 
 def verify_bench(total_items: int) -> None:
@@ -203,6 +251,11 @@ def main() -> None:
         "unit": "s",
         "cache_dir": cache_dir or "",
     }))
+
+    prove_labels = int(os.environ.get("BENCH_PROVE_LABELS", 1 << 16))
+    if prove_labels > 0:
+        prove_bench(prove_labels,
+                    int(os.environ.get("BENCH_PROVE_BATCH", 2048)))
 
     verify_items = int(os.environ.get("BENCH_VERIFY_ITEMS", 512))
     if verify_items > 0:

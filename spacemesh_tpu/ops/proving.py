@@ -82,6 +82,21 @@ def proving_hash_jit(challenge_words, nonce, idx_lo, idx_hi, label_words):
     return salsa20_8(state)[0]
 
 
+def _scan_mask(challenge_words, nonce_base, idx_lo, idx_hi, label_words,
+               threshold, *, n_nonces: int):
+    """(n_nonces, B) bool qualification mask, traced per-nonce.
+
+    The per-nonce stacking (rather than one fused (16, n*B) state) keeps
+    each Salsa20/8 working set L2-resident — measured ~2x faster on CPU
+    and neutral on TPU, where the Pallas kernel is the fast path anyway.
+    """
+    def one(k):
+        vals = proving_hash_jit(challenge_words, nonce_base + jnp.uint32(k),
+                                idx_lo, idx_hi, label_words)
+        return vals < threshold.astype(jnp.uint32)
+    return jnp.stack([one(k) for k in range(n_nonces)])
+
+
 @functools.partial(jax.jit, static_argnames=("n_nonces",))
 def proving_scan_jit(challenge_words, nonce_base, idx_lo, idx_hi, label_words,
                      threshold, *, n_nonces: int):
@@ -91,11 +106,120 @@ def proving_scan_jit(challenge_words, nonce_base, idx_lo, idx_hi, label_words,
     per-nonce hit counts/indices across label batches; n_nonces is static
     so the whole sweep is one compiled program.
     """
-    def one(k):
-        vals = proving_hash_jit(challenge_words, nonce_base + jnp.uint32(k),
-                                idx_lo, idx_hi, label_words)
-        return vals < threshold.astype(jnp.uint32)
-    return jnp.stack([one(k) for k in range(n_nonces)])
+    return _scan_mask(challenge_words, nonce_base, idx_lo, idx_hi,
+                      label_words, threshold, n_nonces=n_nonces)
+
+
+# --- on-device hit compaction ----------------------------------------------
+#
+# The streaming prover never copies a qualification mask to the host: each
+# batch's hits are compacted on device into ascending (lane, rank) form and
+# merged into a *donated* running hit state, so the per-batch D2H is one
+# (n_nonces,) count vector (~100-1000x smaller than the mask) and the packed
+# (nonce, index) hit pairs cross PCIe once per pass, not once per batch.
+
+HIT_SEGMENT = 64  # lanes per compaction segment; batch must divide by this
+
+
+def compact_hits(mask, seg_sum=None, *, max_hits: int):
+    """Compact a (n_nonces, B) mask into per-nonce hit positions.
+
+    Returns ``(batch_counts, local_pos, hit_valid)``: true per-nonce hit
+    counts (i32), the ascending lane indices of each nonce's first
+    ``max_hits`` hits (u32, garbage where invalid), and the validity mask.
+    Two-level extraction — segment popcounts, then a gather of only the
+    ``max_hits`` segments that actually contain the wanted hits — so the
+    cost is one reduction pass over the mask, not a (n_nonces, B) sort.
+
+    ``seg_sum`` may be supplied by a kernel that already reduced the mask
+    (the Pallas epilogue); otherwise it is computed here.
+    """
+    n_nonces, b = mask.shape
+    nseg = b // HIT_SEGMENT
+    m3 = mask.reshape(n_nonces, nseg, HIT_SEGMENT)
+    if seg_sum is None:
+        seg_sum = jnp.sum(m3, axis=-1, dtype=jnp.int32)
+    seg_csum = jnp.cumsum(seg_sum, axis=1)
+    batch_counts = seg_csum[:, -1]
+    targets = jnp.arange(1, max_hits + 1, dtype=jnp.int32)
+    # segment holding each nonce's j-th hit (binary search per row)
+    seg = jax.vmap(
+        lambda row: jnp.searchsorted(row, targets, side="left"))(seg_csum)
+    segc = jnp.minimum(seg, nseg - 1)
+    prev = jnp.where(seg > 0,
+                     jnp.take_along_axis(seg_csum,
+                                         jnp.maximum(seg - 1, 0), axis=1),
+                     0)
+    rank = targets[None, :] - prev             # 1-based rank within segment
+    seg_lanes = jnp.take_along_axis(m3, segc[:, :, None], axis=1)
+    within = jnp.cumsum(seg_lanes.astype(jnp.int32), axis=-1)
+    lane = jnp.sum((within < rank[:, :, None]).astype(jnp.int32), axis=-1)
+    local_pos = (segc * HIT_SEGMENT + lane).astype(jnp.uint32)
+    hit_valid = targets[None, :] <= batch_counts[:, None]
+    return batch_counts, local_pos, hit_valid
+
+
+def merge_hits(hit_counts, hit_carry, batch_counts, local_pos, hit_valid,
+               start_lo, start_hi):
+    """Scatter one batch's compacted hits into the running device state.
+
+    ``hit_carry`` is (2, n_nonces, cap) u32 — lo/hi halves of global label
+    indices, slot-ordered (ascending) per nonce. Hits beyond ``cap`` drop:
+    the prover sizes cap >= k2, and only the first k2 hits per nonce can
+    ever appear in a proof. Returns (new_counts, batch_counts, hit_carry);
+    callers donate hit_counts/hit_carry so the state rotates in place.
+    """
+    n_nonces, max_hits = local_pos.shape
+    cap = hit_carry.shape[2]
+    glo = (start_lo + local_pos).astype(jnp.uint32)
+    ghi = (start_hi + (glo < local_pos).astype(jnp.uint32)).astype(jnp.uint32)
+    targets = jnp.arange(max_hits, dtype=jnp.int32)
+    rows = jnp.broadcast_to(jnp.arange(n_nonces)[:, None], local_pos.shape)
+    slots = jnp.where(hit_valid, hit_counts[:, None] + targets[None, :], cap)
+    hit_carry = hit_carry.at[0, rows, slots].set(glo, mode="drop")
+    hit_carry = hit_carry.at[1, rows, slots].set(ghi, mode="drop")
+    return hit_counts + batch_counts, batch_counts, hit_carry
+
+
+@functools.partial(jax.jit, static_argnames=("n_nonces", "max_hits"),
+                   donate_argnums=(6, 7))
+def prove_scan_step_jit(challenge_words, nonce_base, idx_lo, idx_hi,
+                        label_words, threshold, hit_counts, hit_carry,
+                        valid, start_lo, start_hi, *, n_nonces: int,
+                        max_hits: int):
+    """One pipelined prove step: scan + compact + merge, all on device.
+
+    ``valid`` masks pad lanes of a ragged tail batch (lane >= valid never
+    qualifies), so every batch of a pass shares one compiled shape.
+    Returns (hit_counts', batch_counts, hit_carry'); the carries are
+    donated and cycle device-side across the pass — the only per-batch
+    host fetch is ``batch_counts``.
+    """
+    b = idx_lo.shape[0]
+    mask = _scan_mask(challenge_words, nonce_base, idx_lo, idx_hi,
+                      label_words, threshold, n_nonces=n_nonces)
+    lane = jnp.arange(b, dtype=jnp.uint32)
+    mask = mask & (lane[None, :] < valid)
+    counts, pos, ok = compact_hits(mask, max_hits=max_hits)
+    return merge_hits(hit_counts, hit_carry, counts, pos, ok,
+                      start_lo, start_hi)
+
+
+def init_hit_state(n_nonces: int, cap: int):
+    """Fresh (hit_counts, hit_carry) device state for one prove pass."""
+    return (jnp.zeros(n_nonces, jnp.int32),
+            jnp.full((2, n_nonces, cap), 0xFFFFFFFF, jnp.uint32))
+
+
+def decode_hits(hit_counts, hit_carry, nonce_row: int, limit: int
+                ) -> list[int]:
+    """Host-side: first ``limit`` global label indices of one nonce row."""
+    counts = np.asarray(hit_counts)
+    carry = np.asarray(hit_carry)
+    n = min(int(counts[nonce_row]), carry.shape[2], limit)
+    lo = carry[0, nonce_row, :n].astype(np.uint64)
+    hi = carry[1, nonce_row, :n].astype(np.uint64)
+    return [int(v) for v in (lo | (hi << np.uint64(32)))]
 
 
 def proving_hashes(challenge: bytes, nonce: int, indices, labels: np.ndarray

@@ -8,13 +8,23 @@ in VMEM and unrolls the nonce group over it, so each label crosses
 HBM->VMEM once per group instead of once per nonce (the XLA version
 re-materializes the broadcast state per nonce).
 
+Compaction epilogue (streaming prover): alongside the mask the kernel
+reduces each HIT_SEGMENT-lane span to its hit count while the tile is
+still in VMEM, and masks pad lanes (``lane >= valid``) so a ragged tail
+batch shares the full-batch compiled shape. The surrounding jit
+(``prove_scan_step_pallas``) turns those segment counts into packed
+(nonce, index) hit pairs merged into a donated device carry — the mask
+never crosses PCIe; the only per-batch D2H is the (n_nonces,) count
+vector (ops/proving.py compact_hits/merge_hits).
+
 Layout (matching ops/scrypt.py): lane-minor u32 tiles. Inputs:
   base  (12, B)  rows: challenge words 0..7 (broadcast), idx_lo, idx_hi,
                  zeros, spare
   lw    (4, B)   little-endian label words
-  nonce_base, threshold: SMEM scalars
-Output:
+  nonce_base, threshold, valid: SMEM scalars
+Outputs:
   mask  (n_nonces, B) int8 qualification
+  seg   (n_nonces, B // HIT_SEGMENT) i32 per-segment hit counts
 
 Grid: lane tiles of LANE_TILE. Set ``interpret=True`` to run/verify on CPU
 (the test path); on TPU the same call compiles via Mosaic.
@@ -28,6 +38,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
+
+from . import proving
 
 try:  # pltpu only resolves on TPU builds; interpret mode works without it
     from jax.experimental.pallas import tpu as pltpu
@@ -50,12 +62,18 @@ def _quarter(x, a, b, c, d):
     x[a] = x[a] ^ rotl(x[d] + x[c], 18)
 
 
-def _kernel(nonce_ref, thr_ref, base_ref, lw_ref, out_ref, *, n_nonces: int):
+def _kernel(nonce_ref, thr_ref, valid_ref, base_ref, lw_ref, out_ref,
+            seg_ref, *, n_nonces: int):
     base = base_ref[...]          # (12, T) u32
     lw = lw_ref[...]              # (4, T) u32
     thr = thr_ref[0]
     nonce0 = nonce_ref[0]
+    valid = valid_ref[0]
     t = base.shape[1]
+    nseg = t // proving.HIT_SEGMENT
+    # global lane index of each tile lane (2-D iota: TPU-safe)
+    lane = jax.lax.broadcasted_iota(jnp.uint32, (t, 1), 0).reshape(t)
+    alive = (jnp.uint32(pl.program_id(0)) * jnp.uint32(t) + lane) < valid
     zeros = jnp.zeros((t,), jnp.uint32)
     for k in range(n_nonces):     # static unroll over the nonce group
         x = [base[i] for i in range(8)]          # challenge rows
@@ -75,18 +93,21 @@ def _kernel(nonce_ref, thr_ref, base_ref, lw_ref, out_ref, *, n_nonces: int):
             _quarter(x, 10, 11, 8, 9)
             _quarter(x, 15, 12, 13, 14)
         word0 = x[0] + in0
-        out_ref[k, :] = (word0 < thr).astype(jnp.int8)
+        hit = (word0 < thr) & alive
+        out_ref[k, :] = hit.astype(jnp.int8)
+        # compaction epilogue: per-segment popcounts while the tile is in
+        # VMEM, so the host-side hit extraction never touches the mask
+        seg_ref[k, :] = jnp.sum(
+            hit.reshape(nseg, proving.HIT_SEGMENT).astype(jnp.int32),
+            axis=1)
 
 
 @functools.partial(jax.jit,
                    static_argnames=("n_nonces", "interpret", "lane_tile"))
-def proving_scan_pallas(challenge_words, nonce_base, idx_lo, idx_hi,
-                        label_words, threshold, *, n_nonces: int,
-                        interpret: bool = False, lane_tile: int = LANE_TILE):
-    """Drop-in for ops.proving.proving_scan_jit (returns int8 mask).
-
-    Batch size must be a multiple of ``lane_tile``.
-    """
+def _scan_pallas(challenge_words, nonce_base, idx_lo, idx_hi, label_words,
+                 threshold, valid, *, n_nonces: int, interpret: bool = False,
+                 lane_tile: int = LANE_TILE):
+    """Mask + per-segment hit counts; batch must divide by ``lane_tile``."""
     b = idx_lo.shape[0]
     if b % lane_tile:
         raise ValueError(f"batch {b} not a multiple of lane tile {lane_tile}")
@@ -99,22 +120,73 @@ def proving_scan_pallas(challenge_words, nonce_base, idx_lo, idx_hi,
     kernel = functools.partial(_kernel, n_nonces=n_nonces)
     scalar_spec = (pl.BlockSpec(memory_space=_SMEM) if _SMEM is not None
                    else pl.BlockSpec(memory_space=pl.ANY))
-    out = pl.pallas_call(
+    seg_tile = lane_tile // proving.HIT_SEGMENT
+    mask, seg = pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct((n_nonces, b), jnp.int8),
+        out_shape=(
+            jax.ShapeDtypeStruct((n_nonces, b), jnp.int8),
+            jax.ShapeDtypeStruct((n_nonces, b // proving.HIT_SEGMENT),
+                                 jnp.int32),
+        ),
         grid=grid,
         in_specs=[
+            scalar_spec,
             scalar_spec,
             scalar_spec,
             pl.BlockSpec((12, lane_tile), lambda i: (0, i)),
             pl.BlockSpec((4, lane_tile), lambda i: (0, i)),
         ],
-        out_specs=pl.BlockSpec((n_nonces, lane_tile), lambda i: (0, i)),
+        out_specs=(
+            pl.BlockSpec((n_nonces, lane_tile), lambda i: (0, i)),
+            pl.BlockSpec((n_nonces, seg_tile), lambda i: (0, i)),
+        ),
         interpret=interpret,
     )(jnp.asarray([nonce_base], jnp.uint32),
-      jnp.asarray([threshold], jnp.uint32), base,
+      jnp.asarray([threshold], jnp.uint32),
+      jnp.asarray([valid], jnp.uint32), base,
       label_words.astype(jnp.uint32))
-    return out
+    return mask, seg
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("n_nonces", "interpret", "lane_tile"))
+def proving_scan_pallas(challenge_words, nonce_base, idx_lo, idx_hi,
+                        label_words, threshold, *, n_nonces: int,
+                        interpret: bool = False, lane_tile: int = LANE_TILE):
+    """Drop-in for ops.proving.proving_scan_jit (returns int8 mask).
+
+    Batch size must be a multiple of ``lane_tile``.
+    """
+    b = idx_lo.shape[0]
+    mask, _ = _scan_pallas(challenge_words, nonce_base, idx_lo, idx_hi,
+                           label_words, threshold, jnp.uint32(b),
+                           n_nonces=n_nonces, interpret=interpret,
+                           lane_tile=lane_tile)
+    return mask
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("n_nonces", "max_hits", "interpret",
+                                    "lane_tile"),
+                   donate_argnums=(6, 7))
+def prove_scan_step_pallas(challenge_words, nonce_base, idx_lo, idx_hi,
+                           label_words, threshold, hit_counts, hit_carry,
+                           valid, start_lo, start_hi, *, n_nonces: int,
+                           max_hits: int, interpret: bool = False,
+                           lane_tile: int = LANE_TILE):
+    """Pallas-backed twin of ops.proving.prove_scan_step_jit.
+
+    Same contract: donated (hit_counts, hit_carry) device state, per-batch
+    D2H limited to the (n_nonces,) batch count vector.
+    """
+    mask, seg = _scan_pallas(challenge_words, nonce_base, idx_lo, idx_hi,
+                             label_words, threshold, valid,
+                             n_nonces=n_nonces, interpret=interpret,
+                             lane_tile=lane_tile)
+    counts, pos, ok = proving.compact_hits(mask.astype(bool), seg_sum=seg,
+                                           max_hits=max_hits)
+    return proving.merge_hits(hit_counts, hit_carry, counts, pos, ok,
+                              start_lo, start_hi)
 
 
 def proving_scan(challenge: bytes, nonce_base: int, indices, labels: np.ndarray,

@@ -21,7 +21,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..ops import scrypt
+from ..ops import proving, scrypt
 from ..ops.sha256 import byteswap32
 
 DATA_AXIS = "data"
@@ -82,6 +82,32 @@ def scrypt_labels_sharded(mesh: Mesh, commitment_words, idx_lo, idx_hi,
     if cw.ndim == 2:
         cw = jax.device_put(cw, _lane_sharding(mesh))
     return scrypt.scrypt_labels_jit(cw, idx_lo, idx_hi, n=n)
+
+
+def prove_step_sharded(mesh: Mesh, challenge_words, nonce_base, idx_lo,
+                       idx_hi, label_words, threshold, hit_counts, hit_carry,
+                       valid, start_lo, start_hi, *, n_nonces: int,
+                       max_hits: int):
+    """One sharded streaming-prove step (the multichip prove path).
+
+    Label lanes are striped over the mesh exactly like
+    ``labels_with_min_sharded`` stripes init batches; the Salsa20/8 sweep
+    is embarrassingly parallel per lane, and GSPMD lowers the compaction
+    epilogue's small reductions/gathers to ICI collectives. The donated
+    (hit_counts, hit_carry) state stays replicated (see
+    ops/proving.py merge_hits); the prover replicates it via
+    ``replicate()`` before the first batch of a pass. Batch size must
+    divide by the mesh size — the prover's pad-and-trim already makes
+    every batch the full ``batch_labels``.
+    """
+    bs = _batch_sharding(mesh)
+    idx_lo = jax.device_put(jnp.asarray(idx_lo), bs)
+    idx_hi = jax.device_put(jnp.asarray(idx_hi), bs)
+    lw = jax.device_put(jnp.asarray(label_words), _lane_sharding(mesh))
+    return proving.prove_scan_step_jit(
+        jnp.asarray(challenge_words), nonce_base, idx_lo, idx_hi, lw,
+        threshold, hit_counts, hit_carry, valid, start_lo, start_hi,
+        n_nonces=n_nonces, max_hits=max_hits)
 
 
 @functools.partial(jax.jit, static_argnames=("n",))

@@ -63,9 +63,15 @@ def cmd_prove(a) -> int:
 
     params = ProofParams(k1=a.k1, k2=a.k2, k3=a.k3)
     t0 = time.monotonic()
-    proof = Prover(a.data_dir, params, batch_labels=a.batch).prove(
-        a.challenge_hex)
+    prover = Prover(a.data_dir, params, batch_labels=a.batch,
+                    pipelined=None if not a.serial else False,
+                    window_groups=a.window_groups, inflight=a.inflight,
+                    readers=a.readers)
+    proof = prover.prove(a.challenge_hex)
     out = proof.to_dict() | {"elapsed_s": round(time.monotonic() - t0, 2)}
+    if a.stage_timings and prover.last_stats is not None:
+        out["stages"] = {k: round(v, 3) if isinstance(v, float) else v
+                         for k, v in prover.last_stats.as_dict().items()}
     if a.out:
         Path(a.out).write_text(json.dumps(proof.to_dict()))
     print(json.dumps(out))
@@ -203,6 +209,20 @@ def main(argv=None) -> int:
     pp.add_argument("--k2", type=int, default=37)
     pp.add_argument("--k3", type=int, default=37)
     pp.add_argument("--batch", type=int, default=1 << 14)
+    pp.add_argument("--serial", action="store_true",
+                    help="use the legacy synchronous scan instead of the "
+                    "streaming pipeline (docs/POST_PROVING.md)")
+    pp.add_argument("--window-groups", type=int, default=None,
+                    help="nonce groups scanned per disk pass (default: "
+                    "SPACEMESH_PROVE_WINDOW_GROUPS, or 4 on TPU / 1 on CPU)")
+    pp.add_argument("--inflight", type=int, default=None,
+                    help="device batches in flight (default: "
+                    "SPACEMESH_PROVE_INFLIGHT or 3)")
+    pp.add_argument("--readers", type=int, default=None,
+                    help="background label-reader threads (default: "
+                    "SPACEMESH_PROVE_READERS or 2)")
+    pp.add_argument("--stage-timings", action="store_true",
+                    help="include per-stage prove pipeline timings")
     pp.add_argument("--out", help="write proof JSON here as well")
     pp.set_defaults(fn=cmd_prove)
 

@@ -19,6 +19,7 @@ import time
 from pathlib import Path
 
 from ..ops.scrypt import LABEL_BYTES
+from ..utils import metrics
 
 METADATA_FILE = "postdata_metadata.json"
 
@@ -63,9 +64,32 @@ class LabelStore:
         self.dir = Path(data_dir)
         self.meta = meta
         self.dir.mkdir(parents=True, exist_ok=True)
+        self._fd_lock = threading.Lock()
+        self._read_fds: dict[int, int] = {}
 
     def _file(self, i: int) -> Path:
         return self.dir / f"postdata_{i}.bin"
+
+    def _read_fd(self, i: int) -> int:
+        """Cached O_RDONLY fd for file ``i`` — the prover issues thousands
+        of positioned reads per pass and an open() per call is pure syscall
+        overhead (and defeats readahead heuristics on some filesystems)."""
+        with self._fd_lock:
+            fd = self._read_fds.get(i)
+            if fd is None:
+                fd = os.open(self._file(i), os.O_RDONLY)
+                self._read_fds[i] = fd
+            return fd
+
+    def close(self) -> None:
+        """Drop cached read fds (safe to call repeatedly; reads reopen)."""
+        with self._fd_lock:
+            fds, self._read_fds = self._read_fds, {}
+        for fd in fds.values():
+            try:
+                os.close(fd)
+            except OSError:
+                pass
 
     def write_labels(self, start_index: int, labels: bytes) -> None:
         """Write ``labels`` (concatenated 16B records) at ``start_index``.
@@ -93,6 +117,11 @@ class LabelStore:
         """A background writer pool bound to this store."""
         return LabelWriter(self, threads=threads, queue_depth=queue_depth)
 
+    def start_reader(self, ranges, threads: int = 2,
+                     depth: int = 4) -> "LabelReader":
+        """A background prefetching reader pool bound to this store."""
+        return LabelReader(self, ranges, threads=threads, depth=depth)
+
     def read_labels(self, start_index: int, count: int) -> bytes:
         lpf = self.meta.labels_per_file
         out = bytearray()
@@ -101,15 +130,16 @@ class LabelStore:
         while remaining > 0:
             fi, within = divmod(idx, lpf)
             take = min(remaining, lpf - within)
-            with open(self._file(fi), "rb") as f:
-                f.seek(within * LABEL_BYTES)
-                chunk = f.read(take * LABEL_BYTES)
+            chunk = os.pread(self._read_fd(fi), take * LABEL_BYTES,
+                             within * LABEL_BYTES)
             if len(chunk) != take * LABEL_BYTES:
                 raise IOError(
                     f"short read at label {idx}: file {fi} truncated")
             out += chunk
             idx += take
             remaining -= take
+        metrics.post_store_read_calls.inc()
+        metrics.post_store_read_bytes.inc(count * LABEL_BYTES)
         return bytes(out)
 
 
@@ -227,3 +257,96 @@ class LabelWriter:
                     self._durable = self._done.pop(self._durable)
                 self._inflight -= 1
                 self._idle.notify_all()
+
+
+class LabelReader:
+    """Bounded read-ahead pool over one LabelStore — the prover-side mirror
+    of LabelWriter.
+
+    The streaming prover hands the whole pass plan (an ordered list of
+    ``(start_index, count)`` ranges) here; pool threads read ahead while the
+    device scans, and ``get()`` yields each range's bytes *in plan order*.
+    At most ``depth`` ranges are buffered or being read at once, so a stalled
+    consumer (device backpressure) caps reader memory at
+    ``depth * batch * LABEL_BYTES`` instead of the whole store.
+    """
+
+    def __init__(self, store: LabelStore, ranges, threads: int = 2,
+                 depth: int = 4):
+        self.store = store
+        self.ranges: list[tuple[int, int]] = list(ranges)
+        self._cond = threading.Condition()
+        self._results: dict[int, bytes] = {}
+        self._claim = 0          # next plan slot a worker may take
+        self._consume = 0        # next plan slot get() returns
+        self._budget = max(depth, 1)  # free read-ahead slots
+        self._error: BaseException | None = None
+        self._closed = False
+        self.read_seconds = 0.0
+        self.bytes_read = 0
+        self._threads = [
+            threading.Thread(target=self._worker, daemon=True,
+                             name=f"label-reader-{i}")
+            for i in range(max(threads, 1))]
+        for t in self._threads:
+            t.start()
+
+    def get(self) -> bytes:
+        """Bytes of the next range in plan order; blocks until prefetched.
+
+        In-order results buffered before a background failure are still
+        delivered; the error surfaces on the first range that is actually
+        missing (so an error past an early-exit point cannot abort a prove
+        that never needed those bytes)."""
+        with self._cond:
+            while (self._consume not in self._results
+                   and self._error is None):
+                if self._consume >= len(self.ranges):
+                    raise IndexError("read plan exhausted")
+                self._cond.wait(timeout=0.1)
+            if self._consume in self._results:
+                data = self._results.pop(self._consume)
+                self._consume += 1
+                self._budget += 1
+                self._cond.notify_all()
+                return data
+            raise RuntimeError("background label reader failed") \
+                from self._error
+
+    def close(self) -> None:
+        """Stop the pool; safe mid-plan (early exit drops pending reads)."""
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+        for t in self._threads:
+            t.join(timeout=10)
+
+    def _worker(self) -> None:
+        while True:
+            with self._cond:
+                while (not self._closed and self._error is None
+                       and (self._budget <= 0
+                            or self._claim >= len(self.ranges))):
+                    if self._claim >= len(self.ranges):
+                        return  # plan fully claimed
+                    self._cond.wait(timeout=0.1)
+                if self._closed or self._error is not None:
+                    return
+                slot = self._claim
+                self._claim += 1
+                self._budget -= 1
+            start, count = self.ranges[slot]
+            t0 = time.perf_counter()
+            try:
+                data = self.store.read_labels(start, count)
+            except BaseException as e:  # noqa: BLE001 — surfaced via get()
+                with self._cond:
+                    if self._error is None:
+                        self._error = e
+                    self._cond.notify_all()
+                return
+            with self._cond:
+                self.read_seconds += time.perf_counter() - t0
+                self.bytes_read += len(data)
+                self._results[slot] = data
+                self._cond.notify_all()

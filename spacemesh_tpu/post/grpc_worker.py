@@ -25,16 +25,31 @@ import grpc
 
 from ..api.gen import post_pb2 as ppb
 from ..api.rpc import POST_REGISTER, pack_indices
+from ..utils import metrics
 from .service import PostClient, PostService
 
 
 class _ProofJob:
     """One in-flight proving task per identity (the reference service
-    rejects a second concurrent challenge per identity the same way)."""
+    rejects a second concurrent challenge per identity the same way).
+
+    Tracks the session in ``post_prove_inflight`` so an operator can see
+    how many identities are mid-prove on this worker (the node re-asks
+    every queryInterval while a proof brews; post_client.go:107)."""
 
     def __init__(self, challenge: bytes, task: asyncio.Task):
         self.challenge = challenge
         self.task = task
+        metrics.post_prove_inflight.set(_ProofJob.live + 1)
+        _ProofJob.live += 1
+        task.add_done_callback(self._done)
+
+    live = 0
+
+    @staticmethod
+    def _done(_task) -> None:
+        _ProofJob.live = max(_ProofJob.live - 1, 0)
+        metrics.post_prove_inflight.set(_ProofJob.live)
 
 
 class RegisterSession:

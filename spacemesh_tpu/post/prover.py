@@ -1,11 +1,32 @@
-"""POST proving: k2pow gate + nonce search over the stored labels.
+"""POST proving: k2pow gate + streaming nonce search over the stored labels.
 
 The post-service equivalent (reference's external Rust prover, spawned by
 activation/post_supervisor.go:220-298 with --nonces/--threads flags; proof
-shape reference common/types/poet.go `Post{Nonce, Indices, Pow}`). Here the
-label stream is read back from disk in batches and swept through
-``proving_scan_jit`` — a (n_nonces x batch) qualification mask per program —
-so a whole nonce group rides one device dispatch per label batch.
+shape reference common/types/poet.go `Post{Nonce, Indices, Pow}`).
+
+The default path is a streaming pipeline (docs/POST_PROVING.md) mirroring
+the init side's (post/initializer.py):
+
+  read      — a bounded background reader pool (post/data.py LabelReader)
+              prefetches label batches while the device scans;
+  dispatch  — up to K batches in flight, each one compiled program
+              (``prove_scan_step_jit`` / ``prove_scan_step_pallas``) that
+              scans a nonce group, compacts hits on device and merges them
+              into a *donated* running hit state — ragged tails are padded
+              to the full batch shape so one shape compiles per pass;
+  retire    — the only per-batch D2H is a (nonce_group,) count vector; the
+              packed (nonce, index) hit pairs are fetched once per pass.
+
+One disk pass covers a whole nonce *window* (``window_groups`` groups per
+read — on TPU disk bytes are the scarce resource and device FLOPs nearly
+free, so the default widens there), and a pass stops early as soon as the
+winning nonce is decided: the lowest nonce with >= k2 hits, once every
+lower nonce provably cannot reach k2 with the labels left in the pass.
+That rule makes the pipelined proof bit-identical to the legacy serial
+scan's (kept as ``prove_serial`` — the bench baseline and fallback).
+
+On multi-device the label lanes are sharded over the mesh per batch
+(parallel/mesh.py prove_step_sharded), the way init shards its batches.
 
 A proof for challenge ``ch`` is:
     nonce     — the winning proving nonce
@@ -16,14 +37,33 @@ A proof for challenge ``ch`` is:
 from __future__ import annotations
 
 import dataclasses
+import functools
+import os
+import time
+from collections import deque
 from pathlib import Path
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
 from ..ops import pow as k2pow
-from ..ops import proving, scrypt
+from ..ops import proving, proving_pallas, scrypt
+from ..utils import metrics
 from .data import LabelStore, PostMetadata
+
+DEFAULT_NONCE_GROUP = 16
+DEFAULT_INFLIGHT = 3      # device batches in flight before the oldest retires
+DEFAULT_READERS = 2       # background reader threads
+DEFAULT_READER_QUEUE = 4  # prefetched batches before reader backpressure
+MAX_GROUPS = 1025         # nonce search gives up past this many groups
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default
 
 
 @dataclasses.dataclass
@@ -52,35 +92,140 @@ class ProofParams:
     pow_difficulty: bytes = bytes([0, 255]) + bytes([255]) * 30
 
 
+@dataclasses.dataclass
+class ProverStats:
+    """Per-prove pipeline accounting (tools/profiler.py --prove)."""
+
+    windows: int = 0          # nonce windows swept
+    batches: int = 0          # label batches dispatched
+    labels_swept: int = 0     # labels covered across all passes
+    read_wait_s: float = 0.0  # blocked on the reader pool
+    read_io_s: float = 0.0    # filesystem time inside the reader pool
+    dispatch_s: float = 0.0   # host time converting + enqueueing batches
+    retire_s: float = 0.0     # blocked fetching per-batch count vectors
+    d2h_bytes: int = 0        # compacted device->host traffic
+    early_exited: bool = False
+    elapsed_s: float = 0.0
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
 class Prover:
     def __init__(self, data_dir: str | Path, params: ProofParams | None = None,
-                 batch_labels: int = 1 << 14, nonce_group: int = 16,
-                 use_pallas: bool | None = None):
+                 batch_labels: int = 1 << 14,
+                 nonce_group: int = DEFAULT_NONCE_GROUP,
+                 use_pallas: bool | None = None,
+                 pipelined: bool | None = None,
+                 window_groups: int | None = None,
+                 inflight: int | None = None,
+                 readers: int | None = None,
+                 reader_queue: int | None = None,
+                 mesh="auto"):
         self.meta = PostMetadata.load(data_dir)
         if self.meta.labels_written < self.meta.total_labels:
             raise ValueError("POST data is not fully initialized")
         self.store = LabelStore(data_dir, self.meta)
         self.params = params or ProofParams()
-        self.batch_labels = batch_labels
         self.nonce_group = nonce_group
+        self._platform = jax.devices()[0].platform
         if use_pallas is None:  # the Mosaic kernel path is TPU-only
-            import jax
-
-            use_pallas = jax.devices()[0].platform == "tpu"
+            use_pallas = self._platform == "tpu"
         self.use_pallas = use_pallas
+        # pipelined batches share one compiled shape: round the batch up to
+        # the compaction segment (and the Pallas lane tile on that path)
+        tile = proving_pallas.LANE_TILE if use_pallas else proving.HIT_SEGMENT
+        self.batch_labels = -(-max(batch_labels, tile) // tile) * tile
+        if pipelined is None:
+            pipelined = os.environ.get(
+                "SPACEMESH_PROVE_PIPELINE", "1") not in ("0", "off")
+        self.pipelined = pipelined
+        self.window_groups = max(window_groups if window_groups is not None
+                                 else _env_int("SPACEMESH_PROVE_WINDOW_GROUPS",
+                                               4 if self._platform == "tpu"
+                                               else 1), 1)
+        self.inflight = max(inflight if inflight is not None
+                            else _env_int("SPACEMESH_PROVE_INFLIGHT",
+                                          DEFAULT_INFLIGHT), 1)
+        self.readers = max(readers if readers is not None
+                           else _env_int("SPACEMESH_PROVE_READERS",
+                                         DEFAULT_READERS), 1)
+        self.reader_queue = max(reader_queue if reader_queue is not None
+                                else _env_int("SPACEMESH_PROVE_QUEUE",
+                                              DEFAULT_READER_QUEUE), 1)
+        self._mesh_arg = mesh
+        self.last_stats: ProverStats | None = None
+
+    # -- mesh routing (mirrors post/initializer.py) -------------------------
+
+    def _resolve_mesh(self):
+        if self._mesh_arg is None:
+            return None
+        if self._mesh_arg != "auto":
+            mesh = self._mesh_arg
+            if mesh.size > 1 and self.batch_labels % mesh.size:
+                # an explicitly requested mesh must not silently degrade
+                # to a single device
+                raise ValueError(
+                    f"batch_labels {self.batch_labels} not divisible by "
+                    f"the {mesh.size}-device mesh; pick a multiple")
+        else:
+            env = os.environ.get("SPACEMESH_MESH", "")
+            if env in ("0", "off") or jax.device_count() <= 1:
+                return None
+            if jax.default_backend() == "cpu" and env not in ("1", "on"):
+                return None  # virtual host devices: SPMD compile, no gain
+            from ..parallel import mesh as pmesh
+            mesh = pmesh.data_mesh()
+        if mesh.size <= 1 or self.batch_labels % mesh.size:
+            return None
+        return mesh
+
+    # -- entry points -------------------------------------------------------
 
     def prove(self, challenge: bytes) -> Proof:
-        meta, p = self.meta, self.params
-        node_id = bytes.fromhex(meta.node_id)
-        pow_nonce = k2pow.search(challenge, node_id, p.pow_difficulty)
+        pow_nonce = self._pow(challenge)
+        try:
+            if self.pipelined:
+                return self._prove_pipelined(challenge, pow_nonce)
+            return self._prove_serial(challenge, pow_nonce)
+        finally:
+            # drop the store's cached read fds: PostClient builds a fresh
+            # Prover per challenge, so a long-lived worker would otherwise
+            # leak one fd per postdata file per proving session
+            self.store.close()
+
+    def prove_serial(self, challenge: bytes) -> Proof:
+        """The legacy synchronous scan (read -> scan -> full-mask fetch ->
+        host nonzero per group) — kept as the bench baseline and fallback."""
+        try:
+            return self._prove_serial(challenge, self._pow(challenge))
+        finally:
+            self.store.close()
+
+    def _pow(self, challenge: bytes) -> int:
+        node_id = bytes.fromhex(self.meta.node_id)
+        pow_nonce = k2pow.search(challenge, node_id,
+                                 self.params.pow_difficulty)
         if pow_nonce is None:
             raise RuntimeError("k2pow search exhausted")
+        return pow_nonce
 
+    # -- legacy serial path -------------------------------------------------
+
+    def _prove_serial(self, challenge: bytes, pow_nonce: int) -> Proof:
+        meta, p = self.meta, self.params
         t = proving.threshold_u32(p.k1, meta.total_labels)
         cw = jnp.asarray(proving.challenge_words(challenge))
+        ng = self.nonce_group
+        # Pallas-vs-XLA decided ONCE per prove; ragged tail batches are
+        # padded-and-trimmed inside proving_pallas.proving_scan instead of
+        # flipping to the XLA path mid-pass (one compiled shape per path)
+        use_pallas = self.use_pallas
+        interpret = self._platform != "tpu"
         group = 0
         while True:
-            hits: list[list[int]] = [[] for _ in range(self.nonce_group)]
+            hits: list[list[int]] = [[] for _ in range(ng)]
             start = 0
             while start < meta.total_labels:
                 count = min(self.batch_labels, meta.total_labels - start)
@@ -88,32 +233,187 @@ class Prover:
                 labels = np.frombuffer(
                     self.store.read_labels(start, count), dtype=np.uint8
                 ).reshape(count, scrypt.LABEL_BYTES)
-                lo, hi = scrypt.split_indices(idx)
-                lw = scrypt.labels_to_words(labels)
-                nonce0 = group * self.nonce_group
-                from ..ops import proving_pallas
-
-                if self.use_pallas and count % proving_pallas.LANE_TILE == 0:
-
-                    mask = np.asarray(proving_pallas.proving_scan_pallas(
-                        cw, jnp.uint32(nonce0), jnp.asarray(lo),
-                        jnp.asarray(hi), jnp.asarray(lw), jnp.uint32(t),
-                        n_nonces=self.nonce_group)).astype(bool)
+                nonce0 = group * ng
+                if use_pallas:
+                    mask = proving_pallas.proving_scan(
+                        challenge, nonce0, idx, labels, t, n_nonces=ng,
+                        interpret=interpret)
                 else:
+                    lo, hi = scrypt.split_indices(idx)
+                    lw = scrypt.labels_to_words(labels)
                     mask = np.asarray(proving.proving_scan_jit(
                         cw, jnp.uint32(nonce0),
                         jnp.asarray(lo), jnp.asarray(hi), jnp.asarray(lw),
-                        jnp.uint32(t), n_nonces=self.nonce_group))
-                for k in range(self.nonce_group):
+                        jnp.uint32(t), n_nonces=ng))
+                for k in range(ng):
                     if len(hits[k]) < p.k2:
                         found = np.nonzero(mask[k])[0]
                         hits[k].extend((start + found).tolist())
                 start += count
-            for k in range(self.nonce_group):
+            for k in range(ng):
                 if len(hits[k]) >= p.k2:
-                    return Proof(nonce=group * self.nonce_group + k,
+                    metrics.proofs_generated.inc()
+                    return Proof(nonce=group * ng + k,
                                  indices=[int(i) for i in hits[k][:p.k2]],
                                  pow_nonce=pow_nonce, k2=p.k2)
             group += 1
-            if group > 1024:
+            if group > MAX_GROUPS - 1:
                 raise RuntimeError("no winning nonce found (k1/k2 mismatch?)")
+
+    # -- streaming pipeline -------------------------------------------------
+
+    def _prove_pipelined(self, challenge: bytes, pow_nonce: int) -> Proof:
+        meta, p = self.meta, self.params
+        t0 = time.monotonic()
+        thr = jnp.uint32(proving.threshold_u32(p.k1, meta.total_labels))
+        cw = jnp.asarray(proving.challenge_words(challenge))
+        mesh = self._resolve_mesh()
+        step = self._make_step(mesh)
+        stats = ProverStats()
+        self.last_stats = stats
+        window = self.nonce_group * self.window_groups
+        winner = None
+        max_nonce = MAX_GROUPS * self.nonce_group
+        for base in range(0, max_nonce, window):
+            # clamp the last window to the serial prover's give-up bound so
+            # the two paths search the exact same nonce range
+            groups = min(self.window_groups,
+                         (max_nonce - base) // self.nonce_group)
+            winner, indices = self._scan_window(cw, thr, base, groups, step,
+                                                mesh, stats)
+            if winner is not None:
+                break
+        stats.elapsed_s = time.monotonic() - t0
+        if stats.elapsed_s > 0:
+            metrics.post_prove_labels_per_sec.set(
+                stats.labels_swept / stats.elapsed_s)
+        for stage, secs in (("read", stats.read_wait_s),
+                            ("dispatch", stats.dispatch_s),
+                            ("retire", stats.retire_s)):
+            metrics.post_prove_stage_seconds.inc(secs, stage=stage)
+        if winner is None:
+            raise RuntimeError("no winning nonce found (k1/k2 mismatch?)")
+        metrics.proofs_generated.inc()
+        return Proof(nonce=winner, indices=indices, pow_nonce=pow_nonce,
+                     k2=p.k2)
+
+    def _make_step(self, mesh):
+        """Bind the scan-step backend ONCE per prove (no per-batch paths)."""
+        ng, cap = self.nonce_group, max(self.params.k2, 1)
+        if mesh is not None:
+            from ..parallel import mesh as pmesh
+            return functools.partial(pmesh.prove_step_sharded, mesh,
+                                     n_nonces=ng, max_hits=cap)
+        if self.use_pallas:
+            return functools.partial(
+                proving_pallas.prove_scan_step_pallas, n_nonces=ng,
+                max_hits=cap, interpret=self._platform != "tpu")
+        return functools.partial(proving.prove_scan_step_jit,
+                                 n_nonces=ng, max_hits=cap)
+
+    def _scan_window(self, cw, thr, nonce_base, groups, step, mesh, stats):
+        """One disk pass over the store scanning ``groups`` nonce groups.
+        Returns (winner_nonce, indices) or (None, None)."""
+        meta, p = self.meta, self.params
+        total = meta.total_labels
+        b = self.batch_labels
+        ng = self.nonce_group
+        cap = max(p.k2, 1)
+        ranges = [(s, min(b, total - s)) for s in range(0, total, b)]
+        states = []
+        for _ in range(groups):
+            counts, carry = proving.init_hit_state(ng, cap)
+            if mesh is not None:
+                from ..parallel import mesh as pmesh
+                counts = pmesh.replicate(mesh, counts)
+                carry = pmesh.replicate(mesh, carry)
+            states.append([counts, carry])
+        host_counts = np.zeros(ng * groups, dtype=np.int64)
+        inflight: deque = deque()  # (scanned_end, [per-group batch counts])
+        reader = self.store.start_reader(ranges, self.readers,
+                                         self.reader_queue)
+        metrics.post_prove_windows.inc()
+        stats.windows += 1
+        exited = False
+        retired_end = 0
+        try:
+            for start, count in ranges:
+                tr = time.perf_counter()
+                raw = reader.get()
+                td = time.perf_counter()
+                stats.read_wait_s += td - tr
+                labels = np.frombuffer(raw, dtype=np.uint8).reshape(
+                    count, scrypt.LABEL_BYTES)
+                if count < b:  # pad-and-trim: one compiled shape per pass
+                    labels = np.concatenate([
+                        labels,
+                        np.zeros((b - count, scrypt.LABEL_BYTES), np.uint8)])
+                idx = np.arange(start, start + b, dtype=np.uint64)
+                lo, hi = scrypt.split_indices(idx)
+                lw = scrypt.labels_to_words(labels)
+                jlo, jhi, jlw = (jnp.asarray(lo), jnp.asarray(hi),
+                                 jnp.asarray(lw))
+                bcs = []
+                for g in range(groups):
+                    counts, carry = states[g]
+                    counts, bc, carry = step(
+                        cw, jnp.uint32(nonce_base + g * ng), jlo, jhi, jlw,
+                        thr, counts, carry, jnp.uint32(count),
+                        jnp.uint32(start & 0xFFFFFFFF),
+                        jnp.uint32(start >> 32))
+                    states[g] = [counts, carry]
+                    bcs.append(bc)
+                stats.dispatch_s += time.perf_counter() - td
+                stats.batches += 1
+                metrics.post_prove_batches.inc()
+                inflight.append((start + count, bcs))
+                if len(inflight) >= self.inflight:
+                    item = inflight.popleft()
+                    retired_end = item[0]
+                    exited = self._retire(item, host_counts, total, stats)
+                    if exited:
+                        break
+            while not exited and inflight:
+                item = inflight.popleft()
+                retired_end = item[0]
+                exited = self._retire(item, host_counts, total, stats)
+            scanned = retired_end if exited else total
+        finally:
+            reader.close()
+            stats.read_io_s += reader.read_seconds
+        if exited:
+            metrics.post_prove_early_exits.inc()
+            stats.early_exited = True
+        stats.labels_swept += scanned
+        qualified = np.nonzero(host_counts >= p.k2)[0]
+        if qualified.size == 0:
+            return None, None
+        w = int(qualified[0])
+        counts, carry = states[w // ng]
+        indices = proving.decode_hits(counts, carry, w % ng, p.k2)
+        stats.d2h_bytes += carry.nbytes + counts.nbytes
+        metrics.post_prove_d2h_bytes.inc(carry.nbytes + counts.nbytes)
+        return nonce_base + w, indices
+
+    def _retire(self, item, host_counts, total, stats) -> bool:
+        """Fetch one batch's per-nonce count vectors; True on sound early
+        exit: some nonce has k2 hits and every lower nonce in the window
+        provably cannot reach k2 with the labels left in this pass (lower
+        windows already failed their full pass, so the winner is final and
+        identical to the serial prover's end-of-pass pick)."""
+        scanned_end, bcs = item
+        p = self.params
+        ng = self.nonce_group
+        tr = time.perf_counter()
+        for g, bc in enumerate(bcs):
+            vec = np.asarray(bc)
+            host_counts[g * ng:(g + 1) * ng] += vec
+            stats.d2h_bytes += vec.nbytes
+            metrics.post_prove_d2h_bytes.inc(vec.nbytes)
+        stats.retire_s += time.perf_counter() - tr
+        qualified = host_counts >= p.k2
+        if not qualified.any():
+            return False
+        w = int(np.argmax(qualified))
+        remaining = total - scanned_end
+        return bool(np.all(host_counts[:w] + remaining < p.k2))

@@ -170,10 +170,11 @@ class RemotePostClient:
         return [bytes.fromhex(x) for x in d["node_ids"]]
 
 
-def discover_identities(base_dir: str | Path,
-                        params=None) -> PostService:
+def discover_identities(base_dir: str | Path, params=None,
+                        **prove_opts) -> PostService:
     """Build a PostService from a directory of per-identity POST data dirs
-    (what the worker CLI serves)."""
+    (what the worker CLI serves). ``prove_opts`` are the streaming-prover
+    pipeline knobs, passed through to every identity's PostClient."""
     from .service import PostClient
 
     service = PostService()
@@ -184,5 +185,5 @@ def discover_identities(base_dir: str | Path,
         if (p / "postdata_metadata.json").exists():
             meta = PostMetadata.load(p)
             service.register(bytes.fromhex(meta.node_id),
-                             PostClient(p, params))
+                             PostClient(p, params, **prove_opts))
     return service

@@ -35,13 +35,20 @@ class PostInfo:
 
 class PostClient:
     """What the node sees for one registered identity (reference
-    api/grpcserver/post_client.go:69 `Proof()` / `Info()`)."""
+    api/grpcserver/post_client.go:69 `Proof()` / `Info()`).
+
+    ``prove_opts`` pass through to the Prover — the streaming-pipeline
+    knobs (pipelined, window_groups, inflight, readers, reader_queue,
+    use_pallas, mesh; post/prover.py). Unset knobs fall back to the
+    ``SPACEMESH_PROVE_*`` env overrides, then the platform defaults.
+    """
 
     def __init__(self, data_dir: str | Path, params: ProofParams | None = None,
-                 batch_labels: int = 1 << 14):
+                 batch_labels: int = 1 << 14, **prove_opts):
         self.data_dir = Path(data_dir)
         self.params = params or ProofParams()
         self._batch = batch_labels
+        self._prove_opts = prove_opts
         self._lock = threading.Lock()
 
     def info(self) -> PostInfo:
@@ -59,7 +66,7 @@ class PostClient:
     def proof(self, challenge: bytes) -> tuple[Proof, PostMetadata]:
         with self._lock:  # one proving session per identity at a time
             prover = Prover(self.data_dir, self.params,
-                            batch_labels=self._batch)
+                            batch_labels=self._batch, **self._prove_opts)
             return prover.prove(challenge), prover.meta
 
 

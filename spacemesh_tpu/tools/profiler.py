@@ -18,6 +18,7 @@ Usage:
   python -m spacemesh_tpu.tools.profiler --providers
   python -m spacemesh_tpu.tools.profiler --n 8192 --batches 1024,2048
   python -m spacemesh_tpu.tools.profiler --pipeline --n 8192   # per-stage
+  python -m spacemesh_tpu.tools.profiler --prove               # prove view
   python -m spacemesh_tpu.tools.profiler --verify-farm         # farm view
 Prints ONE JSON document on stdout; progress goes to stderr. --pipeline
 runs a real (tiny) init through the streaming pipeline and dumps per-stage
@@ -192,6 +193,44 @@ def pipeline_benchmark(n: int, labels: int, batch: int,
     return doc
 
 
+def prove_benchmark(labels: int, batch: int,
+                    window_groups: int | None = None,
+                    inflight: int | None = None,
+                    probe: bool = True) -> dict:
+    """Per-stage timings of the streaming prove pipeline (read/dispatch/
+    retire) against the legacy serial scan over the same tiny store, so an
+    operator can see where prove time goes — and whether the sound early
+    exit fired — before pointing the prover at a multi-TiB label store
+    (docs/POST_PROVING.md). The deterministic fixture is shared with
+    bench.py (spacemesh_tpu/post/workload.py)."""
+    import tempfile
+
+    from ..post import workload
+    from ..utils import accel
+
+    if probe and not accel.ensure_usable_platform():
+        _log("accelerator unreachable; JAX restricted to CPU")
+    with tempfile.TemporaryDirectory() as d:
+        prover = workload.build(d, labels, batch,
+                                window_groups=window_groups,
+                                inflight=inflight)
+        res = workload.compare_serial_vs_pipelined(prover, reps=1)
+    stats = res["stats"]
+    doc = {
+        "labels": labels, "batch": batch,
+        "proof_nonce": res["proof"].nonce,
+        "serial_s": round(res["serial_s"], 4),
+        "pipelined_s": round(res["pipelined_s"], 4),
+        "speedup": round(res["speedup"], 2) if res["speedup"] else None,
+        "stages": {k: (round(v, 4) if isinstance(v, float) else v)
+                   for k, v in stats.items()},
+    }
+    busiest = max(("read_wait_s", "dispatch_s", "retire_s"),
+                  key=lambda k: stats.get(k, 0.0))
+    doc["bottleneck"] = busiest
+    return doc
+
+
 def verify_benchmark(counts: list[int], reps: int = 2,
                      probe: bool = True) -> dict:
     """Proof-verification throughput (BASELINE config 3: batch of NIPoST
@@ -296,9 +335,17 @@ def main(argv=None) -> int:
                     help="labels for the --pipeline run")
     ap.add_argument("--pipeline-batch", type=int, default=1024)
     ap.add_argument("--inflight", type=int, default=None,
-                    help="in-flight device batches for --pipeline")
+                    help="in-flight device batches for --pipeline/--prove")
     ap.add_argument("--writers", type=int, default=None,
                     help="writer threads for --pipeline")
+    ap.add_argument("--prove", action="store_true",
+                    help="profile the streaming prove pipeline per stage "
+                    "(read/dispatch/retire) vs the legacy serial scan")
+    ap.add_argument("--prove-labels", type=int, default=16384,
+                    help="store size for the --prove run")
+    ap.add_argument("--prove-batch", type=int, default=2048)
+    ap.add_argument("--window-groups", type=int, default=None,
+                    help="nonce groups per disk pass for --prove")
     ap.add_argument("--n", type=int, default=8192, help="scrypt N")
     ap.add_argument("--batches", default="1024,2048,4096",
                     help="comma-separated label lanes per program")
@@ -323,6 +370,13 @@ def main(argv=None) -> int:
         doc = pipeline_benchmark(
             a.n, a.pipeline_labels, a.pipeline_batch,
             inflight=a.inflight, writers=a.writers, probe=not a.no_probe)
+        print(json.dumps(doc, indent=2))
+        return 0
+    if a.prove:
+        doc = prove_benchmark(
+            a.prove_labels, a.prove_batch,
+            window_groups=a.window_groups, inflight=a.inflight,
+            probe=not a.no_probe)
         print(json.dumps(doc, indent=2))
         return 0
     if a.verify:

@@ -163,6 +163,36 @@ post_pipeline_meta_saves = REGISTRY.counter(
 post_pipeline_labels_per_sec = REGISTRY.gauge(
     "post_pipeline_labels_per_sec", "labels/s of the last init session")
 
+# POST label-store reads (post/data.py LabelStore.read_labels — the serial
+# prover and the prefetching LabelReader pool both land here). The prove
+# pipeline's disk-frugality contract ("at most one pass over the store per
+# scanned nonce window") is asserted against these counters in tests.
+post_store_read_calls = REGISTRY.counter(
+    "post_store_read_calls_total", "label-store read_labels invocations")
+post_store_read_bytes = REGISTRY.counter(
+    "post_store_read_bytes_total", "label bytes read back from disk")
+
+# POST proving streaming pipeline (post/prover.py). Stage seconds carry a
+# stage label (read/dispatch/retire) mirroring the init pipeline's.
+post_prove_windows = REGISTRY.counter(
+    "post_prove_windows_total", "nonce windows swept over the label store")
+post_prove_batches = REGISTRY.counter(
+    "post_prove_batches_total", "label batches dispatched by the prover")
+post_prove_early_exits = REGISTRY.counter(
+    "post_prove_early_exits_total",
+    "prove passes cut short once the winning nonce was decided")
+post_prove_stage_seconds = REGISTRY.counter(
+    "post_prove_stage_seconds_total",
+    "host seconds per prove pipeline stage (label=stage)")
+post_prove_d2h_bytes = REGISTRY.counter(
+    "post_prove_d2h_bytes_total",
+    "bytes copied device->host by the prover (compacted hits, not masks)")
+post_prove_labels_per_sec = REGISTRY.gauge(
+    "post_prove_labels_per_sec",
+    "store labels covered per second by the last prove call")
+post_prove_inflight = REGISTRY.gauge(
+    "post_prove_inflight", "proving sessions currently running (grpc worker)")
+
 # verification farm (verify/farm.py): the micro-batching admission
 # service for signatures / VRFs / POST proofs / poet membership.
 verify_farm_requests = REGISTRY.counter(

@@ -97,8 +97,16 @@ def test_mismatched_params_rejected(unit):
 
 @pytest.fixture(scope="module")
 def proof(unit):
+    # the default prove path — the streaming pipelined scan
     d, meta, _ = unit
     return Prover(d, PARAMS, batch_labels=512).prove(CH)
+
+
+@pytest.fixture(scope="module")
+def serial_proof(unit):
+    # the legacy synchronous scan kept as baseline/fallback
+    d, meta, _ = unit
+    return Prover(d, PARAMS, batch_labels=512).prove_serial(CH)
 
 
 def _item(meta: PostMetadata, pr: Proof) -> verifier.VerifyItem:
@@ -112,6 +120,46 @@ def test_prove_verify_roundtrip(unit, proof):
     assert len(proof.indices) == PARAMS.k2
     assert proof.indices == sorted(proof.indices)
     assert verifier.verify(_item(meta, proof), PARAMS)
+
+
+def test_serial_roundtrip_and_identity(unit, proof, serial_proof):
+    # the legacy path verifies too, and the pipelined prover's proof is
+    # bit-identical to it (nonce, indices, pow_nonce) for a fixed challenge
+    _, meta, _ = unit
+    assert verifier.verify(_item(meta, serial_proof), PARAMS)
+    assert serial_proof == proof
+
+
+@pytest.mark.parametrize("serial", [False, True],
+                         ids=["pipelined", "serial"])
+def test_wrong_nonce_rejected_both_paths(unit, proof, serial_proof, serial):
+    _, meta, _ = unit
+    pr = serial_proof if serial else proof
+    bad = dataclasses.replace(pr, nonce=pr.nonce + 1)
+    assert not verifier.verify(
+        dataclasses.replace(_item(meta, pr), proof=bad), PARAMS)
+
+
+@pytest.mark.parametrize("serial", [False, True],
+                         ids=["pipelined", "serial"])
+def test_corrupted_labels_rejected_both_paths(unit, tmp_path, serial):
+    # a store whose labels were corrupted on disk yields proofs the
+    # verifier's recompute rejects — through either prove path
+    import shutil
+
+    d, meta, _ = unit
+    bad_dir = tmp_path / "corrupt"
+    shutil.copytree(d, bad_dir)
+    for f in sorted(bad_dir.glob("postdata_*.bin")):
+        raw = bytearray(f.read_bytes())
+        raw[::16] = bytes((b ^ 0x5A) for b in raw[::16])  # hit every label
+        f.write_bytes(raw)
+    prover = Prover(bad_dir, PARAMS, batch_labels=512, pipelined=not serial)
+    pr = prover.prove_serial(CH) if serial else prover.prove(CH)
+    assert not verifier.verify(
+        verifier.VerifyItem(proof=pr, challenge=CH, node_id=NODE,
+                            commitment=COMMIT, scrypt_n=meta.scrypt_n,
+                            total_labels=meta.total_labels), PARAMS)
 
 
 def test_tampered_proofs_rejected(unit, proof):
