@@ -1,0 +1,367 @@
+"""ROMix kernel autotuner: race the candidates once, persist the winner.
+
+Which label-kernel variant is fastest is a per-host question (SURVEY.md
+§7; the ASIC-crypto playbook of arxiv 2604.17808 / 2505.14657): the XLA
+gather path with a VMEM/LLC-sized lane chunk wins where the working set
+must be kept hot, the contiguous-row variant wins where the gather's
+read amplification dominates, and the Pallas DMA kernel is only worth
+compiling on a real TPU.  Rather than hardcode that table, first use
+races the candidates on a tiny calibration workload and persists the
+winner per ``(platform, N, batch)`` next to the persistent XLA compile
+cache (utils/accel.py), so every entry point — post/initializer.py,
+post/prover.py's scan, parallel/mesh.py, bench.py, tools/profiler.py —
+picks up the tuned kernel with zero configuration, and a second process
+on the same host skips the race entirely.
+
+Decision precedence (highest first):
+
+1. env overrides — ``SPACEMESH_ROMIX`` (``xla`` | ``xla-rows`` |
+   ``pallas``) forces the implementation, ``SPACEMESH_ROMIX_CHUNK``
+   (lanes per sequential V chunk; ``0``/``off`` = unchunked) forces the
+   chunk; either beats a cached winner;
+2. the persisted winner for ``(platform, N, batch)``;
+3. a race (disable with ``SPACEMESH_ROMIX_AUTOTUNE=off``, e.g. in
+   latency-sensitive tests), whose result is persisted;
+4. a static heuristic default (race disabled or impossible).
+
+Cache file: ``<cache root>/romix_autotune.json`` (cache root is the
+parent of accel.DEFAULT_CACHE_DIR, i.e. ``~/.cache/spacemesh_tpu``;
+``SPACEMESH_ROMIX_CACHE`` overrides the file path, ``SPACEMESH_JAX_CACHE``
+moves the whole cache root).  A corrupt or unreadable file is treated as
+empty — the race re-runs and rewrites it.  See docs/ROMIX_KERNEL.md.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+SCHEMA = 1
+IMPLS = ("xla", "xla-rows", "pallas")
+
+ENV_IMPL = "SPACEMESH_ROMIX"
+ENV_CHUNK = "SPACEMESH_ROMIX_CHUNK"
+ENV_AUTOTUNE = "SPACEMESH_ROMIX_AUTOTUNE"
+ENV_CACHE = "SPACEMESH_ROMIX_CACHE"
+
+# calibration workload: CAL_BATCH lanes bound the race cost independently
+# of the production batch (chunk locality is a per-lane property, so the
+# winner transfers to wider batches — docs/ROMIX_KERNEL.md discusses the
+# one approximation this makes for the unchunked candidate)
+CAL_BATCH = 512
+CAL_REPS = 2
+
+_OFF = ("0", "off", "none", "false")
+
+
+def _log(*a) -> None:
+    print(*a, file=sys.stderr, flush=True)
+
+
+@dataclasses.dataclass(frozen=True)
+class Decision:
+    """A resolved kernel choice for one (platform, N, batch) shape."""
+
+    impl: str                 # "xla" | "xla-rows" | "pallas"
+    chunk: int | None         # lanes per sequential V chunk; None = whole batch
+    source: str               # "env" | "cache" | "race" | "default" | "untuned"
+    labels_per_sec: float | None = None  # calibration rate, when raced
+    explicit_impl: bool = False  # impl came from SPACEMESH_ROMIX (never
+    #                              silently fall back from it — ops/scrypt.py)
+
+    def as_json(self) -> dict:
+        return {"impl": self.impl, "chunk": self.chunk,
+                "source": self.source,
+                "labels_per_sec": self.labels_per_sec}
+
+
+def cache_path() -> str:
+    """The autotune winners file, colocated with the XLA compile cache."""
+    explicit = os.environ.get(ENV_CACHE)
+    if explicit:
+        return os.path.expanduser(explicit)
+    from ..utils import accel
+
+    jax_cache = os.environ.get("SPACEMESH_JAX_CACHE")
+    if not jax_cache or jax_cache in _OFF:
+        jax_cache = accel.DEFAULT_CACHE_DIR
+    root = os.path.dirname(os.path.expanduser(jax_cache))
+    return os.path.join(root, "romix_autotune.json")
+
+
+def _key(platform: str, n: int, batch: int) -> str:
+    return f"v{SCHEMA}:{platform}:n{n}:b{batch}"
+
+
+def _load_cache(path: str | None = None) -> dict:
+    path = path or cache_path()
+    try:
+        with open(path, encoding="utf-8") as f:
+            doc = json.load(f)
+        if not isinstance(doc, dict):
+            raise ValueError("autotune cache root is not an object")
+        return doc
+    except FileNotFoundError:
+        return {}
+    except (OSError, ValueError) as e:
+        # a corrupt winners file must never break labeling — re-race
+        _log(f"romix autotune: ignoring unreadable cache {path} ({e})")
+        return {}
+
+
+def _store(key: str, entry: dict) -> None:
+    path = cache_path()
+    doc = _load_cache(path)
+    doc[key] = entry
+    try:
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(doc, f, indent=1, sort_keys=True)
+        os.replace(tmp, path)  # atomic: concurrent racers lose, not corrupt
+    except OSError as e:
+        # persistence is an optimization (read-only HOME, sandboxed CI)
+        _log(f"romix autotune: cannot persist winner ({e})")
+
+
+def _entry_decision(entry: dict, batch: int, source: str) -> Decision | None:
+    impl = entry.get("impl")
+    chunk = entry.get("chunk")
+    if impl not in IMPLS:
+        return None
+    if chunk is not None and (not isinstance(chunk, int) or chunk < 1):
+        return None
+    if chunk is not None and chunk >= batch:
+        chunk = None
+    rate = entry.get("labels_per_sec")
+    return Decision(impl, chunk, source,
+                    rate if isinstance(rate, (int, float)) else None)
+
+
+def read_env() -> tuple[str | None, int | None, bool, bool]:
+    """-> (impl override, chunk override, chunk was set, race disabled)."""
+    impl = os.environ.get(ENV_IMPL) or None
+    if impl is not None and impl not in IMPLS:
+        raise ValueError(
+            f"{ENV_IMPL}={impl!r}: expected one of {', '.join(IMPLS)}")
+    chunk_raw = os.environ.get(ENV_CHUNK)
+    chunk_set = chunk_raw is not None and chunk_raw != ""
+    chunk: int | None = None
+    if chunk_set and chunk_raw.lower() not in _OFF:
+        chunk = int(chunk_raw)
+        if chunk < 1:
+            raise ValueError(f"{ENV_CHUNK}={chunk_raw!r}: must be >= 1")
+    no_race = (os.environ.get(ENV_AUTOTUNE) or "").lower() in _OFF
+    return impl, chunk, chunk_set, no_race
+
+
+def chunk_candidates(n: int, batch: int,
+                     targets: tuple[int, ...] = (256 << 20,)
+                     ) -> list[int]:
+    """Power-of-two lane chunks whose V working set (n * 128 bytes per
+    lane) lands near each cache-capacity target, clipped to the batch."""
+    row_bytes = 128  # one lane's (32,) u32 V row
+    out = set()
+    for t in targets:
+        c = max(t // (n * row_bytes), 8)
+        c = 1 << (int(c).bit_length() - 1)
+        if c < batch:
+            out.add(int(c))
+    return sorted(out)
+
+
+def default_decision(platform: str, n: int, batch: int) -> Decision:
+    """Static heuristic when racing is disabled or impossible: the
+    word-major XLA gather over the whole batch. Measured on CPU hosts the
+    diagonal-vector Salsa is op-dispatch-bound, so sequential lane chunks
+    only subtract lane width (docs/ROMIX_KERNEL.md) — chunking has to
+    EARN its place through the race."""
+    return Decision("xla", None, "default")
+
+
+def candidates(platform: str, n: int, batch: int) -> list[tuple[str, int | None]]:
+    """The (impl, chunk) grid raced for one shape."""
+    chunks: list[int | None] = [None, *chunk_candidates(n, batch)]
+    if platform == "cpu":
+        # interpret-mode Pallas executes every DMA in Python — never a
+        # contender, so never raced (force it with SPACEMESH_ROMIX=pallas)
+        return [(impl, c) for impl in ("xla", "xla-rows") for c in chunks]
+    out: list[tuple[str, int | None]] = [("xla", c) for c in chunks]
+    if platform == "tpu":
+        # the Pallas kernel tiles lanes at LANE_TILE internally (its V
+        # scratch is per-tile), so an outer chunk adds nothing
+        out.append(("pallas", None))
+    return out
+
+
+def calibration_block(batch: int = CAL_BATCH, seed: int = 7) -> np.ndarray:
+    """Deterministic (32, batch) u32 ROMix input, shared by the race and
+    tools/profiler.py --romix so both measure the same workload."""
+    rng = np.random.RandomState(seed)
+    return rng.randint(0, 2**32, size=(32, batch),
+                       dtype=np.uint64).astype(np.uint32)
+
+
+# in-process memos. Race measurements are per (platform, n) — the
+# calibration workload is FIXED at CAL_BATCH lanes, so one measurement
+# serves every production batch size (bench sweeps, init tail batches,
+# the verifier's variable-count label recomputes) — and are additionally
+# persisted, so a new process deriving a winner for a new batch size
+# never re-compiles. Resolved decisions are memoized per call signature
+# (env included) so the steady dispatch path costs dict lookups, not a
+# cache-file parse per batch.
+_race_memo: dict[tuple, list[dict]] = {}
+_decision_memo: dict[tuple, Decision] = {}
+
+
+def reset_memo() -> None:
+    """Drop in-process memos (tests simulating fresh processes)."""
+    _race_memo.clear()
+    _decision_memo.clear()
+
+
+def _meas_key(platform: str, n: int) -> str:
+    return f"v{SCHEMA}:meas:{platform}:n{n}:cal{CAL_BATCH}"
+
+
+def _valid_rows(rows) -> list[dict]:
+    out = []
+    if not isinstance(rows, list):
+        return out
+    for r in rows:
+        if (isinstance(r, dict) and r.get("impl") in IMPLS
+                and (r.get("chunk") is None
+                     or (isinstance(r.get("chunk"), int) and r["chunk"] >= 1))
+                and isinstance(r.get("labels_per_sec"), (int, float))):
+            out.append(r)
+    return out
+
+
+def _race_measurements(platform: str, n: int) -> list[dict]:
+    memo_key = (platform, n)
+    got = _race_memo.get(memo_key)
+    if got is not None:
+        return got
+    persisted = _valid_rows(
+        _load_cache().get(_meas_key(platform, n), {}).get("raced"))
+    if persisted:
+        _race_memo[memo_key] = persisted
+        return persisted
+    import jax.numpy as jnp
+
+    from ..utils import metrics
+    from . import scrypt
+
+    metrics.post_romix_autotune_races.inc()
+    x = jnp.asarray(calibration_block(CAL_BATCH))
+    rows = []
+    for impl, chunk in candidates(platform, n, CAL_BATCH):
+        if chunk is not None and chunk >= CAL_BATCH:
+            continue  # indistinguishable from unchunked at this workload
+        # non-pallas candidates never interpret — the SAME static jit key
+        # production uses, so the race's compile is reused, not repaid
+        interpret = impl == "pallas" and platform != "tpu"
+        label = f"{impl}" + (f"/chunk={chunk}" if chunk else "")
+        try:
+            t0 = time.perf_counter()
+            scrypt.romix_tuned(x, n=n, impl=impl, chunk=chunk,
+                               interpret=interpret).block_until_ready()
+            compile_s = time.perf_counter() - t0
+            best = float("inf")
+            for _ in range(CAL_REPS):
+                t0 = time.perf_counter()
+                scrypt.romix_tuned(x, n=n, impl=impl, chunk=chunk,
+                                   interpret=interpret).block_until_ready()
+                best = min(best, time.perf_counter() - t0)
+            rate = CAL_BATCH / best
+            _log(f"romix autotune: {label}: {rate:,.0f} labels/s "
+                 f"(compile+first {compile_s:.1f}s)")
+            rows.append({"impl": impl, "chunk": chunk,
+                         "labels_per_sec": round(rate, 1)})
+        except Exception as e:  # noqa: BLE001 — a candidate that cannot
+            # compile on this host simply loses the race
+            _log(f"romix autotune: {label} failed "
+                 f"({type(e).__name__}: {e})")
+    _race_memo[memo_key] = rows
+    if rows:
+        _store(_meas_key(platform, n),
+               {"raced": rows, "cal_batch": CAL_BATCH,
+                "tuned_at": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                          time.gmtime())})
+    return rows
+
+
+def race(platform: str, n: int, batch: int) -> Decision:
+    """Race (or reuse the measured race of) the candidate kernels on the
+    fixed calibration workload, then persist and return the winner for
+    ``(platform, n, batch)``."""
+    rows = _race_measurements(platform, n)
+    usable = [r for r in rows
+              if r["chunk"] is None or r["chunk"] < batch]
+    if not usable:
+        return default_decision(platform, n, batch)
+    win = max(usable, key=lambda r: r["labels_per_sec"])
+    chunk = win["chunk"]
+    entry = {"impl": win["impl"], "chunk": chunk,
+             "labels_per_sec": win["labels_per_sec"],
+             "cal_batch": CAL_BATCH, "raced": rows,
+             "tuned_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())}
+    _store(_key(platform, n, batch), entry)
+    _log(f"romix autotune: winner for {platform} n={n} b={batch}: "
+         f"{win['impl']}" + (f"/chunk={chunk}" if chunk else "") +
+         f" ({win['labels_per_sec']:,.0f} labels/s, persisted)")
+    return Decision(win["impl"], chunk, "race", win["labels_per_sec"])
+
+
+def decide(n: int, batch: int, *, platform: str | None = None,
+           allow_race: bool = True) -> Decision:
+    """Resolve the kernel choice for one shape (precedence in the module
+    docstring). The steady dispatch path — one call per label batch from
+    post/initializer.py — is a memoized dict lookup; the env values are
+    part of the memo key so override changes always take effect."""
+    if platform is None:
+        import jax
+
+        platform = jax.default_backend()
+    memo_key = (platform, n, batch, allow_race,
+                os.environ.get(ENV_IMPL), os.environ.get(ENV_CHUNK),
+                os.environ.get(ENV_AUTOTUNE), os.environ.get(ENV_CACHE))
+    hit = _decision_memo.get(memo_key)
+    if hit is not None:
+        return hit
+    d = _decide(n, batch, platform, allow_race)
+    _decision_memo[memo_key] = d
+    return d
+
+
+def _decide(n: int, batch: int, platform: str, allow_race: bool) -> Decision:
+    impl_env, chunk_env, chunk_set, no_race = read_env()
+    cached = _entry_decision(
+        _load_cache().get(_key(platform, n, batch), {}), batch, "cache")
+    if impl_env is not None:
+        # explicit impl: env chunk > cached chunk (same impl) > heuristic
+        if chunk_set:
+            chunk = chunk_env
+        elif cached is not None and cached.impl == impl_env:
+            chunk = cached.chunk
+        elif impl_env == "pallas":
+            chunk = None
+        else:
+            chunk = default_decision(platform, n, batch).chunk
+        if chunk is not None and chunk >= batch:
+            chunk = None
+        return Decision(impl_env, chunk, "env", explicit_impl=True)
+    if chunk_set:
+        base = cached or default_decision(platform, n, batch)
+        chunk = chunk_env if (chunk_env is None or chunk_env < batch) else None
+        return Decision(base.impl, chunk, "env")
+    if cached is not None:
+        return cached
+    if no_race or not allow_race:
+        return default_decision(platform, n, batch)
+    return race(platform, n, batch)

@@ -14,18 +14,49 @@ ground truth in tests):
     label(commitment, i) = scrypt(password=commitment, salt=le64(i),
                                   N=n, r=1, p=1, dklen=16)
 
+Kernel structure (docs/ROMIX_KERNEL.md):
+
+* Salsa20/8 runs in the DIAGONAL-VECTOR formulation: the 4x4 word matrix
+  is regrouped into four diagonal vectors of shape (4, B) so every
+  quarter-round is ONE vector op over all four quarters at once — 4x
+  fewer, 4x wider XLA ops than the scalar-word unrolling, which is what
+  the op-dispatch-bound XLA:CPU backend needs (measured 6.4x on the
+  ROMix stage; the rowround reuses the same dataflow after a lane roll).
+* ROMix has two interchangeable, bit-identical V layouts: word-major
+  (N, 32, B) — dense u32 tiles on TPU, one fused gather — and
+  contiguous-row (N*B, 32) — one lane's row is 128 contiguous bytes, the
+  layout the Pallas kernel (ops/romix_pallas.py) uses for its DMAs.
+* The batch can be processed in sequential lane CHUNKS (`lax.map`) so the
+  V working set (N * 128 bytes per lane) fits a cache/VMEM budget.
+* The whole label pipeline — PBKDF2 expand, ROMix, PBKDF2 finish, and
+  optionally the VRF min-scan — compiles as ONE jitted program with a
+  donated scan carry, so HMAC block state never round-trips through HBM
+  between stages. (The historical three-program split guarded against an
+  XLA:CPU simplifier loop that the rolled SHA-256 compression loops in
+  ops/sha256.py already avoid; the fused pipeline is re-verified against
+  hashlib in tests/test_scrypt.py and tests/test_romix_autotune.py.)
+
+Which (implementation, chunk) wins is decided per (platform, N, batch) by
+ops/autotune.py — raced once on a calibration workload, persisted next to
+the XLA compile cache, overridable via SPACEMESH_ROMIX /
+SPACEMESH_ROMIX_CHUNK. Every entry point (post/initializer.py,
+post/prover.py, parallel/mesh.py, bench.py, tools/profiler.py) goes
+through `scrypt_labels_jit` / `scrypt_labels_with_min` and therefore
+picks up the tuned kernel with zero configuration.
+
 TPU layout note: the batch is the MINOR dimension everywhere — block state
-is (32, B) and the ROMix scratch V is (N, 32, B) — so u32 tiles are fully
-dense ((8,128) tiling pads a trailing dim of 32 by 4x; a trailing dim of
-B%128==0 pads nothing). Every op is then a (B,)-wide VPU lane op and the
-data-dependent V[j] read is a per-lane gather. V costs N*128 bytes per
-in-flight label (1 MiB at mainnet N=8192), so batch size trades HBM for
-throughput; see post/initializer.py (batch sizing) and bench.py.
+is (32, B) — so u32 tiles are fully dense ((8,128) tiling pads a trailing
+dim of 32 by 4x; a trailing dim of B%128==0 pads nothing). Every op is
+then a (B,)-wide VPU lane op and the data-dependent V[j] read is a
+per-lane gather. V costs N*128 bytes per in-flight label (1 MiB at
+mainnet N=8192), so batch size trades HBM for throughput; see
+post/initializer.py (batch sizing) and bench.py.
 """
 
 from __future__ import annotations
 
 import functools
+import sys
 
 import jax
 import jax.numpy as jnp
@@ -41,26 +72,44 @@ def _rotl(x, n: int):
     return (x << jnp.uint32(n)) | (x >> jnp.uint32(32 - n))
 
 
-def _quarter(x, a: int, b: int, c: int, d: int):
-    x[b] = x[b] ^ _rotl(x[a] + x[d], 7)
-    x[c] = x[c] ^ _rotl(x[b] + x[a], 9)
-    x[d] = x[d] ^ _rotl(x[c] + x[b], 13)
-    x[a] = x[a] ^ _rotl(x[d] + x[c], 18)
+# Salsa20's 4x4 state regrouped into diagonal vectors: row q of _DIAG
+# lists the state words whose quarter-round position is q. In this
+# layout the columnround's four quarters are ONE quarter-round over
+# (4, B) vectors, and the rowround is the same dataflow after rolling
+# each vector q lanes (the standard SIMD salsa trick, cf. the reference
+# implementation's core/salsa2012 SSE2 path).
+_DIAG = np.array([[0, 5, 10, 15],
+                  [4, 9, 14, 3],
+                  [8, 13, 2, 7],
+                  [12, 1, 6, 11]])
+_UNDIAG = np.argsort(_DIAG.ravel())
 
 
 def salsa20_8(block):
     """Salsa20/8 core. ``block``: (16, ...) u32 LE words (lanes trailing)."""
-    x = [block[i] for i in range(16)]
+    a = block[_DIAG[0]]
+    b = block[_DIAG[1]]
+    c = block[_DIAG[2]]
+    d = block[_DIAG[3]]
     for _ in range(4):  # 4 double-rounds = 8 rounds
-        _quarter(x, 0, 4, 8, 12)
-        _quarter(x, 5, 9, 13, 1)
-        _quarter(x, 10, 14, 2, 6)
-        _quarter(x, 15, 3, 7, 11)
-        _quarter(x, 0, 1, 2, 3)
-        _quarter(x, 5, 6, 7, 4)
-        _quarter(x, 10, 11, 8, 9)
-        _quarter(x, 15, 12, 13, 14)
-    return jnp.stack([x[i] + block[i] for i in range(16)])
+        # columnround: all four column quarters, one vector quarter-round
+        b = b ^ _rotl(a + d, 7)
+        c = c ^ _rotl(b + a, 9)
+        d = d ^ _rotl(c + b, 13)
+        a = a ^ _rotl(d + c, 18)
+        # realign diagonals, then the rowround is the same dataflow with
+        # the b/d roles mirrored
+        b = jnp.roll(b, 1, axis=0)
+        c = jnp.roll(c, 2, axis=0)
+        d = jnp.roll(d, 3, axis=0)
+        d = d ^ _rotl(a + b, 7)
+        c = c ^ _rotl(d + a, 9)
+        b = b ^ _rotl(c + d, 13)
+        a = a ^ _rotl(b + c, 18)
+        b = jnp.roll(b, -1, axis=0)
+        c = jnp.roll(c, -2, axis=0)
+        d = jnp.roll(d, -3, axis=0)
+    return jnp.concatenate([a, b, c, d])[_UNDIAG] + block
 
 
 def blockmix_r1(x):
@@ -70,8 +119,13 @@ def blockmix_r1(x):
     return jnp.concatenate([y0, y1])
 
 
-def romix_r1(x, n: int):
-    """scrypt ROMix for r=1 over a (32, B) u32 LE block batch. ``n`` static."""
+def romix_r1(x, n: int, *, mix_phase: bool = True):
+    """scrypt ROMix for r=1 over a (32, B) u32 LE block batch. ``n`` static.
+
+    Word-major V layout (n, 32, B): dense u32 tiles on TPU, and the
+    data-dependent read is one fused per-lane gather. ``mix_phase=False``
+    stops after the fill phase (profiler stage split only).
+    """
     b = x.shape[1]
     v0 = jnp.zeros((n, 32, b), dtype=jnp.uint32)
 
@@ -81,6 +135,8 @@ def romix_r1(x, n: int):
         return v, blockmix_r1(xx)
 
     v, x = lax.fori_loop(0, n, fill, (v0, x))
+    if not mix_phase:
+        return x
 
     def mix(_, xx):
         j = xx[16] % jnp.uint32(n)  # Integerify: first word of B_{2r-1}, per lane
@@ -90,6 +146,74 @@ def romix_r1(x, n: int):
         return blockmix_r1(xx ^ vj)
 
     return lax.fori_loop(0, n, mix, x)
+
+
+def romix_r1_rows(x, n: int, *, mix_phase: bool = True):
+    """ROMix with the contiguous-row V layout: (n*B, 32), one lane's row
+    is 128 contiguous bytes (the layout ops/romix_pallas.py DMAs around).
+
+    Bit-identical to :func:`romix_r1`; trades the word-major gather's
+    read amplification (32 strided words per lane) for one contiguous
+    row read plus a (B, 32) transpose per iteration. Raced against the
+    other variants by ops/autotune.py.
+    """
+    b = x.shape[1]
+    v0 = jnp.zeros((n * b, 32), dtype=jnp.uint32)
+
+    def fill(i, carry):
+        v, xx = carry
+        v = lax.dynamic_update_slice_in_dim(v, xx.T, i * b, axis=0)
+        return v, blockmix_r1(xx)
+
+    v, x = lax.fori_loop(0, n, fill, (v0, x))
+    if not mix_phase:
+        return x
+    lanes = jnp.arange(b, dtype=jnp.uint32)
+
+    def mix(_, xx):
+        j = xx[16] % jnp.uint32(n)
+        rows = (j * jnp.uint32(b) + lanes).astype(jnp.int32)
+        vj = jnp.take(v, rows, axis=0)  # (B, 32): contiguous per lane
+        return blockmix_r1(xx ^ vj.T)
+
+    return lax.fori_loop(0, n, mix, x)
+
+
+def _romix_chunked(fn, x, n: int, chunk: int | None, **kw):
+    """Run ``fn`` over sequential lane chunks (``lax.map``) so only one
+    chunk's V (n * 128 * chunk bytes) is live at a time. Lanes are padded
+    to a chunk multiple and trimmed — pad lanes run wasted ROMix work, at
+    most chunk-1 of them per call."""
+    b = x.shape[1]
+    if not chunk or chunk >= b:
+        return fn(x, n, **kw)
+    pad = -b % chunk
+    if pad:
+        x = jnp.concatenate([x, jnp.zeros((32, pad), jnp.uint32)], axis=1)
+    xc = jnp.moveaxis(x.reshape(32, -1, chunk), 1, 0)
+    out = lax.map(lambda c: fn(c, n, **kw), xc)
+    out = jnp.moveaxis(out, 0, 1).reshape(32, -1)
+    return out[:, :b] if pad else out
+
+
+def _romix_dispatch(blk, *, n: int, impl: str, chunk: int | None,
+                    interpret: bool, mix_phase: bool = True):
+    if impl == "pallas":
+        from .romix_pallas import romix_pallas_padded
+
+        # the Pallas kernel already tiles lanes (per-tile V scratch), so
+        # the outer chunk is meaningless there
+        return romix_pallas_padded(blk, n=n, interpret=interpret,
+                                   mix_phase=mix_phase)
+    fn = romix_r1_rows if impl == "xla-rows" else romix_r1
+    return _romix_chunked(fn, blk, n, chunk, mix_phase=mix_phase)
+
+
+romix_tuned = jax.jit(
+    _romix_dispatch,
+    static_argnames=("n", "impl", "chunk", "interpret", "mix_phase"))
+"""Jitted ROMix with an explicit (impl, chunk) choice — the entry the
+autotune race and the profiler's --romix stage view share."""
 
 
 def _hmac_finish(outer_mid, inner_digest):
@@ -136,40 +260,23 @@ def _pbkdf2_second(inner_mid, outer_mid, b_le):
     return _hmac_finish(outer_mid, st)
 
 
-# The label pipeline is compiled as three programs, not one: XLA:CPU's
-# algebraic simplifier loops forever on the fully fused graph (circular
-# simplification), and ROMix dominates runtime anyway so fusing the PBKDF2
-# envelopes into it buys nothing. Data stays on device between stages.
-
-
-@jax.jit
-def _stage_expand(commitment_words, idx_lo, idx_hi):
+def _expand(commitment_words, idx_lo, idx_hi):
     # commitment_words: (8,) shared across the batch, or (8, B) per-lane
     # (the batched verifier recomputes labels of many smeshers at once)
     inner_mid, outer_mid = hmac_midstates(commitment_words)
     if inner_mid.ndim == 1:
         inner_mid = inner_mid[:, None]  # broadcast over lanes
         outer_mid = outer_mid[:, None]
-    return inner_mid, outer_mid, _pbkdf2_first(inner_mid, outer_mid, idx_lo, idx_hi)
+    return inner_mid, outer_mid, _pbkdf2_first(inner_mid, outer_mid,
+                                               idx_lo, idx_hi)
 
 
-_stage_romix_xla = jax.jit(romix_r1, static_argnames=("n",))
+# standalone per-stage jits: kept for the profiler's stage-timing view
+# and for any caller that wants a single stage; production labeling goes
+# through the fused single-program pipelines below
+_stage_expand = jax.jit(_expand)
 
-
-def _stage_romix(blk, *, n: int):
-    """ROMix stage dispatch: the XLA gather path by default; the Pallas
-    contiguous-row + async-copy variant behind SPACEMESH_ROMIX=pallas
-    (the round-2 race candidate — ops/romix_pallas.py; falls back when
-    the batch doesn't tile)."""
-    import os
-
-    if os.environ.get("SPACEMESH_ROMIX") == "pallas":
-        from .romix_pallas import LANE_TILE, _romix_pallas_jit
-
-        if blk.shape[1] % LANE_TILE == 0:
-            interpret = jax.default_backend() != "tpu"
-            return _romix_pallas_jit(blk, n=n, interpret=interpret)
-    return _stage_romix_xla(blk, n=n)
+_stage_romix_xla = jax.jit(romix_r1, static_argnames=("n", "mix_phase"))
 
 
 @jax.jit
@@ -177,14 +284,116 @@ def _stage_finish(inner_mid, outer_mid, blk):
     return _pbkdf2_second(inner_mid, outer_mid, blk)[:4]
 
 
+# --- tuned dispatch -----------------------------------------------------
+
+_fallback_logged = False
+
+
+def _tunable(*arrays) -> bool:
+    """Autotuned chunking/impl selection only applies when the inputs are
+    concrete and single-device: under a tracer (parallel/mesh.py jits
+    around these wrappers) or a multi-device sharding, the lane-chunk
+    reshape would fight GSPMD's batch partitioning, so those callers get
+    the plain XLA path unless the env overrides say otherwise."""
+    for a in arrays:
+        if isinstance(a, jax.core.Tracer):
+            return False
+        s = getattr(a, "sharding", None)
+        if s is not None:
+            try:
+                if len(s.device_set) > 1:
+                    return False
+            except Exception:  # noqa: BLE001 — exotic array types
+                pass
+    return True
+
+
+def _plan(n: int, batch: int, *arrays):
+    """-> (autotune.Decision, interpret flag) for one call."""
+    from . import autotune
+
+    platform = jax.default_backend()
+    interpret = platform != "tpu"
+    if not _tunable(*arrays):
+        impl_env, chunk_env, chunk_set, _ = autotune.read_env()
+        d = autotune.Decision(impl_env or "xla",
+                              chunk_env if chunk_set else None,
+                              "untuned", explicit_impl=impl_env is not None)
+    else:
+        d = autotune.decide(n, batch, platform=platform)
+    return d, (interpret if d.impl == "pallas" else False)
+
+
+def _pallas_failed(d, err: Exception):
+    """A Pallas selection failed to import/compile/run: raise when the
+    operator explicitly demanded it, otherwise log ONCE, count, and
+    return the XLA fallback decision."""
+    global _fallback_logged
+    from . import autotune
+    from ..utils import metrics
+
+    if d.impl != "pallas":
+        raise err
+    if d.explicit_impl:
+        raise RuntimeError(
+            f"{autotune.ENV_IMPL}=pallas was explicitly requested but the "
+            f"Pallas ROMix kernel failed ({type(err).__name__}: {err}); "
+            "refusing to silently degrade to the XLA path") from err
+    metrics.post_romix_fallback.inc(reason=type(err).__name__)
+    if not _fallback_logged:
+        _fallback_logged = True
+        print(f"romix: Pallas kernel failed ({type(err).__name__}: {err}); "
+              "falling back to XLA (counted in post_romix_fallback_total)",
+              file=sys.stderr, flush=True)
+    return autotune.Decision("xla", d.chunk, "fallback")
+
+
+def _stage_romix(blk, *, n: int):
+    """ROMix stage dispatch under the autotuned (impl, chunk) decision.
+
+    Kept for callers that run the stages separately; the fused pipelines
+    below inline the same dispatch into one program."""
+    d, interpret = _plan(n, blk.shape[1], blk)
+    try:
+        return romix_tuned(blk, n=n, impl=d.impl, chunk=d.chunk,
+                           interpret=interpret)
+    except Exception as e:  # noqa: BLE001 — pallas-only fallback, re-raised otherwise
+        d = _pallas_failed(d, e)
+        return romix_tuned(blk, n=n, impl=d.impl, chunk=d.chunk,
+                           interpret=False)
+
+
+# --- fused single-program pipelines -------------------------------------
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("n", "impl", "chunk", "interpret"))
+def _labels_fused(commitment_words, idx_lo, idx_hi, *, n: int, impl: str,
+                  chunk: int | None, interpret: bool):
+    """expand -> ROMix -> finish as ONE XLA program: PBKDF2/HMAC block
+    state stays on device between stages instead of round-tripping
+    through HBM as three executables' inputs/outputs."""
+    inner_mid, outer_mid, blk = _expand(commitment_words, idx_lo, idx_hi)
+    blk = _romix_dispatch(blk, n=n, impl=impl, chunk=chunk,
+                          interpret=interpret)
+    return _pbkdf2_second(inner_mid, outer_mid, blk)[:4]
+
+
 def scrypt_labels_jit(commitment_words, idx_lo, idx_hi, *, n: int):
     """Batch of labels. ``idx_lo/idx_hi``: (B,) u32 halves of label indices.
 
-    Returns (4, B) u32 BE words = B 16-byte labels (batch minor).
+    Returns (4, B) u32 BE words = B 16-byte labels (batch minor). One
+    fused program under the autotuned kernel decision (module docstring).
     """
-    inner_mid, outer_mid, blk = _stage_expand(commitment_words, idx_lo, idx_hi)
-    blk = _stage_romix(blk, n=n)
-    return _stage_finish(inner_mid, outer_mid, blk)
+    d, interpret = _plan(n, idx_lo.shape[0], commitment_words, idx_lo,
+                         idx_hi)
+    try:
+        return _labels_fused(commitment_words, idx_lo, idx_hi, n=n,
+                             impl=d.impl, chunk=d.chunk, interpret=interpret)
+    except Exception as e:  # noqa: BLE001 — pallas-only fallback
+        d = _pallas_failed(d, e)
+        return _labels_fused(commitment_words, idx_lo, idx_hi, n=n,
+                             impl=d.impl, chunk=d.chunk, interpret=False)
 
 
 # --- on-device VRF-nonce scan ----------------------------------------------
@@ -233,14 +442,7 @@ def vrf_carry_decode(carry) -> tuple[int, tuple[int, int]] | None:
     return int(c[4]) << 32 | int(c[5]), (hi, lo)
 
 
-@functools.partial(jax.jit, donate_argnums=(3,))
-def _stage_minscan(words, idx_lo, idx_hi, carry):
-    """Fold one label batch into the running LE-u128 minimum.
-
-    Returns ``(new_carry, snapshot)``: the donated rolling carry plus an
-    independently-buffered copy of the same value, so callers can retain a
-    per-batch snapshot while the carry buffer keeps rotating.
-    """
+def _minscan(words, idx_lo, idx_hi, carry):
     # LE-u128 key limbs, most significant first (labels are LE bytes; the
     # (4, B) words are BE within each 4-byte group, so byteswap gives the
     # LE u32 limbs and word order gives significance).
@@ -270,21 +472,56 @@ def _stage_minscan(words, idx_lo, idx_hi, carry):
     return new, new + jnp.uint32(0)
 
 
+@functools.partial(jax.jit, donate_argnums=(3,))
+def _stage_minscan(words, idx_lo, idx_hi, carry):
+    """Fold one label batch into the running LE-u128 minimum.
+
+    Returns ``(new_carry, snapshot)``: the donated rolling carry plus an
+    independently-buffered copy of the same value, so callers can retain a
+    per-batch snapshot while the carry buffer keeps rotating.
+    """
+    return _minscan(words, idx_lo, idx_hi, carry)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("n", "impl", "chunk", "interpret"),
+                   donate_argnums=(3,))
+def _labels_min_fused(commitment_words, idx_lo, idx_hi, carry, *, n: int,
+                      impl: str, chunk: int | None, interpret: bool):
+    inner_mid, outer_mid, blk = _expand(commitment_words, idx_lo, idx_hi)
+    blk = _romix_dispatch(blk, n=n, impl=impl, chunk=chunk,
+                          interpret=interpret)
+    words = _pbkdf2_second(inner_mid, outer_mid, blk)[:4]
+    new_carry, snapshot = _minscan(words, idx_lo, idx_hi, carry)
+    return words, new_carry, snapshot
+
+
 def scrypt_labels_with_min(commitment_words, idx_lo, idx_hi, carry, *,
                            n: int):
     """Label batch + running VRF minimum, fully device-side.
 
-    One host call enqueues the whole chain (PBKDF2 expand, ROMix, finish,
-    min-scan; the pipeline stays split into a few XLA programs — see the
-    compile note above — but no data returns to host). Returns
-    ``(words, new_carry, snapshot)``; ``carry`` is donated.
+    One host call enqueues ONE fused XLA program (PBKDF2 expand, ROMix,
+    finish, min-scan) under the autotuned kernel decision; no data
+    returns to host. Returns ``(words, new_carry, snapshot)``; ``carry``
+    is donated.
     """
-    inner_mid, outer_mid, blk = _stage_expand(commitment_words, idx_lo,
-                                              idx_hi)
-    blk = _stage_romix(blk, n=n)
-    words = _stage_finish(inner_mid, outer_mid, blk)
-    new_carry, snapshot = _stage_minscan(words, idx_lo, idx_hi, carry)
-    return words, new_carry, snapshot
+    d, interpret = _plan(n, idx_lo.shape[0], commitment_words, idx_lo,
+                         idx_hi, carry)
+    # a pallas attempt can fail AFTER compile (e.g. HBM exhaustion
+    # allocating the per-tile V scratch at dispatch), by which point the
+    # donated carry buffer is consumed — keep an independent (6,)-word
+    # device copy (async, no host sync: the streaming init keeps batches
+    # in flight) so the XLA fallback retry has a live carry to donate
+    backup = jnp.asarray(carry) + jnp.uint32(0) if d.impl == "pallas" else None
+    try:
+        return _labels_min_fused(commitment_words, idx_lo, idx_hi, carry,
+                                 n=n, impl=d.impl, chunk=d.chunk,
+                                 interpret=interpret)
+    except Exception as e:  # noqa: BLE001 — pallas-only fallback
+        d = _pallas_failed(d, e)
+        return _labels_min_fused(commitment_words, idx_lo, idx_hi, backup,
+                                 n=n, impl=d.impl, chunk=d.chunk,
+                                 interpret=False)
 
 
 def commitment_to_words(commitment: bytes) -> np.ndarray:

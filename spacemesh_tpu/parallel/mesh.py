@@ -57,6 +57,12 @@ def labels_with_min_sharded(mesh: Mesh, commitment_words, idx_lo, idx_hi,
     all-reduces under GSPMD. Returns ``(words, new_carry, snapshot)`` like
     scrypt.scrypt_labels_with_min, with ``words`` lane-sharded so the host
     can fetch and stripe each device's shard to disk independently.
+
+    Kernel choice: multi-device shardings pin the ROMix dispatch to the
+    plain word-major XLA kernel (a sequential lane-chunk would fight
+    GSPMD's batch partitioning — ops/scrypt.py ``_tunable``); the
+    SPACEMESH_ROMIX / SPACEMESH_ROMIX_CHUNK overrides still win for
+    operators who have measured their mesh (docs/ROMIX_KERNEL.md).
     """
     bs = _batch_sharding(mesh)
     idx_lo = jax.device_put(jnp.asarray(idx_lo), bs)

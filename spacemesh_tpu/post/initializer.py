@@ -35,6 +35,7 @@ from __future__ import annotations
 import dataclasses
 import enum
 import os
+import sys
 import time
 from collections import deque
 from pathlib import Path
@@ -164,6 +165,19 @@ class Initializer:
         stats = PipelineStats()
         mesh = self._resolve_mesh()
         cw = scrypt.commitment_to_words(commitment)
+
+        if mesh is None and total > written0:
+            # resolve (and if needed race+persist) the ROMix kernel choice
+            # up front so the session logs what it will run with and the
+            # first dispatch doesn't absorb the calibration race silently
+            # (ops/autotune.py; the sharded path is pinned to the plain
+            # XLA kernel — see ops/scrypt.py _tunable)
+            from ..ops import autotune
+
+            d = autotune.decide(meta.scrypt_n,
+                                min(self.batch, total - written0))
+            print(f"romix kernel: impl={d.impl} chunk={d.chunk} "
+                  f"(source={d.source})", file=sys.stderr, flush=True)
 
         # resumed (or fresh) running-minimum carry for the VRF scan
         resumed = None

@@ -20,6 +20,7 @@ Usage:
   python -m spacemesh_tpu.tools.profiler --pipeline --n 8192   # per-stage
   python -m spacemesh_tpu.tools.profiler --prove               # prove view
   python -m spacemesh_tpu.tools.profiler --verify-farm         # farm view
+  python -m spacemesh_tpu.tools.profiler --romix --n 8192      # kernel view
 Prints ONE JSON document on stdout; progress goes to stderr. --pipeline
 runs a real (tiny) init through the streaming pipeline and dumps per-stage
 host seconds (dispatch/fetch/write/stall) so stalls are visible without a
@@ -30,6 +31,7 @@ from __future__ import annotations
 
 import argparse
 import concurrent.futures
+import functools
 import hashlib
 import json
 import os
@@ -231,6 +233,82 @@ def prove_benchmark(labels: int, batch: int,
     return doc
 
 
+def romix_benchmark(n: int, batch: int, reps: int = 2,
+                    include_pallas: bool | None = None,
+                    probe: bool = True) -> dict:
+    """Per-stage timings of the label kernel — expand (PBKDF2 first),
+    fill (ROMix phase 1), mix (ROMix phase 2), finish (PBKDF2 second) —
+    for the tuned XLA variant and, on TPU (or with --romix-pallas), the
+    Pallas DMA kernel, on the SAME calibration workload the autotuner
+    races (ops/autotune.py). The fill/mix split runs the kernel once
+    with the mix phase compiled out and subtracts."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..ops import autotune, scrypt
+    from ..utils import accel
+
+    if probe and not accel.ensure_usable_platform():
+        _log("accelerator unreachable; JAX restricted to CPU")
+    platform = jax.default_backend()
+    decision = autotune.decide(n, batch, platform=platform)
+
+    commitment = hashlib.sha256(b"profiler-romix").digest()
+    cw = jnp.asarray(scrypt.commitment_to_words(commitment))
+    lo_, hi_ = scrypt.split_indices(np.arange(batch, dtype=np.uint64))
+    lo, hi = jnp.asarray(lo_), jnp.asarray(hi_)
+    x = jnp.asarray(autotune.calibration_block(batch))
+
+    def best_of(fn):
+        fn().block_until_ready()  # compile + warm
+        t = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            fn().block_until_ready()
+            t = min(t, time.perf_counter() - t0)
+        return t
+
+    # the PBKDF2 envelope stages are implementation-independent
+    expand_s = best_of(lambda: scrypt._stage_expand(cw, lo, hi)[2])
+    inner, outer, blk0 = scrypt._stage_expand(cw, lo, hi)
+    finish_s = best_of(lambda: scrypt._stage_finish(inner, outer, blk0))
+
+    if include_pallas is None:
+        include_pallas = platform == "tpu"
+    rows = []
+    variants = [(decision.impl if decision.impl != "pallas" else "xla",
+                 decision.chunk)]
+    if include_pallas:
+        variants.append(("pallas", None))
+    for impl, chunk in variants:
+        interpret = impl == "pallas" and platform != "tpu"
+        if interpret:
+            _log("pallas timings run in INTERPRET mode (every DMA "
+                 "executes in Python) — correctness-grade, not perf")
+        try:
+            kw = dict(n=n, impl=impl, chunk=chunk, interpret=interpret)
+            fill_s = best_of(functools.partial(
+                scrypt.romix_tuned, x, mix_phase=False, **kw))
+            romix_s = best_of(functools.partial(scrypt.romix_tuned, x, **kw))
+        except Exception as e:  # noqa: BLE001 — e.g. pallas on hosts
+            # without Mosaic; the operator still gets the other rows
+            _log(f"{impl}: failed ({type(e).__name__}: {e})")
+            continue
+        total = expand_s + romix_s + finish_s
+        rows.append({
+            "impl": impl, "chunk": chunk, "interpret": interpret,
+            "stages": {"expand_s": round(expand_s, 4),
+                       "fill_s": round(fill_s, 4),
+                       "mix_s": round(max(romix_s - fill_s, 0.0), 4),
+                       "finish_s": round(finish_s, 4)},
+            "romix_s": round(romix_s, 4),
+            "labels_per_sec": round(batch / total, 1),
+        })
+    return {"scrypt_n": n, "batch": batch,
+            "decision": decision.as_json(), "impls": rows}
+
+
 def verify_benchmark(counts: list[int], reps: int = 2,
                      probe: bool = True) -> dict:
     """Proof-verification throughput (BASELINE config 3: batch of NIPoST
@@ -341,6 +419,16 @@ def main(argv=None) -> int:
     ap.add_argument("--prove", action="store_true",
                     help="profile the streaming prove pipeline per stage "
                     "(read/dispatch/retire) vs the legacy serial scan")
+    ap.add_argument("--romix", action="store_true",
+                    help="profile the label kernel per stage (expand/fill/"
+                    "mix/finish) under the autotuned decision "
+                    "(docs/ROMIX_KERNEL.md)")
+    ap.add_argument("--romix-batch", type=int, default=None,
+                    help="label lanes for --romix (default: the autotune "
+                    "calibration batch)")
+    ap.add_argument("--romix-pallas", action="store_true",
+                    help="include the Pallas kernel in --romix even off-"
+                    "TPU (interpret mode: minutes-slow, correctness-grade)")
     ap.add_argument("--prove-labels", type=int, default=16384,
                     help="store size for the --prove run")
     ap.add_argument("--prove-batch", type=int, default=2048)
@@ -370,6 +458,15 @@ def main(argv=None) -> int:
         doc = pipeline_benchmark(
             a.n, a.pipeline_labels, a.pipeline_batch,
             inflight=a.inflight, writers=a.writers, probe=not a.no_probe)
+        print(json.dumps(doc, indent=2))
+        return 0
+    if a.romix:
+        from ..ops import autotune
+
+        doc = romix_benchmark(
+            a.n, a.romix_batch or autotune.CAL_BATCH, reps=a.reps,
+            include_pallas=True if a.romix_pallas else None,
+            probe=not a.no_probe)
         print(json.dumps(doc, indent=2))
         return 0
     if a.prove:

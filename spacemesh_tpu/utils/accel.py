@@ -17,6 +17,9 @@ import sys
 
 PROBE_TIMEOUT_S = 120
 
+# Per-machine cache root: the XLA compile cache lives here, and the ROMix
+# kernel autotuner (ops/autotune.py) persists its raced winners beside it
+# (romix_autotune.json) so one SPACEMESH_JAX_CACHE override moves both.
 DEFAULT_CACHE_DIR = "~/.cache/spacemesh_tpu/jax_cache"
 _cache_enabled: str | None = None
 

@@ -163,6 +163,18 @@ post_pipeline_meta_saves = REGISTRY.counter(
 post_pipeline_labels_per_sec = REGISTRY.gauge(
     "post_pipeline_labels_per_sec", "labels/s of the last init session")
 
+# ROMix label kernel (ops/scrypt.py dispatch + ops/autotune.py). The
+# fallback counter makes a Pallas selection that silently degraded to the
+# XLA path visible (an explicit SPACEMESH_ROMIX=pallas request raises
+# instead of counting here).
+post_romix_fallback = REGISTRY.counter(
+    "post_romix_fallback_total",
+    "Pallas ROMix selections that fell back to the XLA path "
+    "(label=reason)")
+post_romix_autotune_races = REGISTRY.counter(
+    "post_romix_autotune_races_total",
+    "ROMix kernel autotune races run (persisted-winner cache misses)")
+
 # POST label-store reads (post/data.py LabelStore.read_labels — the serial
 # prover and the prefetching LabelReader pool both land here). The prove
 # pipeline's disk-frugality contract ("at most one pass over the store per

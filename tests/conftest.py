@@ -21,6 +21,18 @@ if "xla_force_host_platform_device_count" not in _flags:
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+import tempfile  # noqa: E402
+
+# the ROMix autotuner (ops/autotune.py) must stay deterministic and cheap
+# under test: no implicit candidate races, and never persist winners into
+# the developer's real cache root. The autotune tests opt back in with
+# monkeypatch (tests/test_romix_autotune.py).
+os.environ.setdefault("SPACEMESH_ROMIX_AUTOTUNE", "off")
+os.environ.setdefault(
+    "SPACEMESH_ROMIX_CACHE",
+    os.path.join(tempfile.gettempdir(),
+                 f"spacemesh-test-romix-{os.getpid()}.json"))
+
 import jax  # noqa: E402  (import order is the point here)
 
 jax.config.update("jax_platforms", "cpu")

@@ -53,7 +53,10 @@ def test_flagged_pipeline_is_bit_exact_vs_hashlib(monkeypatch):
         assert bytes(got[i]) == want, f"label {i} mismatch"
 
 
-def test_flag_falls_back_when_batch_does_not_tile(monkeypatch):
+def test_flag_pads_when_batch_does_not_tile(monkeypatch):
+    """An explicit pallas request with a non-tiling batch PADS the lanes
+    up to the tile (romix_pallas_padded) instead of silently falling
+    back to XLA — explicit requests never degrade (ops/autotune.py)."""
     monkeypatch.setenv("SPACEMESH_ROMIX", "pallas")
     commitment = hashlib.sha256(b"romix-fallback").digest()
     got = scrypt.scrypt_labels(commitment, np.arange(3, dtype=np.uint64),
