@@ -81,6 +81,15 @@ class ApiServer:
         # or hot node
         r.add_get("/debug/stacks", self.debug_stacks)
         r.add_get("/debug/profile", self.debug_profile)
+        # span-trace capture (utils/tracing.py): start/stop a bounded
+        # ring capture and export it as Perfetto-compatible JSON. GET
+        # and POST both accepted — operators drive these with curl
+        for route in ("/debug/trace/start", "/debug/trace/stop"):
+            handler = (self.trace_start if route.endswith("start")
+                       else self.trace_stop)
+            r.add_get(route, handler)
+            r.add_post(route, handler)
+        r.add_get("/debug/trace/export", self.trace_export)
 
     # --- lifecycle ---------------------------------------------------
 
@@ -374,6 +383,55 @@ class ApiServer:
             .print_stats(40)
         return web.Response(text=buf.getvalue(),
                             content_type="text/plain")
+
+    # --- span-trace capture (docs/OBSERVABILITY.md) -------------------
+
+    async def trace_start(self, req) -> web.Response:
+        """Begin (or restart) a span capture. ?capacity=N bounds the
+        ring; ?jax=1 bridges spans into jax.profiler annotations."""
+        from ..utils import metrics, tracing
+
+        try:
+            capacity = req.query.get("capacity")
+            capacity = int(capacity) if capacity else None
+            jax_q = req.query.get("jax")
+            jax_bridge = (jax_q not in ("", "0", "off", None)
+                          if jax_q is not None else None)
+        except ValueError:
+            raise web.HTTPBadRequest(text="capacity must be an integer")
+        tracing.start(capacity=capacity, jax_bridge=jax_bridge)
+        metrics.trace_enabled_gauge.set(1)
+        metrics.trace_spans_gauge.set(0)
+        return web.json_response({
+            "enabled": True,
+            "capacity": tracing.TRACER.capacity,
+            "jax_bridge": tracing.TRACER.jax_bridge,
+        })
+
+    async def trace_stop(self, req) -> web.Response:
+        from ..utils import metrics, tracing
+
+        retained = tracing.stop()
+        metrics.trace_enabled_gauge.set(0)
+        metrics.trace_spans_gauge.set(tracing.TRACER.recorded())
+        return web.json_response({
+            "enabled": False,
+            "spans_retained": retained,
+            "spans_recorded": tracing.TRACER.recorded(),
+        })
+
+    async def trace_export(self, req) -> web.Response:
+        """The capture as Chrome trace-event JSON — save the body and
+        open it at https://ui.perfetto.dev. Exporting does not stop the
+        capture; a live capture exports its current ring."""
+        from ..utils import metrics, tracing
+
+        metrics.trace_spans_gauge.set(tracing.TRACER.recorded())
+        # a big ring materializes AND serializes slowly; do both off the
+        # loop (export() tolerates concurrent recording)
+        body = await asyncio.to_thread(
+            lambda: json.dumps(tracing.export()))
+        return web.Response(text=body, content_type="application/json")
 
     # --- chaos fault injection (systest harness; reference
     # systest/chaos/{partition,timeskew}.go) ---------------------------

@@ -34,6 +34,7 @@ from ..post.service import PostClient, PostService
 from ..storage import db as dbmod
 from ..storage.cache import AtxCache
 from ..txs import ConservativeState
+from ..utils import tracing
 from ..vm import VM
 from ..vm import sdk as vm_sdk
 from . import clock as clock_mod
@@ -678,6 +679,11 @@ class App:
             return False
 
         async def process_synced_layer(layer: int, data) -> None:
+            async with tracing.span("sync.apply_layer", {"layer": layer}
+                                    if tracing.is_enabled() else None):
+                await _process_synced_layer(layer, data)
+
+        async def _process_synced_layer(layer: int, data) -> None:
             from ..storage import blocks as bs
 
             # candidates vote-ordered; certificate VALIDATION picks the
@@ -903,15 +909,17 @@ class App:
             self.events.emit(events_mod.LayerUpdate(layer=out.layer,
                                                     status="hare_failed"))
             return
-        block = self.generator.process_hare_output(out)
-        self.events.emit(events_mod.LayerUpdate(layer=out.layer,
-                                                status="hare_done"))
-        if block is not None:
-            epoch = out.layer // self.cfg.layers_per_epoch
-            for s in self.signers:
-                await self.certifier.certify_if_eligible(
-                    out.layer, block.id, self._atx_of(epoch, s.node_id),
-                    signer=s)
+        async with tracing.span("mesh.hare_output", {"layer": out.layer}
+                                if tracing.is_enabled() else None):
+            block = self.generator.process_hare_output(out)
+            self.events.emit(events_mod.LayerUpdate(layer=out.layer,
+                                                    status="hare_done"))
+            if block is not None:
+                epoch = out.layer // self.cfg.layers_per_epoch
+                for s in self.signers:
+                    await self.certifier.certify_if_eligible(
+                        out.layer, block.id, self._atx_of(epoch, s.node_id),
+                        signer=s)
 
     # --- smeshing ------------------------------------------------------
 
@@ -1144,8 +1152,12 @@ class App:
                 self.hare.run_layer(layer, self.clock.time_of(layer)))
             self._hare_tasks[layer] = ht
             ht.add_done_callback(self._reap_hare(layer))
-            await asyncio.gather(*(m.build(layer) for m in self.miners))
-            self.mesh.process_layer(layer)
+            async with tracing.span("layer.build", {"layer": layer}
+                                    if tracing.is_enabled() else None):
+                await asyncio.gather(*(m.build(layer) for m in self.miners))
+            with tracing.span("mesh.process_layer", {"layer": layer}
+                              if tracing.is_enabled() else None):
+                self.mesh.process_layer(layer)
             # report the frontier that is ACTUALLY applied — with hare
             # running concurrently, layer L's block typically lands after
             # this tick, and the event stream must not claim otherwise

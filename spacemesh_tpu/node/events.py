@@ -14,6 +14,8 @@ import dataclasses
 from collections import defaultdict
 from typing import Any, Type
 
+from ..utils import metrics
+
 
 @dataclasses.dataclass
 class LayerUpdate:
@@ -85,7 +87,11 @@ class Subscription:
         try:
             self.queue.put_nowait(ev)
         except asyncio.QueueFull:
+            # the boolean marks the subscription lossy for its consumer;
+            # the counter makes the loss visible to OPERATORS before any
+            # consumer notices a gap in its stream
             self.overflowed = True
+            metrics.events_overflows.inc(type=type(ev).__name__)
 
     async def next(self):
         return await self.queue.get()
@@ -105,8 +111,15 @@ class EventBus:
         return sub
 
     def emit(self, ev: Any) -> None:
-        for sub in list(self._subs.get(type(ev), ())):
+        subs = list(self._subs.get(type(ev), ()))
+        for sub in subs:
             sub._offer(ev)
+        if subs:
+            # deepest queue across this event's subscribers: a consumer
+            # falling behind trends this toward its bound before the
+            # overflow counter ever fires
+            metrics.events_queue_depth.set(
+                max(s.queue.qsize() for s in subs))
 
     def _drop(self, sub: Subscription) -> None:
         for t in sub.types:

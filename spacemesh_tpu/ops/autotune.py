@@ -252,12 +252,31 @@ def _race_measurements(platform: str, n: int) -> list[dict]:
     if persisted:
         _race_memo[memo_key] = persisted
         return persisted
-    import jax.numpy as jnp
-
-    from ..utils import metrics
-    from . import scrypt
+    from ..utils import metrics, tracing
 
     metrics.post_romix_autotune_races.inc()
+    race_sp = tracing.span("romix.race", {"platform": platform, "n": n}
+                           if tracing.is_enabled() else None)
+    race_sp.__enter__()
+    try:
+        rows = _race_candidates(platform, n)
+    finally:
+        race_sp.__exit__(None, None, None)
+    _race_memo[memo_key] = rows
+    if rows:
+        _store(_meas_key(platform, n),
+               {"raced": rows, "cal_batch": CAL_BATCH,
+                "tuned_at": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                          time.gmtime())})
+    return rows
+
+
+def _race_candidates(platform: str, n: int) -> list[dict]:
+    import jax.numpy as jnp
+
+    from ..utils import tracing
+    from . import scrypt
+
     x = jnp.asarray(calibration_block(CAL_BATCH))
     rows = []
     for impl, chunk in candidates(platform, n, CAL_BATCH):
@@ -267,6 +286,10 @@ def _race_measurements(platform: str, n: int) -> list[dict]:
         # production uses, so the race's compile is reused, not repaid
         interpret = impl == "pallas" and platform != "tpu"
         label = f"{impl}" + (f"/chunk={chunk}" if chunk else "")
+        csp = tracing.span("romix.race_candidate",
+                           {"impl": impl, "chunk": chunk}
+                           if tracing.is_enabled() else None)
+        csp.__enter__()
         try:
             t0 = time.perf_counter()
             scrypt.romix_tuned(x, n=n, impl=impl, chunk=chunk,
@@ -281,18 +304,17 @@ def _race_measurements(platform: str, n: int) -> list[dict]:
             rate = CAL_BATCH / best
             _log(f"romix autotune: {label}: {rate:,.0f} labels/s "
                  f"(compile+first {compile_s:.1f}s)")
+            csp.set(labels_per_sec=round(rate, 1),
+                    compile_s=round(compile_s, 3))
             rows.append({"impl": impl, "chunk": chunk,
                          "labels_per_sec": round(rate, 1)})
         except Exception as e:  # noqa: BLE001 — a candidate that cannot
             # compile on this host simply loses the race
             _log(f"romix autotune: {label} failed "
                  f"({type(e).__name__}: {e})")
-    _race_memo[memo_key] = rows
-    if rows:
-        _store(_meas_key(platform, n),
-               {"raced": rows, "cal_batch": CAL_BATCH,
-                "tuned_at": time.strftime("%Y-%m-%dT%H:%M:%SZ",
-                                          time.gmtime())})
+            csp.set(failed=type(e).__name__)
+        finally:
+            csp.__exit__(None, None, None)
     return rows
 
 

@@ -63,6 +63,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from ..utils import tracing
 from .sha256 import byteswap32, hmac_midstates, sha256_compress
 
 LABEL_BYTES = 16  # reference: 16-byte labels, 2^32 per 64 GiB unit
@@ -387,13 +388,21 @@ def scrypt_labels_jit(commitment_words, idx_lo, idx_hi, *, n: int):
     """
     d, interpret = _plan(n, idx_lo.shape[0], commitment_words, idx_lo,
                          idx_hi)
-    try:
-        return _labels_fused(commitment_words, idx_lo, idx_hi, n=n,
-                             impl=d.impl, chunk=d.chunk, interpret=interpret)
-    except Exception as e:  # noqa: BLE001 — pallas-only fallback
-        d = _pallas_failed(d, e)
-        return _labels_fused(commitment_words, idx_lo, idx_hi, n=n,
-                             impl=d.impl, chunk=d.chunk, interpret=False)
+    # the span covers the ENQUEUE (trace+compile on a cache miss, else
+    # async dispatch) — device time shows up in the XLA trace, which the
+    # SPACEMESH_TRACE_JAX bridge lines these spans up against
+    with tracing.span("romix.dispatch",
+                      {"impl": d.impl, "chunk": d.chunk, "n": n,
+                       "batch": int(idx_lo.shape[0])}
+                      if tracing.is_enabled() else None):
+        try:
+            return _labels_fused(commitment_words, idx_lo, idx_hi, n=n,
+                                 impl=d.impl, chunk=d.chunk,
+                                 interpret=interpret)
+        except Exception as e:  # noqa: BLE001 — pallas-only fallback
+            d = _pallas_failed(d, e)
+            return _labels_fused(commitment_words, idx_lo, idx_hi, n=n,
+                                 impl=d.impl, chunk=d.chunk, interpret=False)
 
 
 # --- on-device VRF-nonce scan ----------------------------------------------
@@ -513,15 +522,19 @@ def scrypt_labels_with_min(commitment_words, idx_lo, idx_hi, carry, *,
     # device copy (async, no host sync: the streaming init keeps batches
     # in flight) so the XLA fallback retry has a live carry to donate
     backup = jnp.asarray(carry) + jnp.uint32(0) if d.impl == "pallas" else None
-    try:
-        return _labels_min_fused(commitment_words, idx_lo, idx_hi, carry,
-                                 n=n, impl=d.impl, chunk=d.chunk,
-                                 interpret=interpret)
-    except Exception as e:  # noqa: BLE001 — pallas-only fallback
-        d = _pallas_failed(d, e)
-        return _labels_min_fused(commitment_words, idx_lo, idx_hi, backup,
-                                 n=n, impl=d.impl, chunk=d.chunk,
-                                 interpret=False)
+    with tracing.span("romix.dispatch",
+                      {"impl": d.impl, "chunk": d.chunk, "n": n,
+                       "batch": int(idx_lo.shape[0]), "minscan": True}
+                      if tracing.is_enabled() else None):
+        try:
+            return _labels_min_fused(commitment_words, idx_lo, idx_hi, carry,
+                                     n=n, impl=d.impl, chunk=d.chunk,
+                                     interpret=interpret)
+        except Exception as e:  # noqa: BLE001 — pallas-only fallback
+            d = _pallas_failed(d, e)
+            return _labels_min_fused(commitment_words, idx_lo, idx_hi,
+                                     backup, n=n, impl=d.impl, chunk=d.chunk,
+                                     interpret=False)
 
 
 def commitment_to_words(commitment: bytes) -> np.ndarray:

@@ -17,7 +17,7 @@ import asyncio
 import logging
 from typing import Awaitable, Callable
 
-from ..utils import metrics
+from ..utils import metrics, tracing
 
 _log = logging.getLogger("pubsub")
 
@@ -65,22 +65,41 @@ class PubSub:
         One raising handler must not abort delivery to the REMAINING
         subscribers (nor kill the bus): the exception is counted as a
         reject, logged, and surfaced in pubsub_handler_drops_total so a
-        silently-crashing validator is visible to operators."""
+        silently-crashing validator is visible to operators.
+
+        Under a span-trace capture (utils/tracing.py) each delivery is
+        the ROOT of a causal timeline: the per-handler validator spans —
+        and everything they await, verify-farm submits included —
+        parent into it, so one gossip message's whole processing path
+        reads as a single tree in the Perfetto export."""
         ok = True
-        for h in self._handlers.get(topic, ()):
-            try:
-                r = await h(peer, data)
-            except asyncio.CancelledError:
-                raise  # shutdown must still propagate
-            except Exception as exc:  # noqa: BLE001 — bad message ≠ dead bus
-                metrics.pubsub_handler_drops.inc(topic=topic)
-                _log.warning("handler %r dropped message on topic %s: %r",
-                             getattr(h, "__qualname__", h), topic, exc)
-                r = False
-            if r is False:
-                ok = False
-            elif r is None and ok is True:
-                ok = None
+        dsp = tracing.span("gossip.deliver",
+                           {"topic": topic, "peer": peer.hex()[:16],
+                            "bytes": len(data)}
+                           if tracing.is_enabled() else None)
+        async with dsp:
+            for h in self._handlers.get(topic, ()):
+                try:
+                    async with tracing.span(
+                            "gossip.handler",
+                            {"topic": topic,
+                             "handler": getattr(h, "__qualname__", str(h))}
+                            if tracing.is_enabled() else None):
+                        r = await h(peer, data)
+                except asyncio.CancelledError:
+                    raise  # shutdown must still propagate
+                except Exception as exc:  # noqa: BLE001 — bad message ≠ dead bus
+                    metrics.pubsub_handler_drops.inc(topic=topic)
+                    _log.warning("handler %r dropped message on topic %s: %r",
+                                 getattr(h, "__qualname__", h), topic, exc)
+                    r = False
+                if r is False:
+                    ok = False
+                elif r is None and ok is True:
+                    ok = None
+            if dsp is not tracing._NOP:
+                dsp.set(result={True: "accept", False: "reject",
+                                None: "no-relay"}[ok])
         return ok
 
 

@@ -19,7 +19,7 @@ import time
 from pathlib import Path
 
 from ..ops.scrypt import LABEL_BYTES
-from ..utils import metrics
+from ..utils import metrics, tracing
 
 METADATA_FILE = "postdata_metadata.json"
 
@@ -192,7 +192,9 @@ class LabelWriter:
         with self._lock:
             self._inflight += 1
         self.labels_submitted += len(labels) // LABEL_BYTES
-        self._q.put((start_index, labels))
+        # pool threads are long-lived and cannot inherit the submitter's
+        # contextvars; the span parent rides along with the work item
+        self._q.put((start_index, labels, tracing.current_id()))
 
     def durable(self) -> int:
         """Highest label index with every prior label contiguously on disk."""
@@ -237,10 +239,15 @@ class LabelWriter:
             item = self._q.get()
             if item is self._STOP:
                 return
-            start, labels = item
+            start, labels, parent = item
             t0 = time.perf_counter()
             try:
-                self.store.write_labels(start, labels)
+                with tracing.span("init.write",
+                                  {"start": start,
+                                   "labels": len(labels) // LABEL_BYTES}
+                                  if tracing.is_enabled() else None,
+                                  parent=parent):
+                    self.store.write_labels(start, labels)
             except BaseException as e:  # noqa: BLE001 — surfaced to caller
                 with self._idle:
                     if self._error is None:
@@ -275,6 +282,9 @@ class LabelReader:
                  depth: int = 4):
         self.store = store
         self.ranges: list[tuple[int, int]] = list(ranges)
+        # pool threads can't inherit contextvars; reads parent under the
+        # span that planned the pass (the prover's window span)
+        self._trace_parent = tracing.current_id()
         self._cond = threading.Condition()
         self._results: dict[int, bytes] = {}
         self._claim = 0          # next plan slot a worker may take
@@ -338,7 +348,11 @@ class LabelReader:
             start, count = self.ranges[slot]
             t0 = time.perf_counter()
             try:
-                data = self.store.read_labels(start, count)
+                with tracing.span("prove.read_io",
+                                  {"start": start, "count": count}
+                                  if tracing.is_enabled() else None,
+                                  parent=self._trace_parent):
+                    data = self.store.read_labels(start, count)
             except BaseException as e:  # noqa: BLE001 — surfaced via get()
                 with self._cond:
                     if self._error is None:

@@ -46,7 +46,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..ops import scrypt
-from ..utils import metrics
+from ..utils import metrics, tracing
 from .data import LabelStore, LabelWriter, PostMetadata
 
 DEFAULT_BATCH = 1 << 13  # 8192 labels = 8 MiB ROMix scratch per 1k... tuned in bench
@@ -199,13 +199,21 @@ class Initializer:
         pending: deque = deque()  # (start, count, words, snapshot)
         self._last_save_t = time.monotonic()
         self._last_save_labels = written0
+        session = tracing.span("init.run",
+                               {"total": total, "resume_at": written0,
+                                "batch": self.batch}
+                               if tracing.is_enabled() else None)
+        session.__enter__()
         try:
             dispatched = written0
             while dispatched < total and not self._stop:
                 count = min(self.batch, total - dispatched)
                 td = time.perf_counter()
-                words, carry, snap = self._dispatch(
-                    mesh, cw, dispatched, count, carry)
+                with tracing.span("init.dispatch",
+                                  {"start": dispatched, "count": count}
+                                  if tracing.is_enabled() else None):
+                    words, carry, snap = self._dispatch(
+                        mesh, cw, dispatched, count, carry)
                 stats.dispatch_s += time.perf_counter() - td
                 stats.batches += 1
                 metrics.post_pipeline_dispatched.inc()
@@ -223,10 +231,12 @@ class Initializer:
                 self.status = Status.STOPPED
                 pending.clear()  # discard in-flight device work
             tw = time.perf_counter()
-            writer.drain()
+            with tracing.span("init.drain_stall"):
+                writer.drain()
             stats.write_stall_s += time.perf_counter() - tw
             self._save_meta(writer, stats)
         finally:
+            session.__exit__(None, None, None)
             stats.write_s = writer.write_seconds
             writer.close(drain=False)
             metrics.post_pipeline_inflight.set(0)
@@ -274,26 +284,34 @@ class Initializer:
         """Fetch the oldest in-flight batch and hand it to the writers."""
         start, count, words, snap = item
         shards = []  # (global start, (4, lanes) ndarray, valid lane count)
+        rsp = tracing.span("init.fetch", {"start": start, "count": count}
+                           if tracing.is_enabled() else None)
+        rsp.__enter__()
         tf = time.perf_counter()
-        if len(getattr(words.sharding, "device_set", ())) > 1:
-            for shard in words.addressable_shards:
-                lane0 = shard.index[1].start or 0
-                if lane0 >= count:
-                    continue  # pure padding shard
-                arr = np.asarray(shard.data)
-                shards.append((start + lane0, arr,
-                               min(count - lane0, arr.shape[1])))
-        else:
-            shards.append((start, np.asarray(words), count))
-        stats.shards += len(shards)
         stall = 0.0
-        for shard_start, arr, valid in shards:
-            # byte conversion is host fetch-side work; only the submit()
-            # wait is writer backpressure
-            data = scrypt.labels_to_bytes(arr)[:valid * scrypt.LABEL_BYTES]
-            ts = time.perf_counter()
-            writer.submit(shard_start, data)
-            stall += time.perf_counter() - ts
+        try:
+            if len(getattr(words.sharding, "device_set", ())) > 1:
+                for shard in words.addressable_shards:
+                    lane0 = shard.index[1].start or 0
+                    if lane0 >= count:
+                        continue  # pure padding shard
+                    arr = np.asarray(shard.data)
+                    shards.append((start + lane0, arr,
+                                   min(count - lane0, arr.shape[1])))
+            else:
+                shards.append((start, np.asarray(words), count))
+            stats.shards += len(shards)
+            for shard_start, arr, valid in shards:
+                # byte conversion is host fetch-side work; only the
+                # submit() wait is writer backpressure
+                data = scrypt.labels_to_bytes(arr)[:valid
+                                                   * scrypt.LABEL_BYTES]
+                ts = time.perf_counter()
+                with tracing.span("init.write_stall"):
+                    writer.submit(shard_start, data)
+                stall += time.perf_counter() - ts
+        finally:
+            rsp.__exit__(None, None, None)
         stats.fetch_s += time.perf_counter() - tf - stall
         stats.write_stall_s += stall
         if stall > 0:
@@ -328,7 +346,9 @@ class Initializer:
             meta.vrf_nonce = idx
             meta.vrf_nonce_value = (
                 lo.to_bytes(8, "little") + hi.to_bytes(8, "little")).hex()
-        meta.save(self.store.dir)
+        with tracing.span("init.save_meta", {"durable": durable}
+                          if tracing.is_enabled() else None):
+            meta.save(self.store.dir)
         stats.meta_saves += 1
         stats.save_s += time.perf_counter() - t0
         metrics.post_pipeline_meta_saves.inc()

@@ -49,7 +49,7 @@ import numpy as np
 
 from ..ops import pow as k2pow
 from ..ops import proving, proving_pallas, scrypt
-from ..utils import metrics
+from ..utils import metrics, tracing
 from .data import LabelStore, PostMetadata
 
 DEFAULT_NONCE_GROUP = 16
@@ -205,8 +205,9 @@ class Prover:
 
     def _pow(self, challenge: bytes) -> int:
         node_id = bytes.fromhex(self.meta.node_id)
-        pow_nonce = k2pow.search(challenge, node_id,
-                                 self.params.pow_difficulty)
+        with tracing.span("prove.k2pow"):
+            pow_nonce = k2pow.search(challenge, node_id,
+                                     self.params.pow_difficulty)
         if pow_nonce is None:
             raise RuntimeError("k2pow search exhausted")
         return pow_nonce
@@ -274,15 +275,23 @@ class Prover:
         window = self.nonce_group * self.window_groups
         winner = None
         max_nonce = MAX_GROUPS * self.nonce_group
-        for base in range(0, max_nonce, window):
-            # clamp the last window to the serial prover's give-up bound so
-            # the two paths search the exact same nonce range
-            groups = min(self.window_groups,
-                         (max_nonce - base) // self.nonce_group)
-            winner, indices = self._scan_window(cw, thr, base, groups, step,
-                                                mesh, stats)
-            if winner is not None:
-                break
+        psp = tracing.span("prove.run",
+                           {"challenge": challenge.hex()[:16],
+                            "labels": meta.total_labels}
+                           if tracing.is_enabled() else None)
+        psp.__enter__()
+        try:
+            for base in range(0, max_nonce, window):
+                # clamp the last window to the serial prover's give-up
+                # bound so the two paths search the exact same nonce range
+                groups = min(self.window_groups,
+                             (max_nonce - base) // self.nonce_group)
+                winner, indices = self._scan_window(cw, thr, base, groups,
+                                                    step, mesh, stats)
+                if winner is not None:
+                    break
+        finally:
+            psp.__exit__(None, None, None)
         stats.elapsed_s = time.monotonic() - t0
         if stats.elapsed_s > 0:
             metrics.post_prove_labels_per_sec.set(
@@ -313,56 +322,75 @@ class Prover:
 
     def _scan_window(self, cw, thr, nonce_base, groups, step, mesh, stats):
         """One disk pass over the store scanning ``groups`` nonce groups.
-        Returns (winner_nonce, indices) or (None, None)."""
+        Returns (winner_nonce, indices) or (None, None).
+
+        Under a trace capture the pass is one ``prove.window`` span and
+        every per-batch read/dispatch/retire span carries the SAME
+        ``window`` attribute (the pass's base nonce), so a timeline
+        groups a window's whole read→dispatch→retire ladder even when
+        batches from two windows interleave."""
         meta, p = self.meta, self.params
         total = meta.total_labels
         b = self.batch_labels
         ng = self.nonce_group
         cap = max(p.k2, 1)
-        ranges = [(s, min(b, total - s)) for s in range(0, total, b)]
-        states = []
-        for _ in range(groups):
-            counts, carry = proving.init_hit_state(ng, cap)
-            if mesh is not None:
-                from ..parallel import mesh as pmesh
-                counts = pmesh.replicate(mesh, counts)
-                carry = pmesh.replicate(mesh, carry)
-            states.append([counts, carry])
-        host_counts = np.zeros(ng * groups, dtype=np.int64)
-        inflight: deque = deque()  # (scanned_end, [per-group batch counts])
-        reader = self.store.start_reader(ranges, self.readers,
-                                         self.reader_queue)
-        metrics.post_prove_windows.inc()
-        stats.windows += 1
+        traced = tracing.is_enabled()
+        wsp = tracing.span("prove.window",
+                           {"window": nonce_base, "groups": groups,
+                            "labels": total} if traced else None)
+        wsp.__enter__()
+        reader = None
         exited = False
         retired_end = 0
         try:
+            ranges = [(s, min(b, total - s)) for s in range(0, total, b)]
+            states = []
+            for _ in range(groups):
+                counts, carry = proving.init_hit_state(ng, cap)
+                if mesh is not None:
+                    from ..parallel import mesh as pmesh
+                    counts = pmesh.replicate(mesh, counts)
+                    carry = pmesh.replicate(mesh, carry)
+                states.append([counts, carry])
+            host_counts = np.zeros(ng * groups, dtype=np.int64)
+            inflight: deque = deque()  # (scanned_end, [batch counts])
+            reader = self.store.start_reader(ranges, self.readers,
+                                             self.reader_queue)
+            metrics.post_prove_windows.inc()
+            stats.windows += 1
             for start, count in ranges:
                 tr = time.perf_counter()
-                raw = reader.get()
+                with tracing.span("prove.read_wait",
+                                  {"window": nonce_base, "start": start}
+                                  if traced else None):
+                    raw = reader.get()
                 td = time.perf_counter()
                 stats.read_wait_s += td - tr
-                labels = np.frombuffer(raw, dtype=np.uint8).reshape(
-                    count, scrypt.LABEL_BYTES)
-                if count < b:  # pad-and-trim: one compiled shape per pass
-                    labels = np.concatenate([
-                        labels,
-                        np.zeros((b - count, scrypt.LABEL_BYTES), np.uint8)])
-                idx = np.arange(start, start + b, dtype=np.uint64)
-                lo, hi = scrypt.split_indices(idx)
-                lw = scrypt.labels_to_words(labels)
-                jlo, jhi, jlw = (jnp.asarray(lo), jnp.asarray(hi),
-                                 jnp.asarray(lw))
-                bcs = []
-                for g in range(groups):
-                    counts, carry = states[g]
-                    counts, bc, carry = step(
-                        cw, jnp.uint32(nonce_base + g * ng), jlo, jhi, jlw,
-                        thr, counts, carry, jnp.uint32(count),
-                        jnp.uint32(start & 0xFFFFFFFF),
-                        jnp.uint32(start >> 32))
-                    states[g] = [counts, carry]
-                    bcs.append(bc)
+                with tracing.span("prove.dispatch",
+                                  {"window": nonce_base, "start": start,
+                                   "count": count} if traced else None):
+                    labels = np.frombuffer(raw, dtype=np.uint8).reshape(
+                        count, scrypt.LABEL_BYTES)
+                    if count < b:  # pad-and-trim: one shape per pass
+                        labels = np.concatenate([
+                            labels,
+                            np.zeros((b - count, scrypt.LABEL_BYTES),
+                                     np.uint8)])
+                    idx = np.arange(start, start + b, dtype=np.uint64)
+                    lo, hi = scrypt.split_indices(idx)
+                    lw = scrypt.labels_to_words(labels)
+                    jlo, jhi, jlw = (jnp.asarray(lo), jnp.asarray(hi),
+                                     jnp.asarray(lw))
+                    bcs = []
+                    for g in range(groups):
+                        counts, carry = states[g]
+                        counts, bc, carry = step(
+                            cw, jnp.uint32(nonce_base + g * ng), jlo, jhi,
+                            jlw, thr, counts, carry, jnp.uint32(count),
+                            jnp.uint32(start & 0xFFFFFFFF),
+                            jnp.uint32(start >> 32))
+                        states[g] = [counts, carry]
+                        bcs.append(bc)
                 stats.dispatch_s += time.perf_counter() - td
                 stats.batches += 1
                 metrics.post_prove_batches.inc()
@@ -370,17 +398,21 @@ class Prover:
                 if len(inflight) >= self.inflight:
                     item = inflight.popleft()
                     retired_end = item[0]
-                    exited = self._retire(item, host_counts, total, stats)
+                    exited = self._retire(item, host_counts, total, stats,
+                                          nonce_base)
                     if exited:
                         break
             while not exited and inflight:
                 item = inflight.popleft()
                 retired_end = item[0]
-                exited = self._retire(item, host_counts, total, stats)
+                exited = self._retire(item, host_counts, total, stats,
+                                      nonce_base)
             scanned = retired_end if exited else total
         finally:
-            reader.close()
-            stats.read_io_s += reader.read_seconds
+            if reader is not None:
+                reader.close()
+                stats.read_io_s += reader.read_seconds
+            wsp.__exit__(None, None, None)
         if exited:
             metrics.post_prove_early_exits.inc()
             stats.early_exited = True
@@ -395,7 +427,8 @@ class Prover:
         metrics.post_prove_d2h_bytes.inc(carry.nbytes + counts.nbytes)
         return nonce_base + w, indices
 
-    def _retire(self, item, host_counts, total, stats) -> bool:
+    def _retire(self, item, host_counts, total, stats,
+                nonce_base: int = 0) -> bool:
         """Fetch one batch's per-nonce count vectors; True on sound early
         exit: some nonce has k2 hits and every lower nonce in the window
         provably cannot reach k2 with the labels left in this pass (lower
@@ -405,15 +438,26 @@ class Prover:
         p = self.params
         ng = self.nonce_group
         tr = time.perf_counter()
-        for g, bc in enumerate(bcs):
-            vec = np.asarray(bc)
-            host_counts[g * ng:(g + 1) * ng] += vec
-            stats.d2h_bytes += vec.nbytes
-            metrics.post_prove_d2h_bytes.inc(vec.nbytes)
+        with tracing.span("prove.retire",
+                          {"window": nonce_base, "end": scanned_end}
+                          if tracing.is_enabled() else None):
+            for g, bc in enumerate(bcs):
+                vec = np.asarray(bc)
+                host_counts[g * ng:(g + 1) * ng] += vec
+                stats.d2h_bytes += vec.nbytes
+                metrics.post_prove_d2h_bytes.inc(vec.nbytes)
         stats.retire_s += time.perf_counter() - tr
         qualified = host_counts >= p.k2
         if not qualified.any():
             return False
         w = int(np.argmax(qualified))
         remaining = total - scanned_end
-        return bool(np.all(host_counts[:w] + remaining < p.k2))
+        exit_now = bool(np.all(host_counts[:w] + remaining < p.k2))
+        if exit_now:
+            # the decision point the pipelined prover's speedup hinges
+            # on: mark it so a timeline shows WHERE the pass stopped
+            tracing.instant("prove.early_exit",
+                            {"window": nonce_base, "nonce": nonce_base + w,
+                             "scanned": scanned_end}
+                            if tracing.is_enabled() else None)
+        return exit_now

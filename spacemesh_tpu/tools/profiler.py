@@ -21,10 +21,14 @@ Usage:
   python -m spacemesh_tpu.tools.profiler --prove               # prove view
   python -m spacemesh_tpu.tools.profiler --verify-farm         # farm view
   python -m spacemesh_tpu.tools.profiler --romix --n 8192      # kernel view
+  python -m spacemesh_tpu.tools.profiler --timeline trace.json # flame view
 Prints ONE JSON document on stdout; progress goes to stderr. --pipeline
 runs a real (tiny) init through the streaming pipeline and dumps per-stage
 host seconds (dispatch/fetch/write/stall) so stalls are visible without a
-full profile (docs/POST_PIPELINE.md).
+full profile (docs/POST_PIPELINE.md). --timeline digests a span-trace
+export (``/debug/trace/export`` or utils/tracing.export_json): top spans
+by self-time plus a per-stage queue-wait vs work split, with the text
+flame summary on stderr (docs/OBSERVABILITY.md).
 """
 
 from __future__ import annotations
@@ -391,6 +395,27 @@ def verify_farm_benchmark(items: int = 256, probe: bool = True) -> dict:
     }
 
 
+def timeline_view(path: str, top: int = 20) -> dict:
+    """Digest a captured span trace (tools view over
+    utils/tracing.summarize): validates the trace-event JSON first, so a
+    truncated or hand-edited capture fails loudly, not with a nonsense
+    flame summary."""
+    from ..utils import tracing
+
+    with open(path, encoding="utf-8") as f:
+        doc = json.load(f)
+    tracing.validate(doc)
+    summary = tracing.summarize(doc, top=top)
+    _log(tracing.render_summary(summary))
+    other = doc.get("otherData", {})
+    return {
+        "trace": path,
+        "captured_spans": other.get("captured_spans"),
+        "dropped_spans": other.get("dropped_spans"),
+        **summary,
+    }
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="profiler",
@@ -440,9 +465,21 @@ def main(argv=None) -> int:
     ap.add_argument("--reps", type=int, default=3)
     ap.add_argument("--cpu-labels", type=int, default=16,
                     help="labels for the OpenSSL reference measurement")
+    ap.add_argument("--timeline", metavar="TRACE_JSON", default=None,
+                    help="summarize a span-trace export (top spans by "
+                    "self-time, per-stage wait-vs-work split) instead of "
+                    "benchmarking")
+    ap.add_argument("--timeline-top", type=int, default=20,
+                    help="rows in the --timeline self-time ranking")
     ap.add_argument("--no-probe", action="store_true",
                     help="skip the accelerator liveness probe (tests)")
     a = ap.parse_args(argv)
+
+    if a.timeline:
+        # pure file digestion: no accelerator probe, no jax import
+        print(json.dumps(timeline_view(a.timeline, top=a.timeline_top),
+                         indent=2))
+        return 0
 
     from ..utils import accel
 

@@ -12,6 +12,19 @@ import threading
 from collections import defaultdict
 
 
+def _escape(value) -> str:
+    """Escape a label VALUE per the Prometheus text exposition format:
+    backslash, double-quote and newline must be escaped inside the
+    quoted value, or one peer id / reason string containing a quote
+    corrupts the entire /metrics scrape."""
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _labelstr(labels) -> str:
+    return ",".join(f'{k}="{_escape(v)}"' for k, v in labels)
+
+
 class _Instrument:
     def __init__(self, name: str, help_: str):
         self.name = name
@@ -33,7 +46,7 @@ class Counter(_Instrument):
                f"# TYPE {self.name} counter"]
         with self._lock:
             for labels, v in self._values.items():
-                lbl = ",".join(f'{k}="{val}"' for k, val in labels)
+                lbl = _labelstr(labels)
                 out.append(f"{self.name}{{{lbl}}} {v}" if lbl
                            else f"{self.name} {v}")
         return out
@@ -53,39 +66,54 @@ class Gauge(_Instrument):
                f"# TYPE {self.name} gauge"]
         with self._lock:
             for labels, v in self._values.items():
-                lbl = ",".join(f'{k}="{val}"' for k, val in labels)
+                lbl = _labelstr(labels)
                 out.append(f"{self.name}{{{lbl}}} {v}" if lbl
                            else f"{self.name} {v}")
         return out
 
 
 class Histogram(_Instrument):
+    """Bucketed distribution with label support: each distinct labelset
+    carries its own buckets/sum/count series (like Counter/Gauge), so
+    e.g. verify-farm dispatch timings split per request kind instead of
+    blending signatures and POST proofs into one histogram."""
+
     DEFAULT_BUCKETS = (0.005, 0.05, 0.5, 5.0, 50.0, float("inf"))
 
     def __init__(self, name, help_="", buckets=DEFAULT_BUCKETS):
         super().__init__(name, help_)
         self.buckets = tuple(buckets)
-        self._counts = [0] * len(self.buckets)
-        self._sum = 0.0
-        self._n = 0
+        # labelset -> [per-bucket counts, sum, count]
+        self._series: dict[tuple, list] = {}
 
-    def observe(self, value: float) -> None:
+    def observe(self, value: float, **labels) -> None:
+        key = tuple(sorted(labels.items()))
         with self._lock:
-            self._sum += value
-            self._n += 1
+            s = self._series.get(key)
+            if s is None:
+                s = self._series[key] = [[0] * len(self.buckets), 0.0, 0]
+            s[1] += value
+            s[2] += 1
             for i, b in enumerate(self.buckets):
                 if value <= b:
-                    self._counts[i] += 1
+                    s[0][i] += 1
 
     def expose(self) -> list[str]:
         out = [f"# HELP {self.name} {self.help}",
                f"# TYPE {self.name} histogram"]
         with self._lock:
-            for b, c in zip(self.buckets, self._counts):
+            series = [(k, [list(s[0]), s[1], s[2]])
+                      for k, s in self._series.items()]
+        for labels, (counts, sum_, n) in series:
+            base = _labelstr(labels)
+            sep = "," if base else ""
+            for b, c in zip(self.buckets, counts):
                 le = "+Inf" if b == float("inf") else b
-                out.append(f'{self.name}_bucket{{le="{le}"}} {c}')
-            out.append(f"{self.name}_sum {self._sum}")
-            out.append(f"{self.name}_count {self._n}")
+                out.append(f'{self.name}_bucket{{{base}{sep}le="{le}"}} {c}')
+            out.append(f"{self.name}_sum{{{base}}} {sum_}" if base
+                       else f"{self.name}_sum {sum_}")
+            out.append(f"{self.name}_count{{{base}}} {n}" if base
+                       else f"{self.name}_count {n}")
         return out
 
 
@@ -219,7 +247,8 @@ verify_farm_batch_occupancy = REGISTRY.histogram(
     "verify_farm_batch_occupancy", "requests per dispatched batch",
     buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, float("inf")))
 verify_farm_dispatch_seconds = REGISTRY.histogram(
-    "verify_farm_dispatch_seconds", "backend seconds per batch",
+    "verify_farm_dispatch_seconds",
+    "backend seconds per batch (label: kind)",
     buckets=(0.001, 0.005, 0.02, 0.1, 0.5, 2.0, float("inf")))
 verify_farm_queue_depth = REGISTRY.gauge(
     "verify_farm_queue_depth", "pending requests (label: lane)")
@@ -230,3 +259,23 @@ verify_farm_queue_depth = REGISTRY.gauge(
 pubsub_handler_drops = REGISTRY.counter(
     "pubsub_handler_drops_total",
     "handler exceptions swallowed during delivery (label: topic)")
+
+# event bus (node/events.py): subscription overflow used to be a silent
+# per-subscription boolean — lossy API event streams were invisible until
+# a consumer noticed a sequence gap. The counter fires per dropped event
+# (label=type); the gauge tracks the DEEPEST subscription queue on each
+# emit, so a consumer falling behind shows up before it overflows.
+events_overflows = REGISTRY.counter(
+    "events_subscription_overflows_total",
+    "events dropped on full subscription queues (label: type)")
+events_queue_depth = REGISTRY.gauge(
+    "events_queue_depth",
+    "deepest subscription queue at the last emit")
+
+# span tracer (utils/tracing.py): capture state for operators reading
+# /metrics while a /debug/trace capture runs.
+trace_enabled_gauge = REGISTRY.gauge(
+    "trace_capture_enabled", "1 while the span tracer is recording")
+trace_spans_gauge = REGISTRY.gauge(
+    "trace_spans_recorded",
+    "spans recorded by the current capture (incl. ring overwrites)")

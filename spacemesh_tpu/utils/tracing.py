@@ -1,0 +1,453 @@
+"""Node-wide span tracing: causal timelines from gossip to TPU dispatch.
+
+The metrics registry (utils/metrics.py) answers *how much* — seconds per
+pipeline stage, batches per second. This module answers *which one and
+why then*: each unit of work (a gossip delivery, a verify-farm batch, a
+prove window, a ROMix kernel enqueue) records a **span** — name, wall
+interval, attributes, parent — into a bounded in-memory ring, and the
+whole capture exports as Chrome trace-event / Perfetto-compatible JSON
+so one init+prove+verify run reads as a single causal timeline in
+https://ui.perfetto.dev.
+
+Design constraints, in order:
+
+1. **Free when off.** Tracing is always compiled in but disabled by
+   default; the disabled ``span()`` call is one attribute load, one
+   branch, and the return of a module-singleton no-op context manager —
+   no dict, no object allocation, no clock read (asserted by a test).
+   Hot paths therefore call it unconditionally.
+2. **Fixed memory when on.** Completed spans land in a preallocated
+   ring of ``capacity`` slots; the writer index is an
+   ``itertools.count`` (atomic under the GIL — the "lock-free-ish"
+   part), so recording from pool threads takes no lock and a capture
+   can run for hours overwriting its own tail. Overwritten spans are
+   counted, not silently lost.
+3. **Causality across tasks and threads.** The current span travels
+   through ``contextvars`` — awaits, ``asyncio.to_thread`` and task
+   creation all inherit it. Long-lived worker threads (the label
+   writer/reader pools) cannot inherit a context, so ``current_id()``
+   lets the submitting side capture the parent explicitly and pass it
+   with the work item.
+
+Controls:
+
+* ``start(capacity=..)`` / ``stop()`` / ``export()`` — embedder API;
+  the HTTP server maps them to ``/debug/trace/start|stop|export``
+  (api/http.py).
+* ``SPACEMESH_TRACE`` — capture from boot: ``1``/``on`` starts the
+  tracer at import with the default ring; an integer value sets the
+  ring capacity.
+* ``SPACEMESH_TRACE_JAX`` — bridge each span into a
+  ``jax.profiler.TraceAnnotation`` so host spans line up with XLA
+  device traces inside a ``jax.profiler.trace()`` capture on TPU.
+
+Span linkage in the export: every event's ``args`` carries its ``id``
+and its ``parent`` id; cross-cutting links that are not parent/child
+(a verify-farm batch and its member requests) are recorded as explicit
+``args`` references (``batch``/``members``) — see docs/OBSERVABILITY.md
+for how to follow them in Perfetto.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import itertools
+import json
+import os
+import threading
+import time
+
+DEFAULT_CAPACITY = 65536
+
+# the current span id, inherited by child tasks/coroutines/to_thread
+_current: contextvars.ContextVar[int | None] = contextvars.ContextVar(
+    "spacemesh_trace_span", default=None)
+
+
+def current_id() -> int | None:
+    """The enclosing span's id (None when untraced/disabled) — for
+    handing to long-lived worker threads as an explicit parent."""
+    return _current.get()
+
+
+class _NopSpan:
+    """The disabled-path singleton: every operation is a no-op."""
+
+    __slots__ = ()
+    id = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    async def __aenter__(self):
+        return self
+
+    async def __aexit__(self, *exc):
+        return False
+
+    def set(self, **attrs):
+        return self
+
+
+_NOP = _NopSpan()
+
+
+class _Span:
+    """A live span: a context manager that records itself on exit."""
+
+    __slots__ = ("_tracer", "name", "cat", "attrs", "parent", "id",
+                 "_t0", "_token", "_ann")
+
+    def __init__(self, tracer: "Tracer", name: str, attrs, parent, cat):
+        self._tracer = tracer
+        self.name = name
+        self.cat = cat
+        self.attrs = attrs
+        self.parent = parent if parent is not None else _current.get()
+        self.id = next(tracer._ids)
+        self._ann = None
+
+    def set(self, **attrs):
+        """Attach/overwrite attributes on a live span."""
+        if self.attrs is None:
+            self.attrs = attrs
+        else:
+            self.attrs.update(attrs)
+        return self
+
+    def __enter__(self):
+        self._token = _current.set(self.id)
+        tracer = self._tracer
+        if tracer.jax_bridge:
+            try:
+                from jax import profiler as _jprof
+
+                self._ann = _jprof.TraceAnnotation(self.name)
+                self._ann.__enter__()
+            except Exception:  # noqa: BLE001 — bridge is best-effort
+                tracer.jax_bridge = False
+                self._ann = None
+        self._t0 = time.perf_counter_ns()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        t1 = time.perf_counter_ns()
+        if self._ann is not None:
+            try:
+                self._ann.__exit__(exc_type, exc, tb)
+            except Exception:  # noqa: BLE001
+                pass
+        _current.reset(self._token)
+        self._tracer._record(self.name, self.cat, self._t0 // 1000,
+                             (t1 - self._t0) // 1000, self.id, self.parent,
+                             self.attrs, "X")
+        return False
+
+    # spans bracket awaits too; the sync protocol does the work
+    async def __aenter__(self):
+        return self.__enter__()
+
+    async def __aexit__(self, exc_type, exc, tb):
+        return self.__exit__(exc_type, exc, tb)
+
+
+class Tracer:
+    """A bounded-ring span recorder. One module-level instance (TRACER)
+    serves the whole process; tests may build private ones."""
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY):
+        self.enabled = False
+        self.capacity = max(int(capacity), 16)
+        self.jax_bridge = False
+        self._ids = itertools.count(1)
+        self._buf: list = []
+        self._slots = itertools.count()
+        self._recorded = 0  # approximate under thread races; display only
+        self._tid_names: dict[int, str] = {}
+        self._started_at: float | None = None
+
+    # --- lifecycle ----------------------------------------------------
+
+    def start(self, capacity: int | None = None,
+              jax_bridge: bool | None = None) -> None:
+        """(Re)start a capture with a fresh ring. Idempotent-ish: a
+        second start resets the buffer (a new capture window)."""
+        if capacity is not None:
+            self.capacity = max(int(capacity), 16)
+        if jax_bridge is None:
+            jax_bridge = os.environ.get(
+                "SPACEMESH_TRACE_JAX", "") not in ("", "0", "off")
+        self.jax_bridge = bool(jax_bridge)
+        self._buf = [None] * self.capacity
+        self._slots = itertools.count()
+        self._recorded = 0
+        self._tid_names = {}
+        self._started_at = time.time()
+        self.enabled = True
+
+    def stop(self) -> int:
+        """Stop recording; the ring stays exportable. Returns the number
+        of spans retained."""
+        self.enabled = False
+        return min(self._recorded, self.capacity)
+
+    def recorded(self) -> int:
+        """Spans recorded since start (including overwritten ones)."""
+        return self._recorded
+
+    # --- recording ----------------------------------------------------
+
+    def _record(self, name, cat, ts_us, dur_us, span_id, parent,
+                attrs, ph) -> None:
+        if not self.enabled:
+            return  # stopped while the span was open
+        tid = threading.get_ident()
+        if tid not in self._tid_names:
+            self._tid_names[tid] = threading.current_thread().name
+        slot = next(self._slots)
+        # ring write: a racing slot under heavy thread contention can
+        # momentarily resurrect an older record — acceptable for a
+        # diagnostic ring, and the GIL makes the list store atomic.
+        # Snapshot the buffer and mod by ITS length: a concurrent
+        # start() swapping in a different-capacity ring must never
+        # index a pool thread out of bounds mid-record
+        buf = self._buf
+        if not buf:
+            return
+        buf[slot % len(buf)] = (
+            name, cat, ts_us, dur_us, tid, span_id, parent, attrs, ph)
+        self._recorded += 1
+
+    def instant(self, name: str, attrs=None, cat: str = "host") -> None:
+        """A zero-duration marker event (decision points, state flips)."""
+        if not self.enabled:
+            return
+        self._record(name, cat, time.perf_counter_ns() // 1000, 0,
+                     next(self._ids), _current.get(), attrs, "i")
+
+    def span(self, name: str, attrs=None, parent=None, cat: str = "host"):
+        if not self.enabled:
+            return _NOP
+        return _Span(self, name, attrs, parent, cat)
+
+    # --- export -------------------------------------------------------
+
+    def export(self) -> dict:
+        """The capture as a Chrome trace-event / Perfetto JSON object."""
+        total = self._recorded
+        pid = os.getpid()
+        events = []
+        for tid, tname in sorted(self._tid_names.items()):
+            events.append({"name": "thread_name", "ph": "M", "pid": pid,
+                           "tid": tid, "args": {"name": tname}})
+        recs = [r for r in self._buf if r is not None]
+        recs.sort(key=lambda r: r[2])  # ring order != time order
+        for (name, cat, ts, dur, tid, span_id, parent, attrs, ph) in recs:
+            args = {"id": span_id}
+            if parent is not None:
+                args["parent"] = parent
+            if attrs:
+                args.update(attrs)
+            ev = {"name": name, "cat": cat, "ph": ph, "ts": ts,
+                  "pid": pid, "tid": tid, "args": args}
+            if ph == "X":
+                ev["dur"] = dur
+            elif ph == "i":
+                ev["s"] = "t"
+            events.append(ev)
+        return {
+            "traceEvents": events,
+            "displayTimeUnit": "ms",
+            "otherData": {
+                "tool": "spacemesh_tpu.utils.tracing",
+                "captured_spans": len(recs),
+                "dropped_spans": max(0, total - len(recs)),
+                "capacity": self.capacity,
+                "started_at_unix": self._started_at,
+            },
+        }
+
+
+TRACER = Tracer()
+
+
+# --- module-level convenience API (what instrumented code calls) --------
+
+
+def is_enabled() -> bool:
+    return TRACER.enabled
+
+
+def span(name: str, attrs=None, parent=None, cat: str = "host"):
+    """A span context manager, or the no-op singleton when disabled.
+
+    ``attrs`` is an optional dict the caller builds (kept positional so
+    the disabled path never materializes a kwargs dict). ``parent``
+    overrides the contextvar parent — for work crossing into long-lived
+    pool threads, pair with ``current_id()``.
+    """
+    if not TRACER.enabled:
+        return _NOP
+    return _Span(TRACER, name, attrs, parent, cat)
+
+
+def instant(name: str, attrs=None, cat: str = "host") -> None:
+    if TRACER.enabled:
+        TRACER.instant(name, attrs, cat)
+
+
+def start(capacity: int | None = None, jax_bridge: bool | None = None) -> None:
+    TRACER.start(capacity, jax_bridge)
+
+
+def stop() -> int:
+    return TRACER.stop()
+
+
+def export() -> dict:
+    return TRACER.export()
+
+
+def export_json(path: str) -> dict:
+    """Export and write to ``path``; returns the document."""
+    doc = TRACER.export()
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(doc, f)
+    return doc
+
+
+# --- validation (tests + the CI trace-smoke job) ------------------------
+
+_PHASES = {"X", "B", "E", "i", "M", "s", "f"}
+_REQUIRED = ("name", "ph", "pid", "tid")
+
+
+def validate(doc) -> None:
+    """Raise ValueError unless ``doc`` is structurally valid trace-event
+    JSON: required keys present, known phases, non-negative monotonic
+    ``ts`` within the stream, ``dur`` on complete (X) events, and
+    matched B/E pairs per (pid, tid) if any are used."""
+    if not isinstance(doc, dict) or not isinstance(
+            doc.get("traceEvents"), list):
+        raise ValueError("trace document must be {'traceEvents': [...]}")
+    last_ts = None
+    stacks: dict[tuple, list] = {}
+    for i, ev in enumerate(doc["traceEvents"]):
+        if not isinstance(ev, dict):
+            raise ValueError(f"event {i}: not an object")
+        for k in _REQUIRED:
+            if k not in ev:
+                raise ValueError(f"event {i}: missing key {k!r}")
+        ph = ev["ph"]
+        if ph not in _PHASES:
+            raise ValueError(f"event {i}: unknown phase {ph!r}")
+        if ph == "M":
+            continue  # metadata events carry no timestamp contract
+        ts = ev.get("ts")
+        if not isinstance(ts, (int, float)) or ts < 0:
+            raise ValueError(f"event {i}: bad ts {ts!r}")
+        if last_ts is not None and ts < last_ts:
+            raise ValueError(f"event {i}: ts went backwards "
+                             f"({ts} < {last_ts})")
+        last_ts = ts
+        if ph == "X":
+            dur = ev.get("dur")
+            if not isinstance(dur, (int, float)) or dur < 0:
+                raise ValueError(f"event {i}: X event with bad dur {dur!r}")
+        elif ph == "B":
+            stacks.setdefault((ev["pid"], ev["tid"]), []).append(ev["name"])
+        elif ph == "E":
+            stack = stacks.setdefault((ev["pid"], ev["tid"]), [])
+            if not stack:
+                raise ValueError(f"event {i}: E without matching B")
+            stack.pop()
+    for key, stack in stacks.items():
+        if stack:
+            raise ValueError(f"unclosed B events on {key}: {stack}")
+
+
+# --- text flame summary (tools/profiler.py --timeline) ------------------
+
+_WAIT_MARKERS = ("wait", "stall", "queue", "idle", "block")
+
+
+def summarize(doc, top: int = 20) -> dict:
+    """Digest an exported trace: top spans by self-time (duration minus
+    nested child spans on the same thread) and a per-stage queue-wait vs
+    work split. The stage is the span name's dotted prefix ("prove" for
+    "prove.read_wait"); wait spans are named with one of
+    {wait, stall, queue, idle, block}."""
+    per_tid: dict[tuple, list] = {}
+    for ev in doc.get("traceEvents", ()):
+        if ev.get("ph") == "X":
+            per_tid.setdefault((ev["pid"], ev["tid"]), []).append(ev)
+    totals: dict[str, dict] = {}
+    stages: dict[str, dict] = {}
+    for evs in per_tid.values():
+        evs.sort(key=lambda e: (e["ts"], -e.get("dur", 0)))
+        stack: list = []  # (end_ts, name, child_dur_acc as 1-item list)
+        for ev in evs:
+            ts, dur = ev["ts"], ev.get("dur", 0)
+            while stack and stack[-1][0] <= ts:
+                stack.pop()
+            if stack:
+                stack[-1][2][0] += dur
+            stack.append((ts + dur, ev["name"], [0]))
+            # self time settles when the span pops; accumulate eagerly
+            # by recording the entry and fixing it up below
+            ev["_children"] = stack[-1][2]
+    for evs in per_tid.values():
+        for ev in evs:
+            name = ev["name"]
+            dur = ev.get("dur", 0)
+            self_us = max(dur - ev.pop("_children")[0], 0)
+            t = totals.setdefault(name, {"count": 0, "total_us": 0,
+                                         "self_us": 0})
+            t["count"] += 1
+            t["total_us"] += dur
+            t["self_us"] += self_us
+            stage = name.split(".", 1)[0]
+            s = stages.setdefault(stage, {"wait_us": 0, "work_us": 0})
+            leaf = name.rsplit(".", 1)[-1]
+            if any(m in leaf for m in _WAIT_MARKERS):
+                s["wait_us"] += self_us
+            else:
+                s["work_us"] += self_us
+    ranked = sorted(totals.items(), key=lambda kv: -kv[1]["self_us"])
+    return {
+        "spans": len([1 for evs in per_tid.values() for _ in evs]),
+        "top_self_time": [{"name": k, **v} for k, v in ranked[:top]],
+        "stages": {k: {**v,
+                       "wait_frac": round(v["wait_us"]
+                                          / max(v["wait_us"] + v["work_us"],
+                                                1), 3)}
+                   for k, v in sorted(stages.items())},
+    }
+
+
+def render_summary(summary: dict) -> str:
+    """A terminal-friendly flame digest of ``summarize()``'s output."""
+    lines = [f"{'span':<36} {'count':>7} {'total ms':>10} {'self ms':>10}"]
+    for row in summary["top_self_time"]:
+        lines.append(f"{row['name']:<36} {row['count']:>7} "
+                     f"{row['total_us'] / 1000:>10.2f} "
+                     f"{row['self_us'] / 1000:>10.2f}")
+    lines.append("")
+    lines.append(f"{'stage':<12} {'work ms':>10} {'wait ms':>10} "
+                 f"{'wait %':>7}")
+    for stage, s in summary["stages"].items():
+        lines.append(f"{stage:<12} {s['work_us'] / 1000:>10.2f} "
+                     f"{s['wait_us'] / 1000:>10.2f} "
+                     f"{100 * s['wait_frac']:>6.1f}%")
+    return "\n".join(lines)
+
+
+# --- capture-from-boot (SPACEMESH_TRACE) --------------------------------
+
+_boot = os.environ.get("SPACEMESH_TRACE", "")
+if _boot and _boot.lower() not in ("0", "off", "false", "none"):
+    start(capacity=int(_boot) if _boot.isdigit() and int(_boot) > 1
+          else None)

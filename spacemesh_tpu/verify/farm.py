@@ -49,7 +49,7 @@ from typing import Optional
 from ..core.signing import EdVerifier, VrfVerifier
 from ..post import verifier as post_verifier
 from ..post.prover import ProofParams
-from ..utils import metrics
+from ..utils import metrics, tracing
 
 
 class FarmClosed(RuntimeError):
@@ -133,7 +133,7 @@ class PostRequest:
 
 
 class _Pending:
-    __slots__ = ("req", "lane", "future", "enqueued", "deadline")
+    __slots__ = ("req", "lane", "future", "enqueued", "deadline", "span")
 
     def __init__(self, req, lane: Lane, future: asyncio.Future,
                  enqueued: float, deadline: float):
@@ -142,6 +142,7 @@ class _Pending:
         self.future = future
         self.enqueued = enqueued
         self.deadline = deadline
+        self.span = tracing._NOP  # the submitter's request span
 
 
 class _KindState:
@@ -318,8 +319,42 @@ class VerificationFarm:
                 # twin's lane position (a block-critical check stuck
                 # behind a sync backlog would defeat the lane contract)
                 self._promote(ent, lane)
-            return await self._await(ent.future)
-        # backpressure: a full lane blocks ITS OWN submitters only
+            # the twin's request span owns the lifecycle; this caller's
+            # span just records that it coalesced onto it
+            async with tracing.span(
+                    "farm.request",
+                    {"kind": req.kind, "lane": lane.name.lower(),
+                     "dedup": True, "twin": ent.span.id}
+                    if tracing.is_enabled() else None):
+                return await self._await(ent.future)
+        sp = tracing.span("farm.request",
+                          {"kind": req.kind, "lane": lane.name.lower()}
+                          if tracing.is_enabled() else None)
+        with sp:
+            # backpressure: a full lane blocks ITS OWN submitters only
+            if self._lane_count[lane] >= self.lane_bounds[lane]:
+                async with tracing.span("farm.lane_wait",
+                                        {"lane": lane.name.lower()}
+                                        if tracing.is_enabled() else None):
+                    await self._wait_for_lane(lane)
+            now = self._loop.time()
+            pend = _Pending(req, lane, self._loop.create_future(), now,
+                            now + self.max_wait_s[lane])
+            pend.span = sp
+            st = self._kinds[req.kind]
+            st.lanes[lane].append(pend)
+            self._lane_count[lane] += 1
+            depth = self._lane_count[lane]
+            lname = lane.name.lower()
+            if depth > self.stats["queue_peak"][lname]:
+                self.stats["queue_peak"][lname] = depth
+            metrics.verify_farm_queue_depth.set(depth, lane=lname)
+            self._dedup[key] = pend
+            self._ensure_worker(req.kind)
+            st.arrived.set()
+            return await self._await(pend.future)
+
+    async def _wait_for_lane(self, lane: Lane) -> None:
         while self._lane_count[lane] >= self.lane_bounds[lane]:
             waiter = self._loop.create_future()
             self._lane_waiters[lane].append(waiter)
@@ -338,21 +373,6 @@ class VerificationFarm:
                 raise
             if self._closed:
                 raise FarmClosed("farm closed")
-        now = self._loop.time()
-        pend = _Pending(req, lane, self._loop.create_future(), now,
-                        now + self.max_wait_s[lane])
-        st = self._kinds[req.kind]
-        st.lanes[lane].append(pend)
-        self._lane_count[lane] += 1
-        depth = self._lane_count[lane]
-        lname = lane.name.lower()
-        if depth > self.stats["queue_peak"][lname]:
-            self.stats["queue_peak"][lname] = depth
-        metrics.verify_farm_queue_depth.set(depth, lane=lname)
-        self._dedup[key] = pend
-        self._ensure_worker(req.kind)
-        st.arrived.set()
-        return await self._await(pend.future)
 
     @staticmethod
     async def _await(fut: asyncio.Future) -> bool:
@@ -461,14 +481,27 @@ class VerificationFarm:
                 return
 
     def _on_taken(self, batch: list[_Pending]) -> None:
+        now = self._loop.time()
         for p in batch:
             self._release_lane(p.lane)
+            p.span.set(queue_wait_ms=round((now - p.enqueued) * 1e3, 3))
 
     async def _dispatch(self, kind: str, batch: list[_Pending]) -> None:
+        # the batch span is the hub of the capture: its args carry the
+        # member request-span ids, and each member span records the
+        # batch id back — so in a Perfetto export a request's wall time
+        # decomposes into lane wait vs its batch's backend dispatch
+        bsp = tracing.span("farm.batch",
+                           {"kind": kind, "n": len(batch),
+                            "members": [p.span.id for p in batch]}
+                           if tracing.is_enabled() else None)
+        for p in batch:
+            p.span.set(batch=bsp.id)
         t0 = time.perf_counter()
         try:
-            results = await asyncio.to_thread(
-                self._run_backend, kind, [p.req for p in batch])
+            with bsp:
+                results = await asyncio.to_thread(
+                    self._run_backend, kind, [p.req for p in batch])
         except Exception as exc:  # noqa: BLE001 — fail the batch, not the farm
             for p in batch:
                 if not p.future.done():
@@ -491,7 +524,7 @@ class VerificationFarm:
             self.stats["dispatch_s"] += dt
             metrics.verify_farm_batches.inc(kind=kind)
             metrics.verify_farm_batch_occupancy.observe(len(batch))
-            metrics.verify_farm_dispatch_seconds.observe(dt)
+            metrics.verify_farm_dispatch_seconds.observe(dt, kind=kind)
 
     # --- backends (run in a worker thread) ----------------------------
 

@@ -23,6 +23,11 @@ if "xla_force_host_platform_device_count" not in _flags:
 
 import tempfile  # noqa: E402
 
+# a developer shell with SPACEMESH_TRACE set must not arm the span
+# tracer for the whole suite (tests that want a capture call
+# tracing.start() themselves — tests/test_tracing.py)
+os.environ.pop("SPACEMESH_TRACE", None)
+
 # the ROMix autotuner (ops/autotune.py) must stay deterministic and cheap
 # under test: no implicit candidate races, and never persist winners into
 # the developer's real cache root. The autotune tests opt back in with

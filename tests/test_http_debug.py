@@ -1,0 +1,248 @@
+"""The pprof-analogue debug surface (api/http.py /debug/*), the full
+/metrics exposition (parsed line by line — this is the test that catches
+label-escaping corruption), the span-trace capture endpoints, labeled
+histograms, and the event-bus overflow instruments."""
+
+import asyncio
+import math
+import re
+from types import SimpleNamespace
+
+import pytest
+from aiohttp import ClientSession
+
+from spacemesh_tpu.api.http import ApiServer
+from spacemesh_tpu.storage import db as dbmod
+from spacemesh_tpu.utils import metrics as metrics_mod
+from spacemesh_tpu.utils import tracing
+
+
+# --- a strict Prometheus text-format parser ---------------------------
+
+_LINE = re.compile(
+    r'^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{(.*)\})? (-?(?:[0-9.eE+-]+|inf|nan))$')
+
+
+def _parse_labels(s: str) -> dict:
+    """Parse a label block honoring the exposition-format escapes
+    (\\\\, \\", \\n). Raises on anything malformed — an unescaped quote
+    or newline in a label value fails this parser the way it fails a
+    real Prometheus scrape."""
+    out = {}
+    i = 0
+    while i < len(s):
+        eq = s.index("=", i)
+        name = s[i:eq]
+        if not re.fullmatch(r"[a-zA-Z_][a-zA-Z0-9_]*", name):
+            raise ValueError(f"bad label name {name!r}")
+        if s[eq + 1] != '"':
+            raise ValueError("label value not quoted")
+        k = eq + 2
+        val = []
+        while s[k] != '"':
+            if s[k] == "\\":
+                val.append({"\\": "\\", '"': '"', "n": "\n"}[s[k + 1]])
+                k += 2
+            else:
+                val.append(s[k])
+                k += 1
+        out[name] = "".join(val)
+        i = k + 1
+        if i < len(s):
+            if s[i] != ",":
+                raise ValueError(f"junk after label value: {s[i:]!r}")
+            i += 1
+    return out
+
+
+def parse_exposition(text: str) -> list[tuple[str, dict, float]]:
+    """Parse a full exposition; every non-comment line must be a valid
+    sample or the whole scrape is considered corrupt."""
+    samples = []
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        m = _LINE.match(line)
+        if m is None:
+            raise ValueError(f"unparseable sample line: {line!r}")
+        name, labels, value = m.groups()
+        samples.append((name, _parse_labels(labels) if labels else {},
+                        float(value)))
+    return samples
+
+
+# --- unit: escaping + labeled histograms ------------------------------
+
+EVIL = 'say "hi"\nback\\slash'
+
+
+def test_label_escaping_counter_gauge_histogram():
+    reg = metrics_mod.Registry()
+    reg.counter("c").inc(peer=EVIL)
+    reg.gauge("g").set(2.0, reason=EVIL)
+    reg.histogram("h", buckets=(1.0, float("inf"))).observe(0.5, kind=EVIL)
+    samples = parse_exposition(reg.expose())
+    by_name = {}
+    for name, labels, value in samples:
+        by_name.setdefault(name, []).append((labels, value))
+    assert by_name["c"][0][0]["peer"] == EVIL
+    assert by_name["g"][0][0]["reason"] == EVIL
+    for labels, _ in by_name["h_bucket"]:
+        assert labels["kind"] == EVIL
+    assert by_name["h_count"][0] == ({"kind": EVIL}, 1.0)
+
+
+def test_histogram_per_labelset_series():
+    reg = metrics_mod.Registry()
+    h = reg.histogram("lat", buckets=(0.01, 1.0, float("inf")))
+    h.observe(0.005, kind="sig")
+    h.observe(0.5, kind="sig")
+    h.observe(100.0, kind="post")
+    h.observe(0.002)  # label-free series coexists
+    samples = parse_exposition(reg.expose())
+    sig_buckets = {lbl["le"]: v for n, lbl, v in samples
+                   if n == "lat_bucket" and lbl.get("kind") == "sig"}
+    post_buckets = {lbl["le"]: v for n, lbl, v in samples
+                    if n == "lat_bucket" and lbl.get("kind") == "post"}
+    bare = {lbl.get("le"): v for n, lbl, v in samples
+            if n == "lat_bucket" and "kind" not in lbl}
+    assert sig_buckets == {"0.01": 1.0, "1.0": 2.0, "+Inf": 2.0}
+    assert post_buckets == {"0.01": 0.0, "1.0": 0.0, "+Inf": 1.0}
+    assert bare == {"0.01": 1.0, "1.0": 1.0, "+Inf": 1.0}
+    counts = {tuple(sorted(lbl.items())): v for n, lbl, v in samples
+              if n == "lat_count"}
+    assert counts[(("kind", "sig"),)] == 2.0
+    assert counts[(("kind", "post"),)] == 1.0
+    assert counts[()] == 1.0
+    sums = {tuple(sorted(lbl.items())): v for n, lbl, v in samples
+            if n == "lat_sum"}
+    assert math.isclose(sums[(("kind", "sig"),)], 0.505)
+
+
+def test_farm_dispatch_histogram_carries_kind():
+    """The migrated instrument: batch timings split per request kind
+    instead of blending signatures and POST proofs."""
+    metrics_mod.verify_farm_dispatch_seconds.observe(0.003, kind="sig")
+    metrics_mod.verify_farm_dispatch_seconds.observe(1.5, kind="post")
+    text = "\n".join(metrics_mod.verify_farm_dispatch_seconds.expose())
+    samples = parse_exposition(text)
+    kinds = {lbl.get("kind") for _, lbl, _ in samples}
+    assert {"sig", "post"} <= kinds
+
+
+def test_event_bus_overflow_metrics():
+    from spacemesh_tpu.node import events as events_mod
+
+    async def run():
+        bus = events_mod.EventBus()
+        sub = bus.subscribe(events_mod.LayerUpdate, size=2)
+        before = dict(metrics_mod.events_overflows._values)
+        for i in range(5):
+            bus.emit(events_mod.LayerUpdate(layer=i, status="tick"))
+        assert sub.overflowed
+        key = (("type", "LayerUpdate"),)
+        dropped = (metrics_mod.events_overflows._values.get(key, 0)
+                   - before.get(key, 0))
+        assert dropped == 3
+        # depth gauge saw the full queue
+        assert metrics_mod.events_queue_depth._values.get(()) == 2
+        sub.close()
+
+    asyncio.run(run())
+
+
+# --- the live HTTP surface --------------------------------------------
+
+
+@pytest.fixture()
+def stub_api(tmp_path):
+    """An ApiServer over a stub node: enough attributes for /metrics,
+    and the /debug endpoints need none at all — so this fixture stays
+    orders of magnitude lighter than a full App."""
+    state = dbmod.open_state(tmp_path / "state.db")
+    node = SimpleNamespace(
+        clock=SimpleNamespace(current_layer=lambda: 7),
+        tortoise=SimpleNamespace(verified=3, mode=0),
+        state=state, server=None, syncer=None)
+    api = ApiServer(node, listen="127.0.0.1:0")
+    yield api
+    state.close()
+
+
+def _with_server(api, coro):
+    async def run():
+        port = await api.start()
+        base = f"http://127.0.0.1:{port}"
+        try:
+            async with ClientSession() as s:
+                return await coro(s, base)
+        finally:
+            await api.stop()
+
+    return asyncio.run(run())
+
+
+def test_debug_stacks_and_profile(stub_api):
+    async def go(s, base):
+        stacks = await (await s.get(f"{base}/debug/stacks")).text()
+        prof_r = await s.get(f"{base}/debug/profile?seconds=0.1")
+        prof = await prof_r.text()
+        bad = (await s.get(f"{base}/debug/profile?seconds=abc")).status
+        return stacks, prof_r.status, prof, bad
+
+    stacks, prof_status, prof, bad = _with_server(stub_api, go)
+    assert "--- thread" in stacks and "asyncio tasks" in stacks
+    # the dump names at least this test's own frames
+    assert "test_http_debug" in stacks or "pytest" in stacks
+    assert prof_status == 200
+    assert "cumulative" in prof and "function calls" in prof
+    assert bad == 400
+
+
+def test_metrics_full_exposition_parses(stub_api):
+    # poison the registry with exactly the values that used to corrupt
+    # the scrape: quotes, newlines and backslashes in label values
+    metrics_mod.pubsub_handler_drops.inc(topic=EVIL)
+    metrics_mod.verify_farm_dispatch_seconds.observe(0.01, kind="sig")
+
+    async def go(s, base):
+        r = await s.get(f"{base}/metrics")
+        return r.status, await r.text()
+
+    status, text = _with_server(stub_api, go)
+    assert status == 200
+    samples = parse_exposition(text)  # raises on any corrupt line
+    names = {n for n, _, _ in samples}
+    assert "node_current_layer" in names
+    assert "verify_farm_dispatch_seconds_bucket" in names
+    evil = [lbl for n, lbl, _ in samples
+            if n == "pubsub_handler_drops_total" and lbl.get("topic") == EVIL]
+    assert evil, "escaped label value did not round-trip the scrape"
+
+
+def test_trace_capture_endpoints(stub_api):
+    tracing.stop()
+
+    async def go(s, base):
+        started = await (await s.post(
+            f"{base}/debug/trace/start?capacity=512")).json()
+        assert started["enabled"] and started["capacity"] == 512
+        with tracing.span("api.test_span", {"k": 1}):
+            pass
+        doc = await (await s.get(f"{base}/debug/trace/export")).json()
+        stopped = await (await s.post(f"{base}/debug/trace/stop")).json()
+        bad = (await s.get(
+            f"{base}/debug/trace/start?capacity=zap")).status
+        return doc, stopped, bad
+
+    try:
+        doc, stopped, bad = _with_server(stub_api, go)
+    finally:
+        tracing.stop()
+    tracing.validate(doc)
+    assert any(e["name"] == "api.test_span"
+               for e in doc["traceEvents"])
+    assert stopped["enabled"] is False
+    assert stopped["spans_recorded"] >= 1
+    assert bad == 400
+    assert not tracing.is_enabled()
