@@ -290,7 +290,8 @@ class ProposalHandler:
                 except Exception:
                     pass
                 ref = ballotstore.get(self.db, ballot.ref_ballot)
-            epoch_data = ballotstore.resolve_epoch_data(self.db, ballot)
+            epoch_data = ballotstore.resolve_epoch_data(
+                self.db, ballot, self.layers_per_epoch)
             if epoch_data is None:
                 return False
             bound = epoch_data.eligibility_count if trusted \

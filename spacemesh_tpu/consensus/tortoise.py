@@ -745,7 +745,8 @@ class Tortoise:
                 # (already-validated) ref-ballot eligibility count
                 # bounds the per-eligibility weight on trusted
                 # networks, the local recomputation otherwise
-                epoch_data = ballotstore.resolve_epoch_data(db, ballot)
+                epoch_data = ballotstore.resolve_epoch_data(
+                    db, ballot, layers_per_epoch)
                 if epoch_data is not None and oracle.trusts_declared(epoch):
                     num = epoch_data.eligibility_count
                 else:

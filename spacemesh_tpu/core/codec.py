@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import dataclasses
 import io
+import sys
 from typing import Any, Callable
 
 
@@ -40,6 +41,10 @@ class Codec:
 
 
 def _read(r, n: int) -> bytes:
+    if n > sys.maxsize:
+        # a lying length prefix from the network must reject, not crash:
+        # io.BytesIO.read raises OverflowError past index size
+        raise DecodeError(f"implausible length {n}")
     b = r.read(n)
     if len(b) != n:
         raise DecodeError(f"unexpected EOF: wanted {n} bytes, got {len(b)}")
@@ -82,7 +87,9 @@ def _compact_dec(r) -> int:
         if not (b & 0x80):
             if b == 0 and shift != 0:
                 raise DecodeError("non-minimal compact encoding")
-            if shift > 63:
+            if shift > 63 or out >= 1 << 64:
+                # the shift guard alone leaks values up to ~2^70: the
+                # final byte lands at shift 63 with 7 bits of payload
                 raise DecodeError("compact overflows u64")
             return out
         shift += 7

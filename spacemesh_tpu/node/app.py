@@ -503,7 +503,17 @@ class App:
             missing = [a for a in ids
                        if atxstore.get(self.state, a) is None]
             if missing:
-                await self.fetch.get_hashes(fetch_mod.HINT_ATX, missing)
+                got = await self.fetch.get_hashes(fetch_mod.HINT_ATX,
+                                                  missing)
+                if not all(got.get(a) for a in missing):
+                    # partial member fetch must REJECT the set blob:
+                    # storing it would make fetch_active_set treat the
+                    # root as resolved and never re-fetch, wedging ref-
+                    # ballot validation until epoch ATX sync happens to
+                    # deliver the stragglers (ADVICE r5). Returning
+                    # False leaves the root unresolved so the next
+                    # ballot retries the whole fetch+validate.
+                    return False
             # epoch unknown at fetch time: -1 keeps the row out of the
             # pruner's epoch-horizon deletes (it prunes epoch>=0 only)
             miscstore.add_active_set(self.state, set_id, -1, ids)

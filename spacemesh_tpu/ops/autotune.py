@@ -13,16 +13,33 @@ post/prover.py's scan, parallel/mesh.py, bench.py, tools/profiler.py —
 picks up the tuned kernel with zero configuration, and a second process
 on the same host skips the race entirely.
 
+The grid has a MESH dimension (docs/ROMIX_KERNEL.md): on hosts exposing
+more than one device — notably the CPU fallback's virtual host devices
+(``--xla_force_host_platform_device_count``, which every test/driver
+entry point already forces to 8) — the race also times the label kernel
+lane-sharded over {2, 4, 8} devices via parallel/mesh.py. The
+diagonal-vector Salsa program is op-dispatch-bound on XLA:CPU, so N
+sequential per-device streams routinely beat one device's intra-op
+parallelism (measured 3.2x at mainnet N on a 2-core host); whether and
+at how many devices that trade wins is exactly what the race persists.
+Mesh-aware callers (post/initializer.py, post/prover.py, bench.py) pass
+``max_devices=None`` and route batches through the mesh when the winner
+says so; shape-bound callers keep the default ``max_devices=1`` and are
+served the best single-device row of the same measurements.
+
 Decision precedence (highest first):
 
 1. env overrides — ``SPACEMESH_ROMIX`` (``xla`` | ``xla-rows`` |
    ``pallas``) forces the implementation, ``SPACEMESH_ROMIX_CHUNK``
    (lanes per sequential V chunk; ``0``/``off`` = unchunked) forces the
-   chunk; either beats a cached winner;
-2. the persisted winner for ``(platform, N, batch)``;
+   chunk, ``SPACEMESH_MESH`` forces the device count (``0``/``off`` = 1,
+   ``1``/``on`` = every visible device, an integer >= 2 = exactly that
+   many); any of them beats a cached winner;
+2. the persisted winner for ``(platform, N, batch, device cap)``;
 3. a race (disable with ``SPACEMESH_ROMIX_AUTOTUNE=off``, e.g. in
    latency-sensitive tests), whose result is persisted;
-4. a static heuristic default (race disabled or impossible).
+4. a static heuristic default (race disabled or impossible): the plain
+   single-device XLA kernel.
 
 Cache file: ``<cache root>/romix_autotune.json`` (cache root is the
 parent of accel.DEFAULT_CACHE_DIR, i.e. ``~/.cache/spacemesh_tpu``;
@@ -41,13 +58,15 @@ import time
 
 import numpy as np
 
-SCHEMA = 1
+SCHEMA = 2  # v2: rows/winners carry a "devices" mesh dimension
 IMPLS = ("xla", "xla-rows", "pallas")
+MAX_MESH_DEVICES = 8  # the raced device-count grid is {1, 2, 4, 8}
 
 ENV_IMPL = "SPACEMESH_ROMIX"
 ENV_CHUNK = "SPACEMESH_ROMIX_CHUNK"
 ENV_AUTOTUNE = "SPACEMESH_ROMIX_AUTOTUNE"
 ENV_CACHE = "SPACEMESH_ROMIX_CACHE"
+ENV_MESH = "SPACEMESH_MESH"  # shared with post/initializer.py + prover
 
 # calibration workload: CAL_BATCH lanes bound the race cost independently
 # of the production batch (chunk locality is a per-lane property, so the
@@ -73,10 +92,12 @@ class Decision:
     labels_per_sec: float | None = None  # calibration rate, when raced
     explicit_impl: bool = False  # impl came from SPACEMESH_ROMIX (never
     #                              silently fall back from it — ops/scrypt.py)
+    devices: int = 1          # lane-shard the batch over this many devices
+    #                           (parallel/mesh.py; 1 = single-device dispatch)
 
     def as_json(self) -> dict:
         return {"impl": self.impl, "chunk": self.chunk,
-                "source": self.source,
+                "source": self.source, "devices": self.devices,
                 "labels_per_sec": self.labels_per_sec}
 
 
@@ -94,8 +115,13 @@ def cache_path() -> str:
     return os.path.join(root, "romix_autotune.json")
 
 
-def _key(platform: str, n: int, batch: int) -> str:
-    return f"v{SCHEMA}:{platform}:n{n}:b{batch}"
+def _key(platform: str, n: int, batch: int, dev_cap: int = 1) -> str:
+    # dev_cap: the device budget the winner was selected under. A shape
+    # has (at most) two persisted winners — the best single-device row
+    # (d1, what ops/scrypt.py's per-call dispatch consumes) and the best
+    # row under the host's mesh cap (what the mesh-aware init/prove/bench
+    # callers consume) — so the two lookups never overwrite each other.
+    return f"v{SCHEMA}:{platform}:n{n}:b{batch}:d{dev_cap}"
 
 
 def _load_cache(path: str | None = None) -> dict:
@@ -132,15 +158,20 @@ def _store(key: str, entry: dict) -> None:
 def _entry_decision(entry: dict, batch: int, source: str) -> Decision | None:
     impl = entry.get("impl")
     chunk = entry.get("chunk")
+    devices = entry.get("devices", 1)
     if impl not in IMPLS:
         return None
     if chunk is not None and (not isinstance(chunk, int) or chunk < 1):
+        return None
+    if not isinstance(devices, int) or isinstance(devices, bool) \
+            or devices < 1:
         return None
     if chunk is not None and chunk >= batch:
         chunk = None
     rate = entry.get("labels_per_sec")
     return Decision(impl, chunk, source,
-                    rate if isinstance(rate, (int, float)) else None)
+                    rate if isinstance(rate, (int, float)) else None,
+                    devices=devices)
 
 
 def read_env() -> tuple[str | None, int | None, bool, bool]:
@@ -158,6 +189,79 @@ def read_env() -> tuple[str | None, int | None, bool, bool]:
             raise ValueError(f"{ENV_CHUNK}={chunk_raw!r}: must be >= 1")
     no_race = (os.environ.get(ENV_AUTOTUNE) or "").lower() in _OFF
     return impl, chunk, chunk_set, no_race
+
+
+def read_mesh_env() -> int | None:
+    """``SPACEMESH_MESH`` as a device count: None = auto (tuned),
+    ``0``/``off`` = 1 (never shard), ``1``/``on`` = every visible device
+    (the historical force-the-mesh switch), an integer >= 2 = exactly
+    that many devices."""
+    raw = os.environ.get(ENV_MESH)
+    if raw is None:
+        return None
+    v = raw.strip().lower()
+    if v in ("", "auto"):
+        return None
+    if v in _OFF:
+        return 1
+    if v in ("1", "on"):
+        return _device_count()
+    try:
+        count = int(v)
+    except ValueError:
+        raise ValueError(
+            f"{ENV_MESH}={raw!r}: expected off/on/auto or a device count")
+    if count < 1:
+        raise ValueError(f"{ENV_MESH}={raw!r}: device count must be >= 1")
+    return count
+
+
+def _device_count() -> int:
+    import jax
+
+    return jax.device_count()
+
+
+def resolve_auto_mesh(n: int, batch: int):
+    """-> (device list | None, Decision) for a mesh-aware caller in
+    ``auto`` mode — ONE definition of the routing post/initializer.py
+    and post/prover.py share (hand-rolled twins of this logic have
+    already diverged once on knob parsing; see read_mesh_env).
+
+    On the CPU fallback the tuned mesh winner decides (devices > 1 only
+    when the raced row says so and the host still exposes that many).
+    On real multi-device hardware the historical whole-mesh default
+    holds. SPACEMESH_MESH forces either way (off -> always None; the
+    CPU path honors it inside decide(), which collapses a forced count
+    into the returned decision). Callers build the parallel/mesh.py
+    Mesh from the returned device list; None means stay single-device.
+    """
+    import jax
+
+    if jax.default_backend() != "cpu":
+        forced = read_mesh_env()
+        count = _device_count()
+        d = decide(n, batch)
+        if forced == 1 or count <= 1:
+            return None, d
+        return jax.devices()[:min(forced or count, count)], d
+    d = decide(n, batch, max_devices=None)
+    if d.devices > 1 and _device_count() >= d.devices:
+        return jax.devices()[:d.devices], d
+    return None, d
+
+
+def _device_cap(max_devices: int | None) -> int:
+    """The device budget for one decide() call: the caller's cap clipped
+    to the host and the raced grid. ``max_devices=1`` short-circuits
+    without touching the backend (the per-call dispatch path in
+    ops/scrypt.py must not pay a device enumeration)."""
+    if max_devices == 1:
+        return 1
+    cap = min(_device_count(), MAX_MESH_DEVICES)
+    if max_devices is not None:
+        cap = min(cap, max_devices)
+    return max(cap, 1)
 
 
 def chunk_candidates(n: int, batch: int,
@@ -184,18 +288,42 @@ def default_decision(platform: str, n: int, batch: int) -> Decision:
     return Decision("xla", None, "default")
 
 
-def candidates(platform: str, n: int, batch: int) -> list[tuple[str, int | None]]:
-    """The (impl, chunk) grid raced for one shape."""
+def mesh_candidates(device_count: int, cap: int = MAX_MESH_DEVICES
+                    ) -> list[int]:
+    """Power-of-two device counts to race the lane-sharded kernel over:
+    {2, 4, 8} clipped to the visible devices and ``cap``."""
+    out, d = [], 2
+    while d <= min(device_count, cap):
+        out.append(d)
+        d *= 2
+    return out
+
+
+def candidates(platform: str, n: int, batch: int, mesh_cap: int = 1
+                ) -> list[tuple[str, int | None, int]]:
+    """The (impl, chunk, devices) grid raced for one shape."""
     chunks: list[int | None] = [None, *chunk_candidates(n, batch)]
     if platform == "cpu":
         # interpret-mode Pallas executes every DMA in Python — never a
         # contender, so never raced (force it with SPACEMESH_ROMIX=pallas)
-        return [(impl, c) for impl in ("xla", "xla-rows") for c in chunks]
-    out: list[tuple[str, int | None]] = [("xla", c) for c in chunks]
-    if platform == "tpu":
-        # the Pallas kernel tiles lanes at LANE_TILE internally (its V
-        # scratch is per-tile), so an outer chunk adds nothing
-        out.append(("pallas", None))
+        out = [(impl, c, 1) for impl in ("xla", "xla-rows") for c in chunks]
+    else:
+        out = [("xla", c, 1) for c in chunks]
+        if platform == "tpu":
+            # the Pallas kernel tiles lanes at LANE_TILE internally (its V
+            # scratch is per-tile), so an outer chunk adds nothing
+            out.append(("pallas", None, 1))
+    if mesh_cap > 1:
+        # mesh rows: both XLA layouts on CPU (the contiguous-row variant's
+        # win condition — gather read amplification — is per-device, so it
+        # can flip under sharding too), plain xla elsewhere. No chunk: a
+        # sequential lane chunk inside a shard fights GSPMD partitioning
+        # (ops/scrypt.py _tunable), and the Pallas kernel is raced
+        # single-device only (its per-tile V scratch already bounds the
+        # working set).
+        impls = ("xla", "xla-rows") if platform == "cpu" else ("xla",)
+        for d in mesh_candidates(_device_count(), mesh_cap):
+            out.extend((impl, None, d) for impl in impls)
     return out
 
 
@@ -237,29 +365,44 @@ def _valid_rows(rows) -> list[dict]:
         if (isinstance(r, dict) and r.get("impl") in IMPLS
                 and (r.get("chunk") is None
                      or (isinstance(r.get("chunk"), int) and r["chunk"] >= 1))
+                and isinstance(r.get("devices", 1), int)
+                and not isinstance(r.get("devices", 1), bool)
+                and r.get("devices", 1) >= 1
                 and isinstance(r.get("labels_per_sec"), (int, float))):
+            r.setdefault("devices", 1)
             out.append(r)
     return out
 
 
-def _race_measurements(platform: str, n: int) -> list[dict]:
+def _race_measurements(platform: str, n: int, mesh_cap: int = 1
+                       ) -> list[dict]:
+    """All calibration measurements for (platform, n), raced lazily: the
+    single-device grid on first use, mesh rows the first time a caller
+    with a device budget > 1 asks. Rows persist incrementally, so a
+    winners file written on a 1-device host grows mesh rows when it is
+    first read on (or shipped to, via the CI cache) a multi-device one."""
     memo_key = (platform, n)
-    got = _race_memo.get(memo_key)
-    if got is not None:
-        return got
-    persisted = _valid_rows(
-        _load_cache().get(_meas_key(platform, n), {}).get("raced"))
-    if persisted:
-        _race_memo[memo_key] = persisted
-        return persisted
+    rows = _race_memo.get(memo_key)
+    if rows is None:
+        rows = _valid_rows(
+            _load_cache().get(_meas_key(platform, n), {}).get("raced"))
+    missing = [c for c in candidates(platform, n, CAL_BATCH, mesh_cap)
+               if (c[1] is None or c[1] < CAL_BATCH)
+               and not any(r["impl"] == c[0] and r["chunk"] == c[1]
+                           and r["devices"] == c[2] for r in rows)]
+    if not missing:
+        _race_memo[memo_key] = rows
+        return rows
     from ..utils import metrics, tracing
 
     metrics.post_romix_autotune_races.inc()
-    race_sp = tracing.span("romix.race", {"platform": platform, "n": n}
+    race_sp = tracing.span("romix.race",
+                           {"platform": platform, "n": n,
+                            "mesh_cap": mesh_cap}
                            if tracing.is_enabled() else None)
     race_sp.__enter__()
     try:
-        rows = _race_candidates(platform, n)
+        rows = rows + _race_rows(platform, n, missing)
     finally:
         race_sp.__exit__(None, None, None)
     _race_memo[memo_key] = rows
@@ -271,100 +414,180 @@ def _race_measurements(platform: str, n: int) -> list[dict]:
     return rows
 
 
-def _race_candidates(platform: str, n: int) -> list[dict]:
+def _race_rows(platform: str, n: int,
+               combos: list[tuple[str, int | None, int]]) -> list[dict]:
+    import jax
     import jax.numpy as jnp
 
     from ..utils import tracing
     from . import scrypt
 
-    x = jnp.asarray(calibration_block(CAL_BATCH))
+    x_host = jnp.asarray(calibration_block(CAL_BATCH))
     rows = []
-    for impl, chunk in candidates(platform, n, CAL_BATCH):
-        if chunk is not None and chunk >= CAL_BATCH:
-            continue  # indistinguishable from unchunked at this workload
+    for impl, chunk, devices in combos:
         # non-pallas candidates never interpret — the SAME static jit key
         # production uses, so the race's compile is reused, not repaid
         interpret = impl == "pallas" and platform != "tpu"
-        label = f"{impl}" + (f"/chunk={chunk}" if chunk else "")
+        label = f"{impl}" + (f"/chunk={chunk}" if chunk else "") + (
+            f"/devices={devices}" if devices > 1 else "")
         csp = tracing.span("romix.race_candidate",
-                           {"impl": impl, "chunk": chunk}
+                           {"impl": impl, "chunk": chunk,
+                            "devices": devices}
                            if tracing.is_enabled() else None)
         csp.__enter__()
         try:
+            if devices > 1:
+                from ..parallel import mesh as pmesh
+
+                mesh = pmesh.data_mesh(jax.devices()[:devices])
+                x = jax.device_put(x_host, pmesh.lane_sharding(mesh))
+            else:
+                x = x_host
+
+            def run():
+                return scrypt.romix_tuned(x, n=n, impl=impl, chunk=chunk,
+                                          interpret=interpret)
+
             t0 = time.perf_counter()
-            scrypt.romix_tuned(x, n=n, impl=impl, chunk=chunk,
-                               interpret=interpret).block_until_ready()
+            run().block_until_ready()
             compile_s = time.perf_counter() - t0
             best = float("inf")
             for _ in range(CAL_REPS):
                 t0 = time.perf_counter()
-                scrypt.romix_tuned(x, n=n, impl=impl, chunk=chunk,
-                                   interpret=interpret).block_until_ready()
+                run().block_until_ready()
                 best = min(best, time.perf_counter() - t0)
             rate = CAL_BATCH / best
             _log(f"romix autotune: {label}: {rate:,.0f} labels/s "
                  f"(compile+first {compile_s:.1f}s)")
             csp.set(labels_per_sec=round(rate, 1),
                     compile_s=round(compile_s, 3))
-            rows.append({"impl": impl, "chunk": chunk,
+            rows.append({"impl": impl, "chunk": chunk, "devices": devices,
                          "labels_per_sec": round(rate, 1)})
         except Exception as e:  # noqa: BLE001 — a candidate that cannot
-            # compile on this host simply loses the race
+            # compile on this host simply loses the race. Persisted as a
+            # 0-rate row so the next process does NOT see it as missing
+            # and re-pay the failing attempt at every startup (delete the
+            # winners file to retry after fixing the host).
             _log(f"romix autotune: {label} failed "
                  f"({type(e).__name__}: {e})")
             csp.set(failed=type(e).__name__)
+            rows.append({"impl": impl, "chunk": chunk, "devices": devices,
+                         "labels_per_sec": 0.0,
+                         "failed": type(e).__name__})
         finally:
             csp.__exit__(None, None, None)
     return rows
 
 
-def race(platform: str, n: int, batch: int) -> Decision:
+NOISE_BAND = 0.95  # rows within 5% of the best rate count as tied
+
+
+def _select_winner(usable: list[dict]) -> dict:
+    """The fastest row — except that among rows within the calibration
+    noise band of the best rate, the one sharded over the FEWEST devices
+    wins. Sharding overhead (SPMD rendezvous, per-shard D2H) grows with
+    the production batch while the fixed 512-lane calibration slightly
+    flatters wide meshes, so a near-tie at calibration is a real win for
+    the narrower mesh at production shapes."""
+    best = max(r["labels_per_sec"] for r in usable)
+    near = [r for r in usable if r["labels_per_sec"] >= NOISE_BAND * best]
+    return min(near, key=lambda r: (r["devices"], -r["labels_per_sec"]))
+
+
+def race(platform: str, n: int, batch: int, dev_cap: int = 1,
+         pin_devices: int | None = None) -> Decision | None:
     """Race (or reuse the measured race of) the candidate kernels on the
     fixed calibration workload, then persist and return the winner for
-    ``(platform, n, batch)``."""
-    rows = _race_measurements(platform, n)
+    ``(platform, n, batch)`` under a ``dev_cap`` device budget.
+
+    ``pin_devices`` restricts selection to rows at exactly that device
+    count (the SPACEMESH_MESH=<k> override); pinned selections are NOT
+    persisted as winners — unsetting the override must fall back to the
+    full-grid winner, not a pinned one — and return None when no row at
+    that count survived."""
+    rows = _race_measurements(platform, n, mesh_cap=dev_cap)
     usable = [r for r in rows
-              if r["chunk"] is None or r["chunk"] < batch]
+              if (r["chunk"] is None or r["chunk"] < batch)
+              and r["devices"] <= dev_cap
+              and r["devices"] <= batch
+              and not r.get("failed") and r["labels_per_sec"] > 0]
+    if pin_devices is not None:
+        usable = [r for r in usable if r["devices"] == pin_devices]
+        if not usable:
+            return None
     if not usable:
         return default_decision(platform, n, batch)
-    win = max(usable, key=lambda r: r["labels_per_sec"])
+    win = _select_winner(usable)
     chunk = win["chunk"]
+    d = Decision(win["impl"], chunk, "race", win["labels_per_sec"],
+                 devices=win["devices"])
+    if pin_devices is not None:
+        return dataclasses.replace(d, source="env")
     entry = {"impl": win["impl"], "chunk": chunk,
+             "devices": win["devices"],
              "labels_per_sec": win["labels_per_sec"],
              "cal_batch": CAL_BATCH, "raced": rows,
              "tuned_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())}
-    _store(_key(platform, n, batch), entry)
-    _log(f"romix autotune: winner for {platform} n={n} b={batch}: "
-         f"{win['impl']}" + (f"/chunk={chunk}" if chunk else "") +
-         f" ({win['labels_per_sec']:,.0f} labels/s, persisted)")
-    return Decision(win["impl"], chunk, "race", win["labels_per_sec"])
+    _store(_key(platform, n, batch, dev_cap), entry)
+    _log(f"romix autotune: winner for {platform} n={n} b={batch} "
+         f"(<= {dev_cap} devices): {win['impl']}"
+         + (f"/chunk={chunk}" if chunk else "")
+         + (f"/devices={win['devices']}" if win["devices"] > 1 else "")
+         + f" ({win['labels_per_sec']:,.0f} labels/s, persisted)")
+    return d
 
 
 def decide(n: int, batch: int, *, platform: str | None = None,
-           allow_race: bool = True) -> Decision:
+           allow_race: bool = True, max_devices: int | None = 1
+           ) -> Decision:
     """Resolve the kernel choice for one shape (precedence in the module
     docstring). The steady dispatch path — one call per label batch from
     post/initializer.py — is a memoized dict lookup; the env values are
-    part of the memo key so override changes always take effect."""
+    part of the memo key so override changes always take effect.
+
+    ``max_devices``: the caller's device budget. The default (1) serves
+    shape-bound callers — ops/scrypt.py's per-call dispatch, the
+    profiler's stage views — the best single-device row. Mesh-aware
+    callers (post/initializer.py, post/prover.py, bench.py) pass None
+    (= up to min(visible devices, 8)) and route through parallel/mesh.py
+    when the winning row says ``devices > 1``."""
     if platform is None:
         import jax
 
         platform = jax.default_backend()
-    memo_key = (platform, n, batch, allow_race,
+    dev_cap = _device_cap(max_devices)
+    memo_key = (platform, n, batch, allow_race, dev_cap,
                 os.environ.get(ENV_IMPL), os.environ.get(ENV_CHUNK),
-                os.environ.get(ENV_AUTOTUNE), os.environ.get(ENV_CACHE))
+                os.environ.get(ENV_AUTOTUNE), os.environ.get(ENV_CACHE),
+                os.environ.get(ENV_MESH))
     hit = _decision_memo.get(memo_key)
     if hit is not None:
         return hit
-    d = _decide(n, batch, platform, allow_race)
+    d = _decide(n, batch, platform, allow_race, dev_cap)
     _decision_memo[memo_key] = d
     return d
 
 
-def _decide(n: int, batch: int, platform: str, allow_race: bool) -> Decision:
+def _decide(n: int, batch: int, platform: str, allow_race: bool,
+            dev_cap: int) -> Decision:
     impl_env, chunk_env, chunk_set, no_race = read_env()
+    mesh_env = read_mesh_env() if dev_cap > 1 else None
+    if mesh_env is not None:
+        mesh_env = max(1, min(mesh_env, dev_cap, batch))
+    if mesh_env == 1:
+        # SPACEMESH_MESH=off: the whole decision collapses to the
+        # single-device budget — lookups, races, and persisted winners
+        # all use the :d1 key, so the kill-switch also holds through the
+        # race fall-through at the bottom
+        dev_cap, mesh_env = 1, None
     cached = _entry_decision(
-        _load_cache().get(_key(platform, n, batch), {}), batch, "cache")
+        _load_cache().get(_key(platform, n, batch, dev_cap), {}), batch,
+        "cache")
+    if cached is not None and cached.devices > min(dev_cap, batch):
+        cached = None  # raced under a wider device budget than this call's
+    if cached is not None and mesh_env is not None \
+            and cached.devices != mesh_env:
+        cached = None  # forced device count: the cached winner is moot
     if impl_env is not None:
         # explicit impl: env chunk > cached chunk (same impl) > heuristic
         if chunk_set:
@@ -377,13 +600,27 @@ def _decide(n: int, batch: int, platform: str, allow_race: bool) -> Decision:
             chunk = default_decision(platform, n, batch).chunk
         if chunk is not None and chunk >= batch:
             chunk = None
-        return Decision(impl_env, chunk, "env", explicit_impl=True)
+        devices = mesh_env if mesh_env is not None else (
+            cached.devices if cached is not None else 1)
+        return Decision(impl_env, chunk, "env", explicit_impl=True,
+                        devices=devices)
     if chunk_set:
         base = cached or default_decision(platform, n, batch)
         chunk = chunk_env if (chunk_env is None or chunk_env < batch) else None
-        return Decision(base.impl, chunk, "env")
+        devices = mesh_env if mesh_env is not None else base.devices
+        return Decision(base.impl, chunk, "env", devices=devices)
     if cached is not None:
         return cached
+    if mesh_env is not None and mesh_env > 1:
+        # forced device count: best raced row at that count when racing
+        # is allowed, the plain XLA kernel otherwise (the historical
+        # SPACEMESH_MESH=1 behavior)
+        if allow_race and not no_race:
+            pinned = race(platform, n, batch, dev_cap,
+                          pin_devices=mesh_env)
+            if pinned is not None:
+                return pinned
+        return Decision("xla", None, "env", devices=mesh_env)
     if no_race or not allow_race:
         return default_decision(platform, n, batch)
-    return race(platform, n, batch)
+    return race(platform, n, batch, dev_cap)

@@ -56,6 +56,7 @@ post/initializer.py (batch sizing) and bench.py.
 from __future__ import annotations
 
 import functools
+import os
 import sys
 
 import jax
@@ -67,6 +68,30 @@ from ..utils import tracing
 from .sha256 import byteswap32, hmac_midstates, sha256_compress
 
 LABEL_BYTES = 16  # reference: 16-byte labels, 2^32 per 64 GiB unit
+
+ENV_BUCKETS = "SPACEMESH_SHAPE_BUCKETS"  # "0"/"off" disables bucketing
+
+
+def shape_bucket(b: int) -> int:
+    """The executable lane-count bucket for a batch of ``b`` labels: the
+    next power of two (identity when ``b`` already is one).
+
+    Every jitted program here compiles per (static args, input shape) —
+    so without bucketing, an init session's ragged tail batch, the
+    verifier's variable-count label recomputes, and every bench sweep
+    size each mint a fresh executable (17-26s of XLA compile apiece on a
+    cold host). Padding the lane axis up to a power-of-two bucket and
+    trimming the output caps the executable population at log2(max
+    batch) shapes per N; pad lanes repeat the last label index, which
+    the VRF min-scan cannot distinguish from the real last lane (same
+    value, first-occurrence lane wins — the identical argument the mesh
+    pad in post/initializer.py relies on). ``SPACEMESH_SHAPE_BUCKETS=off``
+    disables (tests that measure exact shapes)."""
+    if b <= 1:
+        return max(b, 1)
+    if (os.environ.get(ENV_BUCKETS) or "").lower() in ("0", "off", "none"):
+        return b
+    return 1 << (b - 1).bit_length()
 
 
 def _rotl(x, n: int):
@@ -309,13 +334,28 @@ def _tunable(*arrays) -> bool:
     return True
 
 
-def _plan(n: int, batch: int, *arrays):
-    """-> (autotune.Decision, interpret flag) for one call."""
+def _plan(n: int, batch: int, *arrays, impl: str | None = None,
+          chunk: int | None = None):
+    """-> (autotune.Decision, interpret flag) for one call.
+
+    ``impl``/``chunk`` are caller overrides (the mesh entry points in
+    parallel/mesh.py pass the raced mesh winner's layout through here);
+    they skip the autotune lookup and are only explicit in the
+    SPACEMESH_ROMIX sense when they MATCH an explicit env request — the
+    mesh callers forward decision.impl verbatim, so an operator's
+    SPACEMESH_ROMIX=pallas must keep its never-silently-fall-back
+    contract through the sharded path too."""
     from . import autotune
 
     platform = jax.default_backend()
     interpret = platform != "tpu"
-    if not _tunable(*arrays):
+    if impl is not None:
+        if chunk is not None and chunk >= batch:
+            chunk = None
+        impl_env, _, _, _ = autotune.read_env()
+        d = autotune.Decision(impl, chunk, "caller",
+                              explicit_impl=impl == impl_env)
+    elif not _tunable(*arrays):
         impl_env, chunk_env, chunk_set, _ = autotune.read_env()
         d = autotune.Decision(impl_env or "xla",
                               chunk_env if chunk_set else None,
@@ -323,6 +363,36 @@ def _plan(n: int, batch: int, *arrays):
     else:
         d = autotune.decide(n, batch, platform=platform)
     return d, (interpret if d.impl == "pallas" else False)
+
+
+def _bucket_lanes(commitment_words, idx_lo, idx_hi):
+    """Pad the lane axis up to its shape bucket (repeat the last index).
+    Returns (cw, lo, hi, valid) with ``valid`` = the caller's lane count
+    (trim the output to it), or the inputs unchanged when the batch is
+    already bucket-sized."""
+    b = int(idx_lo.shape[0])
+    bb = shape_bucket(b)
+    if bb == b:
+        return commitment_words, idx_lo, idx_hi, b
+    pad = bb - b
+    idx_lo = jnp.concatenate(
+        [jnp.asarray(idx_lo), jnp.broadcast_to(jnp.asarray(idx_lo)[-1:],
+                                               (pad,))])
+    idx_hi = jnp.concatenate(
+        [jnp.asarray(idx_hi), jnp.broadcast_to(jnp.asarray(idx_hi)[-1:],
+                                               (pad,))])
+    cw = jnp.asarray(commitment_words)
+    if cw.ndim == 2:  # per-lane commitments: repeat the last column too
+        cw = jnp.concatenate(
+            [cw, jnp.broadcast_to(cw[:, -1:], (cw.shape[0], pad))], axis=1)
+    return cw, idx_lo, idx_hi, b
+
+
+def compiled_shape_count() -> int:
+    """Executables compiled for the fused label pipelines in this
+    process — one per distinct (shape, static args). Tests assert shape
+    bucketing keeps this flat across ragged batch sizes."""
+    return _labels_fused._cache_size() + _labels_min_fused._cache_size()
 
 
 def _pallas_failed(d, err: Exception):
@@ -380,29 +450,42 @@ def _labels_fused(commitment_words, idx_lo, idx_hi, *, n: int, impl: str,
     return _pbkdf2_second(inner_mid, outer_mid, blk)[:4]
 
 
-def scrypt_labels_jit(commitment_words, idx_lo, idx_hi, *, n: int):
+def scrypt_labels_jit(commitment_words, idx_lo, idx_hi, *, n: int,
+                      impl: str | None = None, chunk: int | None = None):
     """Batch of labels. ``idx_lo/idx_hi``: (B,) u32 halves of label indices.
 
     Returns (4, B) u32 BE words = B 16-byte labels (batch minor). One
-    fused program under the autotuned kernel decision (module docstring).
-    """
-    d, interpret = _plan(n, idx_lo.shape[0], commitment_words, idx_lo,
-                         idx_hi)
+    fused program under the autotuned kernel decision (module
+    docstring), or under an explicit caller ``impl``/``chunk`` (the mesh
+    entry points pass the raced mesh winner through). Ragged batches are
+    padded to their power-of-two shape bucket and trimmed, so they reuse
+    the bucket's executable instead of compiling their own
+    (:func:`shape_bucket`; sharded/traced inputs skip the pad — mesh
+    callers pre-bucket on host)."""
+    valid = None
+    if _tunable(commitment_words, idx_lo, idx_hi):
+        commitment_words, idx_lo, idx_hi, valid = _bucket_lanes(
+            commitment_words, idx_lo, idx_hi)
+    batch = int(idx_lo.shape[0])
+    d, interpret = _plan(n, batch, commitment_words, idx_lo, idx_hi,
+                         impl=impl, chunk=chunk)
     # the span covers the ENQUEUE (trace+compile on a cache miss, else
     # async dispatch) — device time shows up in the XLA trace, which the
     # SPACEMESH_TRACE_JAX bridge lines these spans up against
     with tracing.span("romix.dispatch",
                       {"impl": d.impl, "chunk": d.chunk, "n": n,
-                       "batch": int(idx_lo.shape[0])}
+                       "batch": batch}
                       if tracing.is_enabled() else None):
         try:
-            return _labels_fused(commitment_words, idx_lo, idx_hi, n=n,
-                                 impl=d.impl, chunk=d.chunk,
-                                 interpret=interpret)
+            words = _labels_fused(commitment_words, idx_lo, idx_hi, n=n,
+                                  impl=d.impl, chunk=d.chunk,
+                                  interpret=interpret)
         except Exception as e:  # noqa: BLE001 — pallas-only fallback
             d = _pallas_failed(d, e)
-            return _labels_fused(commitment_words, idx_lo, idx_hi, n=n,
-                                 impl=d.impl, chunk=d.chunk, interpret=False)
+            words = _labels_fused(commitment_words, idx_lo, idx_hi, n=n,
+                                  impl=d.impl, chunk=d.chunk,
+                                  interpret=False)
+    return words if valid is None or valid == batch else words[:, :valid]
 
 
 # --- on-device VRF-nonce scan ----------------------------------------------
@@ -506,16 +589,26 @@ def _labels_min_fused(commitment_words, idx_lo, idx_hi, carry, *, n: int,
 
 
 def scrypt_labels_with_min(commitment_words, idx_lo, idx_hi, carry, *,
-                           n: int):
+                           n: int, impl: str | None = None,
+                           chunk: int | None = None):
     """Label batch + running VRF minimum, fully device-side.
 
     One host call enqueues ONE fused XLA program (PBKDF2 expand, ROMix,
-    finish, min-scan) under the autotuned kernel decision; no data
-    returns to host. Returns ``(words, new_carry, snapshot)``; ``carry``
-    is donated.
+    finish, min-scan) under the autotuned kernel decision (or a caller
+    ``impl``/``chunk`` — see :func:`scrypt_labels_jit`); no data returns
+    to host. Returns ``(words, new_carry, snapshot)``; ``carry`` is
+    donated. Ragged batches pad to their shape bucket with the last
+    index repeated — the min-scan cannot tell the pad lanes from the
+    real last lane (same value, first-occurrence lane wins), so the
+    carry is exact and only ``words`` is trimmed.
     """
-    d, interpret = _plan(n, idx_lo.shape[0], commitment_words, idx_lo,
-                         idx_hi, carry)
+    valid = None
+    if _tunable(commitment_words, idx_lo, idx_hi, carry):
+        commitment_words, idx_lo, idx_hi, valid = _bucket_lanes(
+            commitment_words, idx_lo, idx_hi)
+    batch = int(idx_lo.shape[0])
+    d, interpret = _plan(n, batch, commitment_words, idx_lo, idx_hi, carry,
+                         impl=impl, chunk=chunk)
     # a pallas attempt can fail AFTER compile (e.g. HBM exhaustion
     # allocating the per-tile V scratch at dispatch), by which point the
     # donated carry buffer is consumed — keep an independent (6,)-word
@@ -524,17 +617,20 @@ def scrypt_labels_with_min(commitment_words, idx_lo, idx_hi, carry, *,
     backup = jnp.asarray(carry) + jnp.uint32(0) if d.impl == "pallas" else None
     with tracing.span("romix.dispatch",
                       {"impl": d.impl, "chunk": d.chunk, "n": n,
-                       "batch": int(idx_lo.shape[0]), "minscan": True}
+                       "batch": batch, "minscan": True}
                       if tracing.is_enabled() else None):
         try:
-            return _labels_min_fused(commitment_words, idx_lo, idx_hi, carry,
-                                     n=n, impl=d.impl, chunk=d.chunk,
-                                     interpret=interpret)
+            words, new_carry, snap = _labels_min_fused(
+                commitment_words, idx_lo, idx_hi, carry, n=n, impl=d.impl,
+                chunk=d.chunk, interpret=interpret)
         except Exception as e:  # noqa: BLE001 — pallas-only fallback
             d = _pallas_failed(d, e)
-            return _labels_min_fused(commitment_words, idx_lo, idx_hi,
-                                     backup, n=n, impl=d.impl, chunk=d.chunk,
-                                     interpret=False)
+            words, new_carry, snap = _labels_min_fused(
+                commitment_words, idx_lo, idx_hi, backup, n=n, impl=d.impl,
+                chunk=d.chunk, interpret=False)
+    if valid is not None and valid != batch:
+        words = words[:, :valid]
+    return words, new_carry, snap
 
 
 def commitment_to_words(commitment: bytes) -> np.ndarray:

@@ -105,6 +105,18 @@ class CountingReader(asyncio.StreamReader):
         self._consumed += len(data)
         return data
 
+    async def readline(self):
+        # StreamReader.readline swallows LimitOverrunError by truncating
+        # the private ``_buffer`` directly — bytes this counter never sees
+        # as consumed, permanently inflating ``buffered`` and wedging
+        # receive flow control. No caller needs line framing (noise.py is
+        # readexactly-only), so fail loudly instead of corrupting the
+        # accounting (ADVICE r5).
+        raise NotImplementedError(
+            "CountingReader does not support readline(): its "
+            "LimitOverrunError recovery bypasses flow-control accounting; "
+            "use readexactly/readuntil")
+
 
 class QuicWriter:
     """asyncio.StreamWriter-shaped facade over a QuicConnection."""

@@ -37,9 +37,14 @@ def _batch_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P(DATA_AXIS))
 
 
-def _lane_sharding(mesh: Mesh) -> NamedSharding:
-    # word-major arrays: (words, B) — shard the minor/lane axis
+def lane_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding for word-major arrays: (words, B) — shard the minor/lane
+    axis (the autotuner's mesh race places its calibration block with
+    this, the same placement the sharded label entry points use)."""
     return NamedSharding(mesh, P(None, DATA_AXIS))
+
+
+_lane_sharding = lane_sharding  # historical private alias
 
 
 def replicate(mesh: Mesh, value) -> jax.Array:
@@ -49,7 +54,7 @@ def replicate(mesh: Mesh, value) -> jax.Array:
 
 
 def labels_with_min_sharded(mesh: Mesh, commitment_words, idx_lo, idx_hi,
-                            carry, *, n: int):
+                            carry, *, n: int, impl: str | None = None):
     """Sharded label batch chained to the on-device VRF min-scan.
 
     Lane axis sharded over the mesh; the (6,) running-minimum carry is
@@ -58,36 +63,40 @@ def labels_with_min_sharded(mesh: Mesh, commitment_words, idx_lo, idx_hi,
     scrypt.scrypt_labels_with_min, with ``words`` lane-sharded so the host
     can fetch and stripe each device's shard to disk independently.
 
-    Kernel choice: multi-device shardings pin the ROMix dispatch to the
-    plain word-major XLA kernel (a sequential lane-chunk would fight
-    GSPMD's batch partitioning — ops/scrypt.py ``_tunable``); the
-    SPACEMESH_ROMIX / SPACEMESH_ROMIX_CHUNK overrides still win for
-    operators who have measured their mesh (docs/ROMIX_KERNEL.md).
+    Kernel choice: ``impl`` carries the autotuned mesh winner's layout
+    (ops/autotune.py races both XLA layouts per device count); when None,
+    multi-device shardings pin the ROMix dispatch to the plain word-major
+    XLA kernel (a sequential lane-chunk would fight GSPMD's batch
+    partitioning — ops/scrypt.py ``_tunable``). The SPACEMESH_ROMIX /
+    SPACEMESH_ROMIX_CHUNK overrides still win for operators who have
+    measured their mesh (docs/ROMIX_KERNEL.md).
     """
     bs = _batch_sharding(mesh)
     idx_lo = jax.device_put(jnp.asarray(idx_lo), bs)
     idx_hi = jax.device_put(jnp.asarray(idx_hi), bs)
     cw = jnp.asarray(commitment_words)
     if cw.ndim == 2:
-        cw = jax.device_put(cw, _lane_sharding(mesh))
+        cw = jax.device_put(cw, lane_sharding(mesh))
     return scrypt.scrypt_labels_with_min(cw, idx_lo, idx_hi,
-                                         replicate(mesh, carry), n=n)
+                                         replicate(mesh, carry), n=n,
+                                         impl=impl)
 
 
 def scrypt_labels_sharded(mesh: Mesh, commitment_words, idx_lo, idx_hi,
-                          *, n: int):
+                          *, n: int, impl: str | None = None):
     """Label batch sharded over the mesh. Batch size must divide evenly.
 
     ``commitment_words``: (8,) shared or (8, B) per-lane (multi-identity).
-    Returns (4, B) u32 BE words with the lane axis sharded.
+    Returns (4, B) u32 BE words with the lane axis sharded. ``impl`` as
+    in :func:`labels_with_min_sharded`.
     """
     bs = _batch_sharding(mesh)
     idx_lo = jax.device_put(jnp.asarray(idx_lo), bs)
     idx_hi = jax.device_put(jnp.asarray(idx_hi), bs)
     cw = jnp.asarray(commitment_words)
     if cw.ndim == 2:
-        cw = jax.device_put(cw, _lane_sharding(mesh))
-    return scrypt.scrypt_labels_jit(cw, idx_lo, idx_hi, n=n)
+        cw = jax.device_put(cw, lane_sharding(mesh))
+    return scrypt.scrypt_labels_jit(cw, idx_lo, idx_hi, n=n, impl=impl)
 
 
 def prove_step_sharded(mesh: Mesh, challenge_words, nonce_base, idx_lo,

@@ -136,22 +136,31 @@ class Initializer:
 
     # -- mesh routing -------------------------------------------------------
 
-    def _resolve_mesh(self):
+    def _resolve_plan(self, batch_hint: int):
+        """-> (mesh | None, autotune.Decision) for this session.
+
+        Real multi-device backends keep the historical behavior (shard
+        over the whole mesh). On the CPU fallback the autotuned winner
+        decides (ops/autotune.py mesh dimension): the op-dispatch-bound
+        label kernel usually wins sharded over the virtual host devices,
+        and the race has measured by how much on THIS host — zero
+        configuration, SPACEMESH_MESH still forces either way."""
+        from ..ops import autotune
+
+        n = self.meta.scrypt_n
         if self._mesh_arg is None:
-            return None
+            return None, autotune.decide(n, batch_hint)
         if self._mesh_arg != "auto":
-            return self._mesh_arg if self._mesh_arg.size > 1 else None
-        env = os.environ.get("SPACEMESH_MESH", "")
-        if env in ("0", "off"):
-            return None
-        if jax.device_count() <= 1:
-            return None
-        if jax.default_backend() == "cpu" and env not in ("1", "on"):
-            # virtual host devices (tests force 8): lane-sharding buys no
-            # real parallelism but costs an SPMD compile per shape
-            return None
+            mesh = self._mesh_arg if self._mesh_arg.size > 1 else None
+            return mesh, autotune.decide(n, batch_hint)
+        # ONE definition of the auto routing, shared with post/prover.py
+        # (autotune.resolve_auto_mesh: tuned winner on the CPU fallback,
+        # whole mesh on real hardware, SPACEMESH_MESH forces either way)
+        devs, d = autotune.resolve_auto_mesh(n, batch_hint)
+        if devs is None:
+            return None, d
         from ..parallel import mesh as pmesh
-        return pmesh.data_mesh()
+        return pmesh.data_mesh(devs), d
 
     # -- the pipeline -------------------------------------------------------
 
@@ -163,21 +172,28 @@ class Initializer:
         t0 = time.monotonic()
         written0 = meta.labels_written
         stats = PipelineStats()
-        mesh = self._resolve_mesh()
         cw = scrypt.commitment_to_words(commitment)
 
-        if mesh is None and total > written0:
-            # resolve (and if needed race+persist) the ROMix kernel choice
-            # up front so the session logs what it will run with and the
-            # first dispatch doesn't absorb the calibration race silently
-            # (ops/autotune.py; the sharded path is pinned to the plain
-            # XLA kernel — see ops/scrypt.py _tunable)
+        # resolve (and if needed race+persist) the kernel + mesh choice up
+        # front so the session logs what it will run with and the first
+        # dispatch doesn't absorb the calibration race silently
+        # (ops/autotune.py). The decision is taken at the BUCKETED batch —
+        # the executable shape every batch of this session (ragged tail
+        # included) actually runs at (ops/scrypt.py shape_bucket).
+        if total > written0:
+            batch_hint = scrypt.shape_bucket(min(self.batch,
+                                                 total - written0))
+            mesh, decision = self._resolve_plan(batch_hint)
+            print(f"romix kernel: impl={decision.impl} "
+                  f"chunk={decision.chunk} devices={mesh.size if mesh else 1}"
+                  f" (source={decision.source})", file=sys.stderr, flush=True)
+            metrics.post_mesh_devices.set(mesh.size if mesh else 1)
+        else:  # nothing to do: never pay a race/compile for a no-op resume
             from ..ops import autotune
 
-            d = autotune.decide(meta.scrypt_n,
-                                min(self.batch, total - written0))
-            print(f"romix kernel: impl={d.impl} chunk={d.chunk} "
-                  f"(source={d.source})", file=sys.stderr, flush=True)
+            mesh, decision = None, autotune.default_decision(
+                jax.default_backend(), self.meta.scrypt_n, self.batch)
+        self._decision = decision
 
         # resumed (or fresh) running-minimum carry for the VRF scan
         resumed = None
@@ -201,7 +217,9 @@ class Initializer:
         self._last_save_labels = written0
         session = tracing.span("init.run",
                                {"total": total, "resume_at": written0,
-                                "batch": self.batch}
+                                "batch": self.batch,
+                                "devices": mesh.size if mesh else 1,
+                                "impl": decision.impl}
                                if tracing.is_enabled() else None)
         session.__enter__()
         try:
@@ -266,15 +284,25 @@ class Initializer:
         n = self.meta.scrypt_n
         if mesh is not None:
             from ..parallel import mesh as pmesh
-            # pad to a multiple of the mesh size by repeating the last
-            # index — duplicates cannot perturb the min scan (same value,
-            # first-occurrence index wins) and the pad lanes are trimmed
-            # before the bytes reach disk
-            pad = (-count) % mesh.size
-            idx = np.arange(start, start + count + pad, dtype=np.uint64)
+            # pad to the batch's shape bucket (and at least a multiple of
+            # the mesh size) by repeating the last index — duplicates
+            # cannot perturb the min scan (same value, first-occurrence
+            # index wins) and the pad lanes are trimmed before the bytes
+            # reach disk. Bucketing on host here; the sharded wrapper
+            # skips its own pad (ops/scrypt.py shape_bucket)
+            padded = scrypt.shape_bucket(count)
+            if padded % mesh.size:
+                padded = count + (-count) % mesh.size
+            idx = np.arange(start, start + padded, dtype=np.uint64)
             idx[count:] = start + count - 1
             lo, hi = scrypt.split_indices(idx)
-            return pmesh.labels_with_min_sharded(mesh, cw, lo, hi, carry, n=n)
+            # the raced mesh winner's layout rides along; an untuned mesh
+            # (explicit mesh= arg, forced SPACEMESH_MESH with racing off)
+            # keeps the pinned plain-XLA dispatch (impl=None)
+            impl = self._decision.impl if self._decision.devices > 1 \
+                else None
+            return pmesh.labels_with_min_sharded(mesh, cw, lo, hi, carry,
+                                                 n=n, impl=impl)
         idx = np.arange(start, start + count, dtype=np.uint64)
         lo, hi = scrypt.split_indices(idx)
         return scrypt.scrypt_labels_with_min(
@@ -289,18 +317,36 @@ class Initializer:
         rsp.__enter__()
         tf = time.perf_counter()
         stall = 0.0
+        shard_times: list[tuple[int, float]] = []  # (valid lanes, fetch s)
         try:
             if len(getattr(words.sharding, "device_set", ())) > 1:
                 for shard in words.addressable_shards:
                     lane0 = shard.index[1].start or 0
                     if lane0 >= count:
                         continue  # pure padding shard
+                    t0 = time.perf_counter()
                     arr = np.asarray(shard.data)
-                    shards.append((start + lane0, arr,
-                                   min(count - lane0, arr.shape[1])))
+                    valid = min(count - lane0, arr.shape[1])
+                    # the FIRST shard's copy blocks until the sharded
+                    # program retires, so its time includes compute wait;
+                    # later shards are (nearly) pure D2H. Both are what
+                    # the operator experiences per shard.
+                    shard_times.append((valid, time.perf_counter() - t0))
+                    shards.append((start + lane0, arr, valid))
             else:
                 shards.append((start, np.asarray(words), count))
             stats.shards += len(shards)
+            if len(shard_times) > 1:
+                secs = [s for _, s in shard_times]
+                hi, lo_ = max(secs), min(secs)
+                imbalance = (hi - lo_) / hi if hi > 0 else 0.0
+                per_shard = [v / s for v, s in shard_times if s > 0]
+                metrics.post_mesh_shard_imbalance.set(imbalance)
+                if per_shard:
+                    metrics.post_mesh_shard_labels_per_sec.set(
+                        sum(per_shard) / len(per_shard))
+                rsp.set(shards=len(shard_times),
+                        shard_imbalance=round(imbalance, 4))
             for shard_start, arr, valid in shards:
                 # byte conversion is host fetch-side work; only the
                 # submit() wait is writer backpressure

@@ -133,9 +133,15 @@ class Prover:
             use_pallas = self._platform == "tpu"
         self.use_pallas = use_pallas
         # pipelined batches share one compiled shape: round the batch up to
-        # the compaction segment (and the Pallas lane tile on that path)
+        # the compaction segment (and the Pallas lane tile on that path),
+        # then to its power-of-two shape bucket, so two Provers configured
+        # with nearby batch sizes (grpc worker tenants, test fixtures)
+        # land on ONE prove_scan_step executable instead of minting one
+        # each (ops/scrypt.py shape_bucket; both tiles are powers of two,
+        # so bucketing preserves the tile multiple)
         tile = proving_pallas.LANE_TILE if use_pallas else proving.HIT_SEGMENT
-        self.batch_labels = -(-max(batch_labels, tile) // tile) * tile
+        self.batch_labels = scrypt.shape_bucket(
+            -(-max(batch_labels, tile) // tile) * tile)
         if pipelined is None:
             pipelined = os.environ.get(
                 "SPACEMESH_PROVE_PIPELINE", "1") not in ("0", "off")
@@ -170,13 +176,19 @@ class Prover:
                     f"batch_labels {self.batch_labels} not divisible by "
                     f"the {mesh.size}-device mesh; pick a multiple")
         else:
-            env = os.environ.get("SPACEMESH_MESH", "")
-            if env in ("0", "off") or jax.device_count() <= 1:
+            from ..ops import autotune
+
+            # ONE definition of the auto routing, shared with
+            # post/initializer.py (autotune.resolve_auto_mesh). The race
+            # measures the label kernel, not the proving scan — but both
+            # are op-dispatch-bound embarrassingly-lane-parallel sweeps,
+            # so the tuned device count transfers.
+            devs, _ = autotune.resolve_auto_mesh(self.meta.scrypt_n,
+                                                 self.batch_labels)
+            if devs is None:
                 return None
-            if jax.default_backend() == "cpu" and env not in ("1", "on"):
-                return None  # virtual host devices: SPMD compile, no gain
             from ..parallel import mesh as pmesh
-            mesh = pmesh.data_mesh()
+            mesh = pmesh.data_mesh(devs)
         if mesh.size <= 1 or self.batch_labels % mesh.size:
             return None
         return mesh

@@ -19,22 +19,30 @@ def get(db: Database, ballot_id: bytes) -> Ballot | None:
     return Ballot.from_bytes(row["data"]) if row else None
 
 
-def resolve_epoch_data(db: Database, ballot: Ballot):
+def resolve_epoch_data(db: Database, ballot: Ballot,
+                       layers_per_epoch: int | None = None):
     """The ballot's own EpochData, else its ref ballot's — accepted only
     from the same owner AND the same ATX (reference
     eligibility_validator.go validateSecondary: a ballot must share its
     atx with its reference ballot; it must not inherit another
-    identity's epoch declaration either). ONE definition shared by live
-    ingest (miner.ingest_ballot) and restart recovery
-    (Tortoise.recover): the two paths must derive identical beacons and
-    eligibility counts, or a restart changes ballot weights and
-    bad-beacon flags (code-review r5)."""
+    identity's epoch declaration either), and — when the caller passes
+    ``layers_per_epoch`` — only from a ref ballot in the SAME epoch.
+    The reference rejects a cross-epoch ref explicitly; relying on an
+    ATX id resolving for a single target epoch covers this only
+    incidentally (ADVICE r5). ONE definition shared by live ingest
+    (miner.ingest_ballot) and restart recovery (Tortoise.recover): the
+    two paths must derive identical beacons and eligibility counts, or
+    a restart changes ballot weights and bad-beacon flags
+    (code-review r5)."""
     if ballot.epoch_data is not None:
         return ballot.epoch_data
     ref = get(db, ballot.ref_ballot)
     if ref is not None and ref.epoch_data is not None \
             and ref.node_id == ballot.node_id \
-            and ref.atx_id == ballot.atx_id:
+            and ref.atx_id == ballot.atx_id \
+            and (layers_per_epoch is None
+                 or ref.layer // layers_per_epoch
+                 == ballot.layer // layers_per_epoch):
         return ref.epoch_data
     return None
 

@@ -465,6 +465,13 @@ def main(argv=None) -> int:
     ap.add_argument("--reps", type=int, default=3)
     ap.add_argument("--cpu-labels", type=int, default=16,
                     help="labels for the OpenSSL reference measurement")
+    ap.add_argument("--warm", action="store_true",
+                    help="pre-compile the autotuned winner shapes into "
+                    "the persistent XLA cache (tools/warmcache.py)")
+    ap.add_argument("--warm-batches", default="8192,4096,2048,1024,512",
+                    help="batch sizes for --warm")
+    ap.add_argument("--warm-prove", action="store_true",
+                    help="--warm also compiles the prover's scan step")
     ap.add_argument("--timeline", metavar="TRACE_JSON", default=None,
                     help="summarize a span-trace export (top spans by "
                     "self-time, per-stage wait-vs-work split) instead of "
@@ -479,6 +486,15 @@ def main(argv=None) -> int:
         # pure file digestion: no accelerator probe, no jax import
         print(json.dumps(timeline_view(a.timeline, top=a.timeline_top),
                          indent=2))
+        return 0
+
+    if a.warm:
+        from . import warmcache
+
+        doc = warmcache.warm(
+            a.n, [int(b) for b in a.warm_batches.split(",") if b],
+            prove=a.warm_prove, probe=not a.no_probe)
+        print(json.dumps(doc, indent=2))
         return 0
 
     from ..utils import accel

@@ -1,0 +1,223 @@
+"""Pre-warm the persistent XLA compile cache for the autotuned shapes.
+
+The label pipeline pays 17-26s of XLA compile per (N, batch) executable
+on a cold host — a cost that dominates every short session (bench runs,
+CI jobs, a node's first init batch after an upgrade). The persistent
+compile cache (utils/accel.py) already makes that once-per-machine;
+this tool makes it once-per-NOBODY by compiling exactly the shapes the
+autotuned winners will run — ahead of time, so tier-1/bench/operator
+sessions start warm (ISSUE 6; the CI warm-cache job publishes the
+resulting cache directory and every other job restores it).
+
+What gets compiled per (N, bucketed batch):
+
+* the fused single-device label programs (``_labels_fused`` and the
+  min-scan variant) under the autotuned single-device decision — the
+  executables bench.py's sweep and the verifier's recomputes hit;
+* when the mesh race says ``devices > 1``: the GSPMD-sharded twins via
+  parallel/mesh.py — the executables the streaming initializer and the
+  bench mesh headline hit;
+* with ``--prove``: the streaming prover's scan step at its default
+  (bucketed) batch.
+
+Because decisions are taken through ops/autotune.py, a cold host races
+first (and persists the winners beside the cache), so one warmcache run
+leaves BOTH caches — executables and winners — ready. Shapes already in
+the cache deserialize in well under a second; the per-shape ``compile_s``
+in the output tells you which were actually cold.
+
+Usage:
+  python -m spacemesh_tpu.tools.warmcache [--n 8192]
+      [--batches 8192,4096,2048,1024,512] [--prove] [--no-mesh]
+      [--no-probe] [--cached-shapes]
+  python -m spacemesh_tpu.tools.profiler --warm      # same, via profiler
+
+``--cached-shapes`` additionally warms every shape that already has a
+persisted autotune winner for this platform (a machine that has run real
+workloads re-warms what those workloads used).
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import sys
+import time
+
+
+def _log(*a) -> None:
+    print(*a, file=sys.stderr, flush=True)
+
+
+def _cached_shapes(platform: str) -> list[tuple[int, int]]:
+    """(n, batch) pairs with a persisted autotune winner on this host."""
+    from ..ops import autotune
+
+    out = set()
+    prefix = f"v{autotune.SCHEMA}:{platform}:"
+    for key in autotune._load_cache():
+        if not key.startswith(prefix):
+            continue
+        try:
+            n_part, b_part = key[len(prefix):].split(":")[:2]
+            out.add((int(n_part[1:]), int(b_part[1:])))
+        except (ValueError, IndexError):
+            continue
+    return sorted(out)
+
+
+def _warm_shape(n: int, batch: int, mesh_ok: bool) -> dict:
+    """Compile (or cache-deserialize) every executable one (n, batch)
+    shape runs at; returns per-program seconds."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..ops import autotune, scrypt
+
+    commitment = hashlib.sha256(b"warmcache").digest()
+    cw = scrypt.commitment_to_words(commitment)
+    idx = np.arange(batch, dtype=np.uint64)
+    lo, hi = scrypt.split_indices(idx)
+    jcw, jlo, jhi = jnp.asarray(cw), jnp.asarray(lo), jnp.asarray(hi)
+
+    doc: dict = {"n": n, "batch": batch, "programs": {}}
+
+    def timed(name, fn):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn())
+        doc["programs"][name] = round(time.perf_counter() - t0, 2)
+        _log(f"  {name}: {doc['programs'][name]}s")
+
+    # single-device decision + fused programs (bench sweep, verifier)
+    d1 = autotune.decide(n, batch)
+    doc["impl"] = d1.impl
+    doc["chunk"] = d1.chunk
+    timed("labels_fused", lambda: scrypt.scrypt_labels_jit(
+        jcw, jlo, jhi, n=n))
+    timed("labels_min_fused", lambda: scrypt.scrypt_labels_with_min(
+        jcw, jlo, jhi, jnp.asarray(scrypt.vrf_carry_init()), n=n)[0])
+
+    if not mesh_ok:
+        return doc
+    dm = autotune.decide(n, batch, max_devices=None)
+    doc["devices"] = dm.devices
+    if dm.devices <= 1 or batch % dm.devices:
+        return doc
+    from ..parallel import mesh as pmesh
+
+    mesh = pmesh.data_mesh(jax.devices()[:dm.devices])
+    timed(f"labels_sharded_d{dm.devices}",
+          lambda: pmesh.scrypt_labels_sharded(mesh, cw, lo, hi, n=n,
+                                              impl=dm.impl))
+    timed(f"labels_min_sharded_d{dm.devices}",
+          lambda: pmesh.labels_with_min_sharded(
+              mesh, cw, lo, hi, scrypt.vrf_carry_init(), n=n,
+              impl=dm.impl)[0])
+    return doc
+
+
+def _warm_prove(batch: int) -> dict:
+    """Compile the streaming prover's scan step at its bucketed batch."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..ops import proving, scrypt
+
+    b = scrypt.shape_bucket(-(-batch // proving.HIT_SEGMENT)
+                            * proving.HIT_SEGMENT)
+    ng, cap = 16, 37  # prover defaults (nonce_group, k2)
+    cw = jnp.asarray(proving.challenge_words(bytes(32)))
+    idx = np.arange(b, dtype=np.uint64)
+    lo, hi = scrypt.split_indices(idx)
+    lw = jnp.zeros((4, b), jnp.uint32)
+    counts, carry = proving.init_hit_state(ng, cap)
+    t0 = time.perf_counter()
+    out = proving.prove_scan_step_jit(
+        cw, jnp.uint32(0), jnp.asarray(lo), jnp.asarray(hi), lw,
+        jnp.uint32(1 << 30), counts, carry, jnp.uint32(b),
+        jnp.uint32(0), jnp.uint32(0), n_nonces=ng, max_hits=cap)
+    jax.block_until_ready(out)
+    dt = round(time.perf_counter() - t0, 2)
+    _log(f"  prove_scan_step b={b}: {dt}s")
+    return {"batch": b, "nonce_group": ng, "compile_s": dt}
+
+
+def warm(n: int = 8192, batches: list[int] | None = None, *,
+         mesh: bool = True, prove: bool = False,
+         cached_shapes: bool = False, probe: bool = True) -> dict:
+    """Warm the persistent caches; returns a JSON-able report."""
+    import os
+
+    from ..utils import accel
+
+    if probe and not accel.ensure_usable_platform():
+        _log("accelerator unreachable; warming the CPU fallback")
+    if mesh and os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+        # BEFORE any backend use (jax.default_backend below instantiates
+        # it): expose the virtual host devices the mesh winners run on
+        accel.ensure_host_devices()
+    import jax
+
+    platform = jax.default_backend()
+    cache_dir = accel.enable_persistent_cache()
+    _log(f"persistent compile cache: {cache_dir or 'DISABLED'}")
+
+    from ..ops import autotune, scrypt
+
+    shapes = {(n, scrypt.shape_bucket(b))
+              for b in (batches or [8192, 4096, 2048, 1024, 512])}
+    if cached_shapes:
+        shapes.update(_cached_shapes(platform))
+    t0 = time.perf_counter()
+    done = []
+    for sn, sb in sorted(shapes):
+        _log(f"warming n={sn} b={sb} ...")
+        try:
+            done.append(_warm_shape(sn, sb, mesh))
+        except Exception as e:  # noqa: BLE001 — e.g. OOM at big batches
+            _log(f"  n={sn} b={sb} failed ({type(e).__name__}: {e})")
+            done.append({"n": sn, "batch": sb,
+                         "failed": type(e).__name__})
+    doc = {
+        "platform": platform,
+        "devices_visible": jax.device_count(),
+        "cache_dir": cache_dir,
+        "autotune_cache": autotune.cache_path(),
+        "shapes": done,
+        "elapsed_s": round(time.perf_counter() - t0, 1),
+    }
+    if prove:
+        doc["prove"] = _warm_prove(1 << 14)
+    return doc
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="warmcache",
+        description="pre-compile the autotuned winner shapes into the "
+                    "persistent XLA cache (docs/ROMIX_KERNEL.md)")
+    ap.add_argument("--n", type=int, default=8192, help="scrypt N")
+    ap.add_argument("--batches", default="8192,4096,2048,1024,512",
+                    help="comma-separated label batch sizes (bucketed)")
+    ap.add_argument("--no-mesh", action="store_true",
+                    help="skip the sharded (multi-device) programs")
+    ap.add_argument("--prove", action="store_true",
+                    help="also warm the streaming prover's scan step")
+    ap.add_argument("--cached-shapes", action="store_true",
+                    help="also warm every shape with a persisted "
+                    "autotune winner on this host")
+    ap.add_argument("--no-probe", action="store_true",
+                    help="skip the accelerator liveness probe (tests)")
+    a = ap.parse_args(argv)
+    doc = warm(a.n, [int(b) for b in a.batches.split(",") if b],
+               mesh=not a.no_mesh, prove=a.prove,
+               cached_shapes=a.cached_shapes, probe=not a.no_probe)
+    print(json.dumps(doc, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -57,6 +57,49 @@ def enable_persistent_cache(path: str | None = None) -> str | None:
     return dir_
 
 
+DEFAULT_HOST_DEVICES = 8  # the autotuner's raced mesh grid is {1,2,4,8}
+
+
+def ensure_host_devices(count: int | None = None) -> int:
+    """Expose ``count`` virtual CPU devices (XLA_FLAGS, this process AND
+    children) so the CPU fallback can lane-shard label batches across
+    them (parallel/mesh.py; the autotuner races whether/how many win —
+    ops/autotune.py mesh dimension).
+
+    Must run BEFORE the first backend use — the flag is read when the
+    CPU client is instantiated; afterwards it is inert (harmless). A
+    pre-existing ``xla_force_host_platform_device_count`` flag (tests'
+    conftest, the driver entry) is respected, as is
+    ``SPACEMESH_HOST_DEVICES`` (0/off disables). Oversubscription is
+    deliberate: more virtual devices than cores still wins on the
+    op-dispatch-bound label kernel (sequential per-device streams beat
+    one device's intra-op parallelism), and the race decides per host
+    how many to actually use. Returns the count in effect."""
+    env = os.environ.get("SPACEMESH_HOST_DEVICES")
+    if env is not None and env.lower() in ("0", "off", "none"):
+        return 1
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" in flags:
+        for part in flags.split():
+            if "xla_force_host_platform_device_count" in part:
+                try:
+                    return int(part.split("=", 1)[1])
+                except (IndexError, ValueError):
+                    return 1
+        return 1
+    try:
+        n = count if count is not None else int(env or DEFAULT_HOST_DEVICES)
+    except ValueError:
+        raise ValueError(
+            f"SPACEMESH_HOST_DEVICES={env!r}: expected a device count "
+            "or 0/off")
+    if n <= 1:
+        return 1
+    os.environ["XLA_FLAGS"] = (
+        flags + f" --xla_force_host_platform_device_count={n}").strip()
+    return n
+
+
 def accelerator_reachable(timeout_s: int = PROBE_TIMEOUT_S) -> bool:
     """``jax.devices()`` in a SUBPROCESS with a hard timeout."""
     try:

@@ -191,6 +191,22 @@ post_pipeline_meta_saves = REGISTRY.counter(
 post_pipeline_labels_per_sec = REGISTRY.gauge(
     "post_pipeline_labels_per_sec", "labels/s of the last init session")
 
+# autotuned device mesh (ops/autotune.py mesh dimension, consumed by
+# post/initializer.py + post/prover.py). Shard fetch seconds include the
+# first shard's wait for the sharded program to retire; the imbalance
+# gauge is (max-min)/max over the last batch's per-shard fetch seconds,
+# so a straggling device (or an unevenly split host thread pool) is
+# visible without a trace capture.
+post_mesh_devices = REGISTRY.gauge(
+    "post_mesh_devices",
+    "device count label batches are sharded over (1 = single device)")
+post_mesh_shard_labels_per_sec = REGISTRY.gauge(
+    "post_mesh_shard_labels_per_sec",
+    "mean per-shard label fetch throughput of the last sharded batch")
+post_mesh_shard_imbalance = REGISTRY.gauge(
+    "post_mesh_shard_imbalance",
+    "(max-min)/max per-shard fetch seconds of the last sharded batch")
+
 # ROMix label kernel (ops/scrypt.py dispatch + ops/autotune.py). The
 # fallback counter makes a Pallas selection that silently degraded to the
 # XLA path visible (an explicit SPACEMESH_ROMIX=pallas request raises

@@ -29,6 +29,20 @@ def test_compact_minimal_encoding_enforced():
         codec.decode(b"\x80\x00", codec.compact)
     with pytest.raises(codec.DecodeError):
         codec.decode(b"\xff" * 10 + b"\x01", codec.compact)
+    # 10 bytes at full fan-out lands at shift 63 with 7 payload bits —
+    # a value up to ~2^70 that the shift guard alone waves through
+    with pytest.raises(codec.DecodeError):
+        codec.decode(b"\xff" * 9 + b"\x7f", codec.compact)
+
+
+def test_lying_length_prefix_rejected_not_crashed():
+    """A var-bytes length prefix near 2^64 must raise DecodeError, not
+    OverflowError out of io.BytesIO.read (gossip fuzz found the crash:
+    one bit flip in a valid blob can inflate a compact length past
+    index size)."""
+    huge = codec.encode((1 << 64) - 1, codec.compact) + b"\x00" * 8
+    with pytest.raises(codec.DecodeError):
+        codec.decode(huge, codec.var_bytes)
 
 
 def test_trailing_bytes_rejected():

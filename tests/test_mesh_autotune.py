@@ -1,0 +1,366 @@
+"""The autotuner's mesh dimension + shape bucketing + warmcache (ISSUE 6).
+
+Three contracts, asserted rather than eyeballed:
+
+* **sharded bit-identity** — an init session whose autotuned winner says
+  ``devices > 1`` writes byte-identical labels (and the same VRF nonce)
+  as the single-device path, across ragged totals (1 / 7 / 1000) whose
+  tail batches exercise the bucket-then-mesh pad in
+  post/initializer.py ``_dispatch``;
+* **bucketed executable reuse** — ragged batch sizes inside one
+  power-of-two bucket share ONE compiled executable
+  (ops/scrypt.py ``shape_bucket``), measured by the in-process compile
+  counter, not by timing;
+* **warmcache round-trip** — a cold ``tools/warmcache.py`` run populates
+  the persistent XLA cache so a second (warm) run's per-program compile
+  seconds collapse to ~0 (the bench's ``post_init_compile_s`` contract).
+"""
+
+import hashlib
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from spacemesh_tpu.ops import autotune, scrypt
+from spacemesh_tpu.parallel.mesh import data_mesh, scrypt_labels_sharded
+from spacemesh_tpu.post import initializer
+from spacemesh_tpu.post.data import LabelStore, PostMetadata
+from spacemesh_tpu.utils import metrics
+
+NODE = hashlib.sha256(b"mesh-node").digest()
+COMMIT = hashlib.sha256(b"mesh-commitment").digest()
+N = 2
+BATCH = 256
+
+
+@pytest.fixture
+def tuner(tmp_path, monkeypatch):
+    """Fresh autotune world (same shape as tests/test_romix_autotune.py):
+    private winners file, no overrides, no memoized decisions. Racing
+    stays OFF (conftest) — these tests seed winners explicitly."""
+    path = tmp_path / "romix_autotune.json"
+    monkeypatch.setenv(autotune.ENV_CACHE, str(path))
+    monkeypatch.delenv(autotune.ENV_IMPL, raising=False)
+    monkeypatch.delenv(autotune.ENV_CHUNK, raising=False)
+    monkeypatch.delenv(autotune.ENV_MESH, raising=False)
+    autotune.reset_memo()
+    yield path
+    autotune.reset_memo()
+
+
+def _seed_mesh_winner(path, n, batch, devices, impl="xla"):
+    """Persist a mesh winner under the key the initializer's decide()
+    call (max_devices=None -> dev_cap 8 on the virtual 8-device host)
+    actually looks up: the BUCKETED batch hint."""
+    key = autotune._key("cpu", n, scrypt.shape_bucket(batch),
+                        autotune._device_cap(None))
+    doc = json.loads(path.read_text()) if path.exists() else {}
+    doc[key] = {"impl": impl, "chunk": None, "devices": devices,
+                "labels_per_sec": 9999.0}
+    path.write_text(json.dumps(doc))
+
+
+def _disk_labels(d, count):
+    meta = PostMetadata.load(d)
+    return LabelStore(d, meta).read_labels(0, count)
+
+
+# --- sharded-vs-single bit-identity across ragged totals ------------------
+
+
+@pytest.mark.parametrize("total", (1, 7, 1000))
+def test_autotuned_mesh_init_bit_identical(total, tuner, tmp_path):
+    """End to end through the initializer: a seeded devices=4 winner
+    routes batches over the mesh (bucket pad + mesh pad + trim), and the
+    bytes on disk — and the VRF nonce — match the single-device ground
+    truth exactly. total=1 also proves the devices<=batch clamp: a
+    4-device winner cannot shard one lane, so the session honestly runs
+    single-device."""
+    hint = min(BATCH, total)
+    _seed_mesh_winner(tuner, N, hint, devices=4)
+
+    d = tmp_path / f"mesh-{total}"
+    meta, _res = initializer.initialize(
+        d, node_id=NODE, commitment=COMMIT, num_units=1,
+        labels_per_unit=total, scrypt_n=N, max_file_size=1 << 20,
+        batch_size=BATCH, mesh="auto")
+
+    assert meta.labels_written == total
+    got = np.frombuffer(_disk_labels(d, total), dtype=np.uint8)
+    want = scrypt.scrypt_labels(COMMIT, np.arange(total, dtype=np.uint64),
+                                n=N)
+    assert np.array_equal(got.reshape(-1, 16), want), \
+        f"sharded labels diverged from single-device at total={total}"
+    lo = want[:, :8].copy().view("<u8").ravel()
+    hi = want[:, 8:].copy().view("<u8").ravel()
+    assert meta.vrf_nonce == int(np.lexsort((lo, hi))[0])
+
+    expected_devices = 4 if total >= 4 else 1
+    assert metrics.post_mesh_devices._values.get(()) == expected_devices
+
+
+def test_mesh_decision_consumed_and_reported(tuner, tmp_path):
+    """The seeded winner is what the session runs with (gauge + shard
+    metrics), and shard-imbalance telemetry appears for sharded runs."""
+    _seed_mesh_winner(tuner, N, BATCH, devices=4)
+    metrics.post_mesh_shard_imbalance.set(-1.0)
+    d = tmp_path / "telemetry"
+    initializer.initialize(
+        d, node_id=NODE, commitment=COMMIT, num_units=1,
+        labels_per_unit=512, scrypt_n=N, max_file_size=1 << 20,
+        batch_size=BATCH, mesh="auto")
+    assert metrics.post_mesh_devices._values.get(()) == 4
+    imb = metrics.post_mesh_shard_imbalance._values.get(())
+    assert imb is not None and 0.0 <= imb <= 1.0
+
+
+@pytest.mark.parametrize("impl", ("xla", "xla-rows"))
+def test_sharded_impl_passthrough_bit_identity(impl):
+    """Both raced mesh layouts produce identical labels through the
+    sharded entry point (the winner's impl rides into the dispatch)."""
+    idx = np.arange(64, dtype=np.uint64)
+    lo, hi = scrypt.split_indices(idx)
+    want = scrypt.scrypt_labels(COMMIT, idx, n=4)
+    mesh = data_mesh(jax.devices()[:4])
+    cw = scrypt.commitment_to_words(COMMIT)
+    words = scrypt_labels_sharded(mesh, cw, lo, hi, n=4, impl=impl)
+    got = np.frombuffer(scrypt.labels_to_bytes(np.asarray(words)),
+                        dtype=np.uint8).reshape(-1, 16)
+    assert np.array_equal(got, want), f"impl={impl} diverged under mesh"
+
+
+# --- decision-surface units for the mesh dimension ------------------------
+
+
+def test_read_mesh_env_parsing(monkeypatch):
+    monkeypatch.delenv(autotune.ENV_MESH, raising=False)
+    assert autotune.read_mesh_env() is None
+    monkeypatch.setenv(autotune.ENV_MESH, "auto")
+    assert autotune.read_mesh_env() is None
+    monkeypatch.setenv(autotune.ENV_MESH, "off")
+    assert autotune.read_mesh_env() == 1
+    monkeypatch.setenv(autotune.ENV_MESH, "3")
+    assert autotune.read_mesh_env() == 3
+    monkeypatch.setenv(autotune.ENV_MESH, "on")
+    assert autotune.read_mesh_env() == jax.device_count()
+    monkeypatch.setenv(autotune.ENV_MESH, "lots")
+    with pytest.raises(ValueError, match="SPACEMESH_MESH"):
+        autotune.read_mesh_env()
+    monkeypatch.setenv(autotune.ENV_MESH, "-2")
+    with pytest.raises(ValueError, match="SPACEMESH_MESH"):
+        autotune.read_mesh_env()
+
+
+def test_mesh_candidates_grid():
+    assert autotune.mesh_candidates(8) == [2, 4, 8]
+    assert autotune.mesh_candidates(3) == [2]
+    assert autotune.mesh_candidates(1) == []
+    assert autotune.mesh_candidates(16, cap=4) == [2, 4]
+    # the raced grid includes per-device-count rows for both CPU layouts
+    combos = autotune.candidates("cpu", N, autotune.CAL_BATCH, mesh_cap=8)
+    assert ("xla", None, 8) in combos and ("xla-rows", None, 4) in combos
+    # single-device callers never see mesh rows
+    assert all(dev == 1 for _, _, dev in
+               autotune.candidates("cpu", N, autotune.CAL_BATCH))
+
+
+def test_winner_noise_band_prefers_fewer_devices():
+    """Within the calibration noise band the narrowest mesh wins (the
+    fixed 512-lane calibration flatters wide meshes; sharding overhead
+    grows with the production batch). Outside the band, rate wins."""
+    rows = [
+        {"impl": "xla", "chunk": None, "devices": 8, "labels_per_sec": 69.0},
+        {"impl": "xla", "chunk": None, "devices": 4, "labels_per_sec": 67.0},
+        {"impl": "xla-rows", "chunk": None, "devices": 1,
+         "labels_per_sec": 59.0},
+    ]
+    assert autotune._select_winner(rows)["devices"] == 4
+    # a single-device row inside the band beats every mesh row: a mesh
+    # "win" within noise is not a win
+    rows[2]["labels_per_sec"] = 66.0
+    assert autotune._select_winner(rows)["devices"] == 1
+    # far apart: the fastest row wins regardless of width
+    rows[1]["labels_per_sec"] = rows[2]["labels_per_sec"] = 30.0
+    assert autotune._select_winner(rows)["devices"] == 8
+    # equal devices tie-break back to rate
+    rows = [{"impl": "xla", "chunk": None, "devices": 2,
+             "labels_per_sec": 50.0},
+            {"impl": "xla-rows", "chunk": None, "devices": 2,
+             "labels_per_sec": 51.0}]
+    assert autotune._select_winner(rows)["impl"] == "xla-rows"
+
+
+def test_mesh_off_holds_through_the_race_path(tuner, monkeypatch):
+    """SPACEMESH_MESH=off with racing ENABLED (the production default —
+    conftest pins autotune off, which used to mask this): the decision
+    must collapse to the single-device budget before the race, so the
+    race can neither select nor persist a devices>1 row."""
+    monkeypatch.setenv(autotune.ENV_MESH, "off")
+    monkeypatch.delenv(autotune.ENV_AUTOTUNE, raising=False)
+    calls = []
+
+    def fake_race(platform, n, batch, dev_cap=1, pin_devices=None):
+        calls.append((dev_cap, pin_devices))
+        return autotune.Decision("xla", None, "race")
+
+    monkeypatch.setattr(autotune, "race", fake_race)
+    d = autotune.decide(N, BATCH, platform="cpu", max_devices=None)
+    assert d.devices == 1
+    assert calls == [(1, None)], \
+        "the off switch must clamp the race's device budget to 1"
+
+
+def test_failed_race_candidates_not_retried(tuner, monkeypatch):
+    """A candidate that failed is persisted as a 0-rate row: the next
+    decide must not see it as missing (re-racing it every process), and
+    it must never win."""
+    key = autotune._meas_key("cpu", N)
+    rows = [{"impl": "xla", "chunk": None, "devices": 1,
+             "labels_per_sec": 100.0}]
+    rows += [{"impl": impl, "chunk": c, "devices": dv,
+              "labels_per_sec": 0.0, "failed": "RuntimeError"}
+             for impl, c, dv in autotune.candidates(
+                 "cpu", N, autotune.CAL_BATCH,
+                 mesh_cap=autotune._device_cap(None))
+             if not (impl == "xla" and c is None and dv == 1)]
+    doc = json.loads(tuner.read_text()) if tuner.exists() else {}
+    doc[key] = {"raced": rows}
+    tuner.write_text(json.dumps(doc))
+    monkeypatch.delenv(autotune.ENV_AUTOTUNE, raising=False)
+    monkeypatch.setattr(autotune, "_race_rows",
+                        lambda *a, **k: pytest.fail("re-raced a failed "
+                                                    "candidate"))
+    d = autotune.decide(N, BATCH, platform="cpu", max_devices=None)
+    assert (d.impl, d.devices) == ("xla", 1)
+
+
+def test_forced_mesh_device_count_beats_cached_winner(tuner, monkeypatch):
+    _seed_mesh_winner(tuner, N, BATCH, devices=8)
+    d = autotune.decide(N, BATCH, platform="cpu", max_devices=None)
+    assert (d.devices, d.source) == (8, "cache")
+    monkeypatch.setenv(autotune.ENV_MESH, "2")
+    d = autotune.decide(N, BATCH, platform="cpu", max_devices=None)
+    assert (d.devices, d.source) == (2, "env")
+    monkeypatch.setenv(autotune.ENV_MESH, "off")
+    d = autotune.decide(N, BATCH, platform="cpu", max_devices=None)
+    assert d.devices == 1
+    # the cap-1 lookup (ops/scrypt.py per-call dispatch) is untouched by
+    # the mesh winner: it must never try to shard
+    monkeypatch.delenv(autotune.ENV_MESH)
+    assert autotune.decide(N, BATCH, platform="cpu").devices == 1
+
+
+# --- bucketed executable reuse (the compile counter, not a stopwatch) -----
+
+
+def test_bucketed_shapes_share_one_executable(tuner):
+    """Every ragged batch inside a power-of-two bucket reuses the
+    bucket's executable; crossing the bucket boundary mints exactly one
+    more. Asserted on the jit cache-entry counter."""
+    n = 64  # a (n, shape) family no other test compiles
+    cw = jnp.asarray(scrypt.commitment_to_words(COMMIT))
+
+    def labels(b):
+        lo, hi = scrypt.split_indices(np.arange(b, dtype=np.uint64))
+        return scrypt.scrypt_labels_jit(cw, jnp.asarray(lo),
+                                        jnp.asarray(hi), n=n)
+
+    base = scrypt.compiled_shape_count()
+    out5 = labels(5)
+    assert out5.shape == (4, 5)  # trimmed back to the caller's batch
+    assert scrypt.compiled_shape_count() == base + 1
+    for b in (6, 7, 8):
+        assert labels(b).shape == (4, b)
+    assert scrypt.compiled_shape_count() == base + 1, \
+        "ragged batches 5..8 must share the bucket-8 executable"
+    labels(9)  # bucket 16
+    assert scrypt.compiled_shape_count() == base + 2
+
+    # bit-identity of the pad-and-trim against ground truth
+    want = scrypt.scrypt_labels(COMMIT, np.arange(5, dtype=np.uint64), n=n)
+    got = np.frombuffer(scrypt.labels_to_bytes(np.asarray(out5)),
+                        dtype=np.uint8).reshape(-1, 16)
+    assert np.array_equal(got, want)
+
+
+def test_bucketed_min_scan_carry_is_exact(tuner):
+    """Pad lanes repeat the last index: the VRF min-scan's carry must be
+    identical to the unpadded result (first-occurrence wins)."""
+    n = 64
+    total = 11  # bucket 16: 5 pad lanes
+    idx = np.arange(total, dtype=np.uint64)
+    lo, hi = scrypt.split_indices(idx)
+    cw = jnp.asarray(scrypt.commitment_to_words(COMMIT))
+    base = scrypt.compiled_shape_count()
+    words, _carry, snap = scrypt.scrypt_labels_with_min(
+        cw, jnp.asarray(lo), jnp.asarray(hi),
+        jnp.asarray(scrypt.vrf_carry_init()), n=n)
+    assert words.shape == (4, total)
+    assert scrypt.compiled_shape_count() == base + 1
+
+    want = scrypt.scrypt_labels(COMMIT, idx, n=n)
+    wlo = want[:, :8].copy().view("<u8").ravel()
+    whi = want[:, 8:].copy().view("<u8").ravel()
+    want_k = int(np.lexsort((wlo, whi))[0])
+    decoded = scrypt.vrf_carry_decode(snap)
+    assert decoded is not None and decoded[0] == want_k
+
+
+def test_shape_bucket_contract(monkeypatch):
+    assert scrypt.shape_bucket(1) == 1
+    assert scrypt.shape_bucket(5) == 8
+    assert scrypt.shape_bucket(8) == 8
+    assert scrypt.shape_bucket(1000) == 1024
+    monkeypatch.setenv(scrypt.ENV_BUCKETS, "off")
+    assert scrypt.shape_bucket(1000) == 1000
+
+
+# --- warmcache round-trip: cold compile -> warm ~0 ------------------------
+
+
+def _run_warmcache(cache_dir, tmp_path):
+    env = dict(os.environ,
+               JAX_PLATFORMS="cpu",
+               SPACEMESH_JAX_CACHE=str(cache_dir),
+               SPACEMESH_ROMIX_CACHE=str(tmp_path / "tune.json"),
+               SPACEMESH_ROMIX_AUTOTUNE="off")
+    r = subprocess.run(
+        [sys.executable, "-m", "spacemesh_tpu.tools.warmcache",
+         "--n", "32", "--batches", "64", "--no-mesh", "--no-probe"],
+        env=env, capture_output=True, text=True, timeout=570)
+    assert r.returncode == 0, r.stderr[-2000:]
+    return json.loads(r.stdout)
+
+
+def test_warmcache_cold_then_warm(tmp_path):
+    """The CLI's first (cold) run pays the XLA compiles into the
+    persistent cache; a second process deserializes instead — every
+    per-program second collapses below the bench's 1s warm budget
+    (`post_init_compile_s` contract, ISSUE 6)."""
+    cache = tmp_path / "xla-cache"
+    cold = _run_warmcache(cache, tmp_path)
+    assert cold["cache_dir"] and cold["shapes"], cold
+    cold_s = cold["shapes"][0]["programs"]
+    assert cold_s, "cold run compiled nothing"
+
+    warm = _run_warmcache(cache, tmp_path)
+    warm_s = warm["shapes"][0]["programs"]
+    assert set(warm_s) == set(cold_s)
+    for prog, secs in warm_s.items():
+        # warm = deserialize + trace, no XLA compile. The absolute floor
+        # absorbs loaded CI containers; the relative bound is the
+        # contract (a cache miss re-pays the FULL compile at ~1.0x cold,
+        # far over both; measured warm restores land at 0.2-0.4x on a
+        # throttled 2-core container, so 0.5x keeps headroom without
+        # losing the miss/hit separation)
+        assert secs <= max(1.0, 0.5 * cold_s[prog]), \
+            f"{prog} took {secs}s warm (cold {cold_s[prog]}s) — " \
+            "persistent cache did not round-trip"
+    # and warming was not a no-op: the cold run actually compiled
+    assert max(cold_s.values()) > max(warm_s.values())

@@ -171,16 +171,25 @@ def test_counting_reader_tracks_buffered_bytes():
         assert r.buffered == 0  # partial counted as consumed
 
         # delegating methods must not double-count (code-review r5:
-        # readline -> readuntil and read(-1) -> read(n) re-enter the
-        # counting overrides; a second count drives buffered negative
-        # and disables backpressure forever)
+        # readuntil and read(-1) -> read(n) re-enter the counting
+        # overrides; a second count drives buffered negative and
+        # disables backpressure forever)
         r2 = CountingReader()
         r2.feed_data(b"one\ntwo")
-        assert await r2.readline() == b"one\n"
+        assert await r2.readuntil(b"\n") == b"one\n"
         assert r2.buffered == 3
         r2.feed_eof()
         assert await r2.read(-1) == b"two"
         assert r2.buffered == 0
+
+        # readline() is refused outright (ADVICE r5): its
+        # LimitOverrunError recovery truncates the private buffer behind
+        # the counter's back, silently corrupting flow-control accounting
+        r3 = CountingReader()
+        r3.feed_data(b"line\n")
+        with pytest.raises(NotImplementedError):
+            await r3.readline()
+        assert r3.buffered == 5  # nothing consumed by the refusal
 
     asyncio.run(go())
 

@@ -199,7 +199,10 @@ def test_cached_pallas_winner_falls_back_and_counts(tuner, monkeypatch):
     from spacemesh_tpu.utils import metrics
 
     _break_pallas(monkeypatch)
-    _seed(tuner, autotune._key("cpu", 4, 6), "pallas", None)
+    # decisions are keyed by the BUCKETED batch — the executable shape a
+    # 6-lane call actually runs at (ops/scrypt.py shape_bucket)
+    _seed(tuner, autotune._key("cpu", 4, scrypt.shape_bucket(6)),
+          "pallas", None)
     before = sum(metrics.post_romix_fallback._values.values())
     commitment = hashlib.sha256(b"pallas-falls-back").digest()
     got = scrypt.scrypt_labels(commitment, np.arange(6, dtype=np.uint64),
