@@ -90,6 +90,14 @@ class ApiServer:
             r.add_get(route, handler)
             r.add_post(route, handler)
         r.add_get("/debug/trace/export", self.trace_export)
+        # health & SLO engine surface (obs/health.py, docs/OBSERVABILITY.md):
+        # /healthz is liveness (the tick loop runs), /readyz is per-
+        # component readiness with reasons, /debug/flight spools a
+        # diagnostic bundle on demand
+        r.add_get("/healthz", self.healthz)
+        r.add_get("/readyz", self.readyz)
+        r.add_get("/debug/flight", self.debug_flight)
+        r.add_post("/debug/flight", self.debug_flight)
 
     # --- lifecycle ---------------------------------------------------
 
@@ -383,6 +391,63 @@ class ApiServer:
             .print_stats(40)
         return web.Response(text=buf.getvalue(),
                             content_type="text/plain")
+
+    # --- health & SLO engine (obs/health.py) --------------------------
+
+    def _engine(self):
+        return getattr(self.node, "health_engine", None)
+
+    async def healthz(self, req) -> web.Response:
+        """Liveness: 200 while the health engine's tick loop is not
+        wedged (or when no engine is attached — a serving process with
+        nothing registered is alive by definition)."""
+        engine = self._engine()
+        if engine is None:
+            return web.json_response({"status": "ok", "engine": False})
+        if not engine.live():
+            return web.json_response(
+                {"status": "wedged",
+                 "detail": "health tick loop missed 3+ intervals"},
+                status=503)
+        return web.json_response({"status": "ok", "engine": True})
+
+    async def readyz(self, req) -> web.Response:
+        """Per-component readiness with reasons + SLO state. 503 while
+        any registered component probe fails."""
+        engine = self._engine()
+        if engine is not None:
+            # serves the background loop's cached report when fresh; a
+            # loop-less embedder evaluates inline with the flight dump
+            # deferred, and the dump (trace-ring serialization + disk
+            # writes) is flushed off the loop so a readiness poll can't
+            # stall gossip exactly when the node is unhealthy
+            report = engine.current_report()
+            if engine._pending_dump is not None:
+                await asyncio.to_thread(engine.flush_dump)
+        else:
+            # no engine (stub embedders): probes from the global health
+            # registry still answer, without SLI/SLO evaluation
+            from ..obs import health as health_mod
+
+            components = health_mod.HEALTH.report()
+            report = {"ready": all(e["healthy"]
+                                   for e in components.values()),
+                      "components": components, "slos": {}, "slis": {}}
+        return web.json_response(
+            report, status=200 if report["ready"] else 503)
+
+    async def debug_flight(self, req) -> web.Response:
+        """Spool a flight bundle NOW (manual trigger; bypasses the
+        breach rate limit)."""
+        engine = self._engine()
+        if engine is None:
+            raise web.HTTPConflict(text="no health engine attached")
+        reason = req.query.get("reason", "manual")
+        path = await asyncio.to_thread(engine.dump_flight, reason)
+        if path is None:
+            raise web.HTTPConflict(
+                text="no flight spool dir configured on the engine")
+        return web.json_response({"bundle": path, "reason": reason})
 
     # --- span-trace capture (docs/OBSERVABILITY.md) -------------------
 

@@ -10,9 +10,11 @@ from __future__ import annotations
 
 import dataclasses
 import threading
+import time
 from typing import Optional
 
 from ..core.types import Block, Proposal, Reward, Transaction
+from ..utils import metrics
 from ..storage import blocks as blockstore
 from ..storage import layers as layerstore
 from ..storage import misc as miscstore
@@ -143,6 +145,16 @@ class Mesh:
     def process_layer(self, layer: int) -> None:
         """Tortoise-driven path: tally votes, apply validity updates,
         revert + reapply on opinion change (reference mesh.go:302)."""
+        t0 = time.perf_counter()
+        try:
+            self._process_layer(layer)
+        finally:
+            # the layer-apply latency SLI (obs/sli.py): observed at the
+            # ONE choke point every caller (layer loop, hare drain,
+            # sync apply) funnels through
+            metrics.layer_apply_seconds.observe(time.perf_counter() - t0)
+
+    def _process_layer(self, layer: int) -> None:
         self.tortoise.tally_votes(layer)
         min_changed = None
         for upd in self.tortoise.updates():

@@ -40,6 +40,11 @@ def main(argv=None) -> int:
     from .app import App
     from .config import load
     from . import events as events_mod
+    from ..utils import logging as slog
+
+    # SPACEMESH_LOG_JSON=1 flips this to trace-correlated JSON lines
+    # (utils/logging.py JsonFormatter; docs/OBSERVABILITY.md)
+    slog.configure()
 
     overrides = {}
     if a.data_dir:

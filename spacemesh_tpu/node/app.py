@@ -152,6 +152,14 @@ class App:
 
         self.verify_farm = VerificationFarm(
             ed_verifier=self.verifier, post_params=self.post_params)
+        # node-wide health & SLO engine (obs/health.py): windowed SLIs
+        # over the metrics registry, stall watchdogs the pipelines and
+        # the farm register on obs.health.HEALTH, flight bundles spooled
+        # under the data dir; served as /healthz + /readyz (api/http.py)
+        from ..obs.health import HealthEngine
+
+        self.health_engine = HealthEngine(
+            bus=self.events, spool_dir=self.data / "flight")
         self.atx_handler = activation.Handler(
             db=self.state, cache=self.cache, verifier=self.verifier,
             golden_atx=self.golden_atx, post_params=self.post_params,
@@ -843,10 +851,53 @@ class App:
             on_drift=lambda off: self.events.emit(
                 _ev.ClockDrift(offset=off)))
         self._tasks.append(asyncio.ensure_future(self.peersync.run()))
+        self._register_network_probes()
         return addr
+
+    def _register_network_probes(self) -> None:
+        """Sync + clock-drift liveness on the global health registry
+        (obs/health.py): while catching up, the processed frontier (or
+        the sync state itself) must advance; the clock probe reports the
+        peersync median offset against its tolerance."""
+        from ..obs import health as health_mod
+        from ..storage import layers as layerstore
+
+        sync_wd = health_mod.Watchdog(
+            "sync",
+            progress=lambda: (self.syncer.state.value,
+                              layerstore.processed(self.state)),
+            deadline_s=120.0,
+            active=lambda: (self.syncer is not None
+                            and not self.syncer.is_synced()
+                            and self.clock.genesis_reached()))
+
+        def clock_probe(now: float):
+            ps = getattr(self, "peersync", None)
+            offset = ps.last_offset if ps is not None else None
+            if offset is None:
+                return True, "no quorum yet"
+            tolerance = ps.max_drift
+            if abs(offset) > tolerance:
+                return False, (f"clock drift {offset:.2f}s exceeds "
+                               f"tolerance {tolerance:.2f}s")
+            return True, f"offset={offset:.3f}s"
+
+        # keep the probe objects: unregister must be equality-checked so
+        # tearing down THIS node never evicts another in-process node's
+        # live probes from the shared registry (multi-App test clusters)
+        self._sync_probe = sync_wd.check
+        self._clock_probe = clock_probe
+        health_mod.HEALTH.register("sync", self._sync_probe)
+        health_mod.HEALTH.register("clock", self._clock_probe)
 
     async def stop_network(self) -> None:
         if getattr(self, "host", None) is not None:
+            from ..obs import health as health_mod
+
+            if getattr(self, "_sync_probe", None) is not None:
+                health_mod.HEALTH.unregister("sync", self._sync_probe)
+            if getattr(self, "_clock_probe", None) is not None:
+                health_mod.HEALTH.unregister("clock", self._clock_probe)
             if self.syncer is not None:
                 self.syncer.stop()
             if getattr(self, "peersync", None) is not None:
@@ -1096,6 +1147,7 @@ class App:
         from ..api import ApiServer
 
         self.api = ApiServer(self, listen=self.cfg.api.private_listener)
+        self.health_engine.ensure_running()
         return await self.api.start()
 
     async def start_grpc_api(self) -> int:
@@ -1142,6 +1194,7 @@ class App:
             await self.prepare()
         from ..storage import layers as layerstore
 
+        self.health_engine.ensure_running()
         seen_epochs = {0}
         async for layer in self.clock.ticks():
             if layer <= layerstore.processed(self.state):
@@ -1209,6 +1262,7 @@ class App:
         for t in self._hare_tasks.values():
             t.cancel()
         self._hare_tasks.clear()
+        self.health_engine.close()
         self.verify_farm.shutdown()
         if self.post_supervisor is not None:
             self.post_supervisor.stop()

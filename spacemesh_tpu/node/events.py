@@ -11,7 +11,9 @@ from __future__ import annotations
 
 import asyncio
 import dataclasses
-from collections import defaultdict
+import time
+import weakref
+from collections import defaultdict, deque
 from typing import Any, Type
 
 from ..utils import metrics
@@ -76,6 +78,26 @@ class Malfeasance:
     node_id: bytes
 
 
+@dataclasses.dataclass
+class SloBreach:
+    """A declarative SLO's burn exceeded its budget (obs/health.py)."""
+
+    slo: str
+    sli: str
+    value: float
+    target: float
+    burn: float
+
+
+@dataclasses.dataclass
+class ComponentHealth:
+    """A component liveness probe changed verdict (obs/health.py)."""
+
+    component: str
+    healthy: bool
+    reason: str
+
+
 class Subscription:
     def __init__(self, bus: "EventBus", types: tuple, size: int):
         self._bus = bus
@@ -101,8 +123,15 @@ class Subscription:
 
 
 class EventBus:
+    # a bounded ring of the last emissions, for the flight recorder: a
+    # diagnostic bundle wants "what just happened" without any consumer
+    # having subscribed in advance
+    RECENT = 256
+
     def __init__(self) -> None:
         self._subs: dict[type, list[Subscription]] = defaultdict(list)
+        self.recent: deque = deque(maxlen=self.RECENT)
+        _BUSES.add(self)
 
     def subscribe(self, *types: Type, size: int = 256) -> Subscription:
         sub = Subscription(self, types, size)
@@ -111,17 +140,42 @@ class EventBus:
         return sub
 
     def emit(self, ev: Any) -> None:
-        subs = list(self._subs.get(type(ev), ()))
-        for sub in subs:
+        self.recent.append((time.time(), type(ev).__name__, ev))
+        for sub in list(self._subs.get(type(ev), ())):
             sub._offer(ev)
-        if subs:
-            # deepest queue across this event's subscribers: a consumer
-            # falling behind trends this toward its bound before the
-            # overflow counter ever fires
-            metrics.events_queue_depth.set(
-                max(s.queue.qsize() for s in subs))
+
+    def deepest_queue(self) -> int:
+        """Deepest subscription queue right now (scrape-time truth).
+        Snapshots the dict/lists first: collectors run from flight-dump
+        worker threads while the loop thread subscribes (GIL makes the
+        list() copies atomic; plain iteration would race a dict
+        resize)."""
+        deepest = 0
+        seen: set[int] = set()
+        for subs in list(self._subs.values()):
+            for sub in list(subs):
+                if id(sub) in seen:
+                    continue  # multi-type subscriptions appear once
+                seen.add(id(sub))
+                deepest = max(deepest, sub.queue.qsize())
+        return deepest
 
     def _drop(self, sub: Subscription) -> None:
         for t in sub.types:
             if sub in self._subs.get(t, ()):
                 self._subs[t].remove(sub)
+
+
+# The queue-depth gauge is recomputed at SCRAPE time over every live
+# bus: the old emit-time write never decayed as consumers drained (or
+# when the deepest subscriber closed), so /metrics reported the
+# high-water mark of the last emission forever.
+_BUSES: "weakref.WeakSet[EventBus]" = weakref.WeakSet()
+
+
+def _collect_queue_depth() -> None:
+    metrics.events_queue_depth.set(
+        max((bus.deepest_queue() for bus in list(_BUSES)), default=0))
+
+
+metrics.REGISTRY.add_collector(_collect_queue_depth)

@@ -37,6 +37,10 @@ class PeerSync:
         self.interval = interval
         self.min_peers = min_peers
         self.on_drift = on_drift
+        # last measured median offset (None before the first quorum) —
+        # the clock-drift health probe (obs/health.py via node/app.py)
+        # reads this instead of re-sampling the network per scrape
+        self.last_offset: float | None = None
         self._stop = False
         server.register(PROTOCOL, self._serve)
 
@@ -69,6 +73,8 @@ class PeerSync:
     async def run(self) -> None:
         while not self._stop:
             offset = await self.check()
+            if offset is not None:
+                self.last_offset = offset
             if offset is not None and abs(offset) > self.max_drift:
                 log.error("clock drift %.2fs exceeds tolerance %.2fs — "
                           "fix the system clock", offset, self.max_drift)

@@ -1,0 +1,25 @@
+"""Health & SLO engine: windowed SLIs, watchdogs, flight recorder.
+
+The metrics registry accumulates since boot; the span tracer explains
+individual units of work. Neither answers "is this node healthy RIGHT
+NOW". This package does:
+
+* ``sli.py``     — rolling-window service-level indicators computed from
+                   registry snapshots (counter-rate deltas, interpolated
+                   quantiles from histogram bucket deltas) plus runtime
+                   collectors (RSS, fds, event-loop lag).
+* ``health.py``  — declarative SLOs with burn-rate accounting, a
+                   component health registry with progress-counter stall
+                   watchdogs, and the HealthEngine tick loop behind
+                   ``/healthz`` and ``/readyz``.
+* ``flight.py``  — the flight recorder: on a breach or stall, dump a
+                   spooled diagnostic bundle (trace export, metrics
+                   snapshot, recent events, health report).
+
+docs/OBSERVABILITY.md documents the SLO spec format, the HTTP surface
+and the flight-bundle layout.
+"""
+
+from . import flight, health, sli  # noqa: F401
+
+__all__ = ["sli", "health", "flight"]

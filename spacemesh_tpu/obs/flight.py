@@ -1,0 +1,195 @@
+"""Flight recorder: spool a diagnostic bundle when something breaks.
+
+An SLO breach or a stalled component is exactly the moment an operator
+wishes they had started a trace capture five minutes ago. The flight
+recorder makes that retroactively true: the span tracer's ring, the
+full metrics exposition, the event bus's recent ring and the health
+report are all already in memory — a dump just serializes them into a
+timestamped bundle directory under the spool dir:
+
+    flight-<unix_ts>-<pid>-<seq>/
+        manifest.json   {reason, unix_ts, pid, health}
+        trace.json      tracing.export() (validates via tracing.validate)
+        metrics.prom    Registry.expose() text exposition
+        events.json     recent EventBus emissions (bounded ring)
+        health.json     the engine's readiness report at dump time
+
+Automatic dumps (engine tick transitions) are rate-limited to one per
+``min_interval_s`` so a flapping SLO cannot fill the disk; the manual
+``/debug/flight`` trigger bypasses the limit. The spool keeps the
+newest ``keep`` bundles and prunes the rest.
+
+``profiler --flight <bundle>`` (tools/profiler.py) digests a bundle:
+validates the trace, summarizes it, and prints the unhealthy components
+and breached SLOs from the manifest.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import json
+import os
+import shutil
+import time
+from pathlib import Path
+
+from ..utils import logging as slog
+from ..utils import metrics, tracing
+
+_log = slog.get("flight")
+
+DEFAULT_MIN_INTERVAL_S = 60.0
+DEFAULT_KEEP = 8
+
+MANIFEST = "manifest.json"
+TRACE = "trace.json"
+METRICS = "metrics.prom"
+EVENTS = "events.json"
+HEALTH = "health.json"
+
+
+def _jsonable(obj):
+    """Best-effort JSON projection for event payloads (bytes -> hex)."""
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        return {k: _jsonable(v)
+                for k, v in dataclasses.asdict(obj).items()}
+    if isinstance(obj, bytes):
+        return obj.hex()
+    if isinstance(obj, (list, tuple)):
+        return [_jsonable(v) for v in obj]
+    if isinstance(obj, dict):
+        return {str(k): _jsonable(v) for k, v in obj.items()}
+    if isinstance(obj, (str, int, float, bool)) or obj is None:
+        return obj
+    return repr(obj)
+
+
+class FlightRecorder:
+    def __init__(self, spool_dir, *,
+                 min_interval_s: float = DEFAULT_MIN_INTERVAL_S,
+                 keep: int = DEFAULT_KEEP,
+                 registry: metrics.Registry = metrics.REGISTRY,
+                 time_source=time.monotonic):
+        self.spool = Path(spool_dir)
+        self.min_interval_s = float(min_interval_s)
+        self.keep = max(int(keep), 1)
+        self.registry = registry
+        self.time_source = time_source
+        self._last_dump: float | None = None
+        self._seq = itertools.count()
+
+    def dump(self, reason: str, *, now: float | None = None,
+             health: dict | None = None, events=None,
+             force: bool = False) -> Path | None:
+        """Write one bundle; returns its path, or None when rate-limited.
+
+        ``now`` is the engine's monotonic clock (rate limiting only —
+        bundle names use wall time so operators can correlate them with
+        logs)."""
+        t = self.time_source() if now is None else float(now)
+        if (not force and self._last_dump is not None
+                and t - self._last_dump < self.min_interval_s):
+            return None
+        # pid in the name: a crash-looping node restarting within one
+        # wall-clock second resets the seq counter, and colliding with a
+        # previous run's bundle would fail os.replace (ENOTEMPTY) and
+        # drop the dump at exactly the moment it matters
+        name = (f"flight-{int(time.time())}-{os.getpid()}-"
+                f"{next(self._seq):03d}")
+        path = self.spool / name
+        tmp = self.spool / f".{name}.tmp"
+        try:
+            tmp.mkdir(parents=True, exist_ok=True)
+            manifest = {
+                "reason": reason,
+                "unix_ts": time.time(),
+                "pid": os.getpid(),
+                "trace_enabled": tracing.is_enabled(),
+                "health": health,
+            }
+            (tmp / MANIFEST).write_text(
+                json.dumps(_jsonable(manifest), indent=1))
+            (tmp / TRACE).write_text(json.dumps(tracing.export()))
+            (tmp / METRICS).write_text(self.registry.expose())
+            (tmp / EVENTS).write_text(json.dumps(
+                [{"t": et, "type": etype, "event": _jsonable(ev)}
+                 for et, etype, ev in (events or [])]))
+            (tmp / HEALTH).write_text(
+                json.dumps(_jsonable(health or {}), indent=1))
+            os.replace(tmp, path)  # bundle appears atomically or not
+        except OSError as exc:
+            _log.error("flight dump failed: %r", exc)
+            shutil.rmtree(tmp, ignore_errors=True)
+            return None
+        # rate limit arms only on SUCCESS: a failed write (disk full)
+        # must not suppress the next automatic dump once it could work
+        self._last_dump = t
+        metrics.flight_bundles.inc(trigger=reason.split(":", 1)[0])
+        _log.warning("flight bundle written: %s (%s)", path, reason)
+        self._prune()
+        return path
+
+    def bundles(self) -> list[Path]:
+        if not self.spool.is_dir():
+            return []
+        return sorted(p for p in self.spool.iterdir()
+                      if p.is_dir() and p.name.startswith("flight-"))
+
+    def _prune(self) -> None:
+        for stale in self.bundles()[:-self.keep]:
+            shutil.rmtree(stale, ignore_errors=True)
+
+
+# --- bundle digestion (profiler --flight) -------------------------------
+
+
+def read_bundle(path) -> dict:
+    """Load + validate one bundle. Raises on a malformed trace or an
+    unparseable metrics snapshot — a corrupt bundle must fail loudly."""
+    p = Path(path)
+    if not (p / MANIFEST).exists():
+        raise FileNotFoundError(f"{p}: not a flight bundle (no {MANIFEST})")
+    manifest = json.loads((p / MANIFEST).read_text())
+    trace = json.loads((p / TRACE).read_text())
+    tracing.validate(trace)
+    metrics_text = (p / METRICS).read_text()
+    samples = 0
+    for line in metrics_text.splitlines():
+        if line and not line.startswith("#"):
+            if " " not in line:
+                raise ValueError(f"{p}/{METRICS}: bad sample {line!r}")
+            samples += 1
+    events = json.loads((p / EVENTS).read_text()) \
+        if (p / EVENTS).exists() else []
+    health = json.loads((p / HEALTH).read_text()) \
+        if (p / HEALTH).exists() else {}
+    return {"path": str(p), "manifest": manifest, "trace": trace,
+            "metrics_samples": samples, "events": events,
+            "health": health}
+
+
+def digest(bundle: dict, top: int = 10) -> dict:
+    """A render-ready summary of ``read_bundle()``'s output."""
+    health = bundle.get("health") or {}
+    components = health.get("components", {})
+    slos = health.get("slos", {})
+    summary = tracing.summarize(bundle["trace"], top=top)
+    return {
+        "bundle": bundle["path"],
+        "reason": bundle["manifest"].get("reason"),
+        "unix_ts": bundle["manifest"].get("unix_ts"),
+        "ready": health.get("ready"),
+        "unhealthy_components": {
+            name: ent.get("reason") for name, ent in components.items()
+            if not ent.get("healthy", True)},
+        "breached_slos": {
+            name: {"value": ent.get("value"), "target": ent.get("target"),
+                   "burn": ent.get("burn")}
+            for name, ent in slos.items() if ent.get("breached")},
+        "slis": health.get("slis", {}),
+        "metrics_samples": bundle["metrics_samples"],
+        "events": len(bundle["events"]),
+        "trace_spans": summary["spans"],
+        "trace_top_self_time": summary["top_self_time"][:top],
+    }

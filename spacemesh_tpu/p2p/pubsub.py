@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import time
 from typing import Awaitable, Callable
 
 from ..utils import metrics, tracing
@@ -79,6 +80,7 @@ class PubSub:
                            if tracing.is_enabled() else None)
         async with dsp:
             for h in self._handlers.get(topic, ()):
+                t0 = time.perf_counter()
                 try:
                     async with tracing.span(
                             "gossip.handler",
@@ -93,6 +95,11 @@ class PubSub:
                     _log.warning("handler %r dropped message on topic %s: %r",
                                  getattr(h, "__qualname__", h), topic, exc)
                     r = False
+                finally:
+                    # handler wall time INCLUDING farm queue wait — the
+                    # gossip-latency SLI an admission decision keys off
+                    metrics.gossip_handler_seconds.observe(
+                        time.perf_counter() - t0, topic=topic)
                 if r is False:
                     ok = False
                 elif r is None and ok is True:

@@ -201,6 +201,13 @@ class LabelWriter:
         with self._lock:
             return self._durable
 
+    def pending(self) -> int:
+        """Writes submitted but not yet on disk — the stall watchdog's
+        activity gate (obs/health.py writer_watchdog): while this is
+        non-zero the durable cursor must keep advancing."""
+        with self._lock:
+            return self._inflight
+
     def queue_depth(self) -> int:
         return self._q.qsize()
 

@@ -107,7 +107,8 @@ class Initializer:
                  writer_queue: int = DEFAULT_WRITER_QUEUE,
                  meta_interval_s: float = DEFAULT_META_INTERVAL_S,
                  meta_interval_labels: int = DEFAULT_META_INTERVAL_LABELS,
-                 mesh="auto"):
+                 mesh="auto",
+                 stall_deadline_s: float = 30.0):
         self.store = LabelStore(data_dir, meta)
         self.meta = meta
         self.batch = batch_size
@@ -121,6 +122,8 @@ class Initializer:
         self.writer_queue = writer_queue
         self.meta_interval_s = meta_interval_s
         self.meta_interval_labels = meta_interval_labels
+        self.stall_deadline_s = stall_deadline_s
+        self._fetched = meta.labels_written  # fetch frontier (watchdog)
         self._mesh_arg = mesh
         self.status = (Status.COMPLETE
                        if meta.labels_written >= meta.total_labels
@@ -215,6 +218,20 @@ class Initializer:
         pending: deque = deque()  # (start, count, words, snapshot)
         self._last_save_t = time.monotonic()
         self._last_save_labels = written0
+        # liveness (obs/health.py): the fetch frontier and the writer's
+        # durable cursor must both keep advancing while the session runs
+        # — a wedged device or disk flips /readyz instead of hanging a
+        # silent init forever
+        from ..obs import health as health_mod
+
+        init_wd = health_mod.Watchdog(
+            "post.init", progress=lambda: self._fetched,
+            deadline_s=self.stall_deadline_s,
+            active=lambda: self.status == Status.IN_PROGRESS)
+        writer_wd = health_mod.writer_watchdog(
+            writer, deadline_s=self.stall_deadline_s)
+        health_mod.HEALTH.register("post.init", init_wd.check)
+        health_mod.HEALTH.register("post.writer", writer_wd.check)
         session = tracing.span("init.run",
                                {"total": total, "resume_at": written0,
                                 "batch": self.batch,
@@ -257,6 +274,8 @@ class Initializer:
             session.__exit__(None, None, None)
             stats.write_s = writer.write_seconds
             writer.close(drain=False)
+            health_mod.HEALTH.unregister("post.init", init_wd.check)
+            health_mod.HEALTH.unregister("post.writer", writer_wd.check)
             metrics.post_pipeline_inflight.set(0)
             metrics.post_pipeline_queue_depth.set(0)
 
@@ -363,6 +382,8 @@ class Initializer:
         if stall > 0:
             metrics.post_pipeline_stall_seconds.inc(stall)
         metrics.post_pipeline_queue_depth.set(writer.queue_depth())
+        metrics.post_pipeline_labels.inc(count)
+        self._fetched = start + count
         self._snapshot = snap
         if self.progress:
             self.progress(start + count, self.meta.total_labels)

@@ -121,7 +121,8 @@ class Prover:
                  inflight: int | None = None,
                  readers: int | None = None,
                  reader_queue: int | None = None,
-                 mesh="auto"):
+                 mesh="auto",
+                 stall_deadline_s: float = 30.0):
         self.meta = PostMetadata.load(data_dir)
         if self.meta.labels_written < self.meta.total_labels:
             raise ValueError("POST data is not fully initialized")
@@ -160,6 +161,7 @@ class Prover:
                                 else _env_int("SPACEMESH_PROVE_QUEUE",
                                               DEFAULT_READER_QUEUE), 1)
         self._mesh_arg = mesh
+        self.stall_deadline_s = stall_deadline_s
         self.last_stats: ProverStats | None = None
 
     # -- mesh routing (mirrors post/initializer.py) -------------------------
@@ -292,17 +294,37 @@ class Prover:
                             "labels": meta.total_labels}
                            if tracing.is_enabled() else None)
         psp.__enter__()
+        # liveness (obs/health.py): while a prove runs, the labels-swept
+        # counter must advance within the deadline or /readyz flips
+        from ..obs import health as health_mod
+
+        running = True
+        # progress must advance PER BATCH, not per window: labels_swept
+        # alone updates once per disk pass, and a healthy pass over a
+        # real store legitimately outlives the deadline (the window
+        # histogram buckets reach 600s) — a per-window counter would
+        # report every normal prove as stalled
+        prove_wd = health_mod.Watchdog(
+            "post.prove",
+            progress=lambda: (stats.batches, stats.labels_swept),
+            deadline_s=self.stall_deadline_s, active=lambda: running)
+        health_mod.HEALTH.register("post.prove", prove_wd.check)
         try:
             for base in range(0, max_nonce, window):
                 # clamp the last window to the serial prover's give-up
                 # bound so the two paths search the exact same nonce range
                 groups = min(self.window_groups,
                              (max_nonce - base) // self.nonce_group)
+                tw = time.perf_counter()
                 winner, indices = self._scan_window(cw, thr, base, groups,
                                                     step, mesh, stats)
+                metrics.post_prove_window_seconds.observe(
+                    time.perf_counter() - tw)
                 if winner is not None:
                     break
         finally:
+            running = False
+            health_mod.HEALTH.unregister("post.prove", prove_wd.check)
             psp.__exit__(None, None, None)
         stats.elapsed_s = time.monotonic() - t0
         if stats.elapsed_s > 0:

@@ -416,6 +416,28 @@ def timeline_view(path: str, top: int = 20) -> dict:
     }
 
 
+def flight_view(path: str, top: int = 10) -> dict:
+    """Digest a flight-recorder bundle (obs/flight.py): validates the
+    trace export and the metrics snapshot while loading, then summarizes
+    what was unhealthy and where the captured time went."""
+    from ..obs import flight as flight_mod
+    from ..utils import tracing
+
+    bundle = flight_mod.read_bundle(path)
+    doc = flight_mod.digest(bundle, top=top)
+    lines = [f"flight bundle: {doc['bundle']}",
+             f"  reason: {doc['reason']}  ready: {doc['ready']}"]
+    for name, reason in (doc["unhealthy_components"] or {}).items():
+        lines.append(f"  unhealthy {name}: {reason}")
+    for name, ent in (doc["breached_slos"] or {}).items():
+        lines.append(f"  breached SLO {name}: value={ent['value']} "
+                     f"target={ent['target']} burn={ent['burn']}")
+    _log("\n".join(lines))
+    _log(tracing.render_summary(tracing.summarize(bundle["trace"],
+                                                  top=top)))
+    return doc
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="profiler",
@@ -478,6 +500,11 @@ def main(argv=None) -> int:
                     "benchmarking")
     ap.add_argument("--timeline-top", type=int, default=20,
                     help="rows in the --timeline self-time ranking")
+    ap.add_argument("--flight", metavar="BUNDLE_DIR", default=None,
+                    help="digest a flight-recorder bundle "
+                    "(obs/flight.py spool entry): validates the trace "
+                    "+ metrics snapshot, prints unhealthy components, "
+                    "breached SLOs and a trace summary")
     ap.add_argument("--no-probe", action="store_true",
                     help="skip the accelerator liveness probe (tests)")
     a = ap.parse_args(argv)
@@ -485,6 +512,12 @@ def main(argv=None) -> int:
     if a.timeline:
         # pure file digestion: no accelerator probe, no jax import
         print(json.dumps(timeline_view(a.timeline, top=a.timeline_top),
+                         indent=2))
+        return 0
+
+    if a.flight:
+        # pure file digestion too
+        print(json.dumps(flight_view(a.flight, top=a.timeline_top),
                          indent=2))
         return 0
 

@@ -41,6 +41,11 @@ class Counter(_Instrument):
         with self._lock:
             self._values[tuple(sorted(labels.items()))] += value
 
+    def sample(self) -> dict[tuple, float]:
+        """Point-in-time {labelset: value} snapshot (obs/sli.py sampler)."""
+        with self._lock:
+            return dict(self._values)
+
     def expose(self) -> list[str]:
         out = [f"# HELP {self.name} {self.help}",
                f"# TYPE {self.name} counter"]
@@ -60,6 +65,17 @@ class Gauge(_Instrument):
     def set(self, value: float, **labels) -> None:
         with self._lock:
             self._values[tuple(sorted(labels.items()))] = value
+
+    def remove(self, **labels) -> None:
+        """Drop one labelset's series — a gauge describing something
+        that no longer exists (an unregistered health component) must
+        disappear from the scrape, not pin its last value forever."""
+        with self._lock:
+            self._values.pop(tuple(sorted(labels.items())), None)
+
+    def sample(self) -> dict[tuple, float]:
+        with self._lock:
+            return dict(self._values)
 
     def expose(self) -> list[str]:
         out = [f"# HELP {self.name} {self.help}",
@@ -98,6 +114,12 @@ class Histogram(_Instrument):
                 if value <= b:
                     s[0][i] += 1
 
+    def sample(self) -> dict[tuple, tuple[list, float, int]]:
+        """{labelset: (cumulative bucket counts, sum, count)} snapshot."""
+        with self._lock:
+            return {k: (list(s[0]), s[1], s[2])
+                    for k, s in self._series.items()}
+
     def expose(self) -> list[str]:
         out = [f"# HELP {self.name} {self.help}",
                f"# TYPE {self.name} histogram"]
@@ -120,6 +142,7 @@ class Histogram(_Instrument):
 class Registry:
     def __init__(self) -> None:
         self._instruments: dict[str, _Instrument] = {}
+        self._collectors: list = []
         self._lock = threading.Lock()
 
     def counter(self, name: str, help_: str = "") -> Counter:
@@ -129,10 +152,20 @@ class Registry:
         return self._get(name, lambda: Gauge(name, help_), Gauge)
 
     def histogram(self, name: str, help_: str = "", buckets=None) -> Histogram:
-        return self._get(
+        inst = self._get(
             name, lambda: Histogram(name, help_,
                                     buckets or Histogram.DEFAULT_BUCKETS),
             Histogram)
+        # re-registering with DIFFERENT buckets used to silently return
+        # the original instrument — the caller would then record into a
+        # bucket layout it never asked for and every quantile computed
+        # from the deltas would be wrong without a trace
+        if buckets is not None and tuple(buckets) != inst.buckets:
+            raise ValueError(
+                f"histogram {name} already registered with buckets "
+                f"{inst.buckets}, re-registration asked for "
+                f"{tuple(buckets)}")
+        return inst
 
     def _get(self, name, factory, cls):
         with self._lock:
@@ -144,7 +177,48 @@ class Registry:
                                 f"{type(inst).__name__}")
             return inst
 
+    # --- scrape-time collectors ---------------------------------------
+
+    def add_collector(self, fn) -> None:
+        """Register a zero-arg hook run before every scrape/sample.
+
+        Collectors recompute gauges whose truth lives elsewhere (event
+        queue depths, process RSS, open fds) at OBSERVATION time instead
+        of trusting the last write — a gauge set on emit and never
+        decayed lies to every later scrape."""
+        with self._lock:
+            self._collectors.append(fn)
+
+    def run_collectors(self) -> None:
+        with self._lock:
+            fns = list(self._collectors)
+        for fn in fns:
+            try:
+                fn()
+            except Exception:  # noqa: BLE001 — one bad hook ≠ dead scrape
+                pass
+
+    def sample(self) -> dict[str, tuple[str, object]]:
+        """Run collectors, then snapshot every instrument:
+        {name: (kind, data)} where kind is counter/gauge/histogram and
+        data is the instrument's ``sample()`` (histograms additionally
+        carry their bucket bounds). The SLI sampler diffs two of these."""
+        self.run_collectors()
+        with self._lock:
+            instruments = list(self._instruments.items())
+        out: dict[str, tuple[str, object]] = {}
+        for name, inst in instruments:
+            if isinstance(inst, Histogram):
+                out[name] = ("histogram", {"buckets": inst.buckets,
+                                           "series": inst.sample()})
+            elif isinstance(inst, Counter):
+                out[name] = ("counter", inst.sample())
+            else:
+                out[name] = ("gauge", inst.sample())
+        return out
+
     def expose(self) -> str:
+        self.run_collectors()
         with self._lock:
             instruments = list(self._instruments.values())
         lines: list[str] = []
@@ -286,7 +360,7 @@ events_overflows = REGISTRY.counter(
     "events dropped on full subscription queues (label: type)")
 events_queue_depth = REGISTRY.gauge(
     "events_queue_depth",
-    "deepest subscription queue at the last emit")
+    "deepest subscription queue, recomputed at scrape time")
 
 # span tracer (utils/tracing.py): capture state for operators reading
 # /metrics while a /debug/trace capture runs.
@@ -295,3 +369,61 @@ trace_enabled_gauge = REGISTRY.gauge(
 trace_spans_gauge = REGISTRY.gauge(
     "trace_spans_recorded",
     "spans recorded by the current capture (incl. ring overwrites)")
+
+# --- health & SLO engine substrate (spacemesh_tpu/obs/) -----------------
+#
+# The windowed-SLI sampler (obs/sli.py) interpolates p50/p95/p99 from
+# BUCKET DELTAS of these histograms over a rolling window, so bucket
+# layouts are chosen to straddle each signal's healthy range (a quantile
+# is only as sharp as the bucket it lands in).
+
+layer_apply_seconds = REGISTRY.histogram(
+    "layer_apply_seconds",
+    "mesh.process_layer wall seconds (tortoise tally + apply)",
+    buckets=(0.001, 0.005, 0.02, 0.1, 0.5, 2.0, 10.0, float("inf")))
+gossip_handler_seconds = REGISTRY.histogram(
+    "gossip_handler_seconds",
+    "per-handler gossip validation seconds (label: topic)",
+    buckets=(0.0005, 0.002, 0.01, 0.05, 0.25, 1.0, 5.0, float("inf")))
+verify_farm_queue_wait_seconds = REGISTRY.histogram(
+    "verify_farm_queue_wait_seconds",
+    "submit -> batch-take queue wait seconds (label: kind)",
+    buckets=(0.001, 0.003, 0.01, 0.05, 0.25, 1.0, 10.0, float("inf")))
+post_prove_window_seconds = REGISTRY.histogram(
+    "post_prove_window_seconds",
+    "wall seconds per prove nonce-window disk pass",
+    buckets=(0.05, 0.25, 1.0, 5.0, 30.0, 120.0, 600.0, float("inf")))
+post_pipeline_labels = REGISTRY.counter(
+    "post_pipeline_labels_total",
+    "labels fetched to host by the init pipeline (rate = init labels/s)")
+
+# runtime collectors (obs/sli.py register_runtime_collectors): recomputed
+# by scrape-time hooks, not trusted last writes
+process_rss_bytes = REGISTRY.gauge(
+    "process_resident_memory_bytes", "resident set size")
+process_open_fds = REGISTRY.gauge(
+    "process_open_fds", "open file descriptors")
+event_loop_lag = REGISTRY.gauge(
+    "runtime_event_loop_lag_seconds",
+    "asyncio scheduling lag measured by the health engine heartbeat")
+
+# SLO evaluation (obs/health.py HealthEngine)
+slo_healthy = REGISTRY.gauge(
+    "slo_healthy", "1 while the SLO is met (label: slo)")
+slo_burn = REGISTRY.gauge(
+    "slo_burn_rate",
+    "violating fraction of the SLO window, 0..1 (label: slo)")
+slo_breaches = REGISTRY.counter(
+    "slo_breaches_total", "healthy->breach transitions (label: slo)")
+
+# component health + stall watchdogs (obs/health.py HealthRegistry)
+component_healthy = REGISTRY.gauge(
+    "component_healthy", "1 while the liveness probe passes "
+    "(label: component)")
+component_stalls = REGISTRY.counter(
+    "component_stalls_total",
+    "healthy->unhealthy probe transitions (label: component)")
+
+# flight recorder (obs/flight.py)
+flight_bundles = REGISTRY.counter(
+    "flight_bundles_total", "diagnostic bundles written (label: trigger)")

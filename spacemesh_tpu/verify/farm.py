@@ -197,7 +197,8 @@ class VerificationFarm:
                  max_inflight: int = 4,
                  max_wait_s: dict[Lane, float] | None = None,
                  lane_bounds: dict[Lane, int] | None = None,
-                 sig_threads: int | None = None):
+                 sig_threads: int | None = None,
+                 stall_deadline_s: float = 30.0):
         self.ed_verifier = ed_verifier or EdVerifier()
         self.vrf_verifier = vrf_verifier or VrfVerifier()
         self.post_params = post_params or ProofParams()
@@ -227,6 +228,18 @@ class VerificationFarm:
             "max_occupancy": 0, "dispatch_s": 0.0, "rejected": 0,
             "queue_peak": {lane.name.lower(): 0 for lane in Lane},
         }
+        # liveness contract (obs/health.py): while ANY lane holds queued
+        # requests, the dispatched-item counter must advance within the
+        # deadline — a wedged backend thread or a dead worker task shows
+        # up on /readyz instead of as silently-hanging submitters
+        from ..obs import health as health_mod
+
+        self._watchdog = health_mod.Watchdog(
+            "verify.farm",
+            progress=lambda: self.stats["items"],
+            active=lambda: sum(self._lane_count.values()) > 0,
+            deadline_s=stall_deadline_s)
+        health_mod.HEALTH.register("verify.farm", self._watchdog.check)
 
     # --- lifecycle ----------------------------------------------------
 
@@ -297,6 +310,9 @@ class VerificationFarm:
         if self._pool is not None:
             self._pool.shutdown(wait=False, cancel_futures=True)
             self._pool = None
+        from ..obs import health as health_mod
+
+        health_mod.HEALTH.unregister("verify.farm", self._watchdog.check)
 
     # --- submission ---------------------------------------------------
 
@@ -484,7 +500,10 @@ class VerificationFarm:
         now = self._loop.time()
         for p in batch:
             self._release_lane(p.lane)
-            p.span.set(queue_wait_ms=round((now - p.enqueued) * 1e3, 3))
+            wait = max(now - p.enqueued, 0.0)
+            metrics.verify_farm_queue_wait_seconds.observe(
+                wait, kind=p.req.kind)
+            p.span.set(queue_wait_ms=round(wait * 1e3, 3))
 
     async def _dispatch(self, kind: str, batch: list[_Pending]) -> None:
         # the batch span is the hub of the capture: its args carry the

@@ -28,6 +28,11 @@ import tempfile  # noqa: E402
 # tracing.start() themselves — tests/test_tracing.py)
 os.environ.pop("SPACEMESH_TRACE", None)
 
+# likewise an operator shell with JSON logging on must not change the
+# log format tests parse (tests that want JSON lines call
+# logging.configure(json_lines=True) themselves — tests/test_health_engine.py)
+os.environ.pop("SPACEMESH_LOG_JSON", None)
+
 # the ROMix autotuner (ops/autotune.py) must stay deterministic and cheap
 # under test: no implicit candidate races, and never persist winners into
 # the developer's real cache root. The autotune tests opt back in with

@@ -1,0 +1,549 @@
+"""Health & SLO engine (spacemesh_tpu/obs/): stall watchdogs, SLO burn
+accounting, the flight recorder, trace-correlated JSON logs, the
+/healthz //readyz //debug/flight surface, and the ISSUE 7 acceptance
+capture — one init+prove+farm run with the engine enabled, every timing
+assertion driven by an injected clock, zero sleeps."""
+
+import asyncio
+import io
+import json
+import logging as pylogging
+import threading
+from types import SimpleNamespace
+
+import pytest
+from aiohttp import ClientSession
+
+from spacemesh_tpu.api.http import ApiServer
+from spacemesh_tpu.node import events as events_mod
+from spacemesh_tpu.obs import flight as flight_mod
+from spacemesh_tpu.obs import health as health_mod
+from spacemesh_tpu.obs import sli as sli_mod
+from spacemesh_tpu.utils import logging as slog
+from spacemesh_tpu.utils import metrics as metrics_mod
+from spacemesh_tpu.utils import tracing
+
+from test_http_debug import parse_exposition
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+        return self.t
+
+
+# --- watchdogs ----------------------------------------------------------
+
+
+def test_watchdog_progress_stall_idle_rebaseline():
+    v = {"n": 0, "active": True}
+    wd = health_mod.Watchdog("x", progress=lambda: v["n"],
+                             deadline_s=5.0, active=lambda: v["active"])
+    assert wd.check(0.0)[0]
+    assert wd.check(4.0)[0]                      # quiet but in deadline
+    ok, reason = wd.check(6.0)
+    assert not ok and "stalled" in reason and "6.0s" in reason
+    v["n"] = 1
+    assert wd.check(7.0)[0]                      # progress heals
+    v["active"] = False
+    ok, reason = wd.check(100.0)
+    assert ok and reason == "idle"
+    v["active"] = True
+    # first check after re-activation re-baselines: a long-idle
+    # component is not instantly accused of a 93s stall
+    assert wd.check(200.0)[0]
+    assert not wd.check(206.0)[0]
+
+
+def test_watchdog_raising_probe_is_unhealthy():
+    def boom():
+        raise RuntimeError("dead counter")
+
+    wd = health_mod.Watchdog("x", progress=boom, deadline_s=1.0)
+    ok, reason = wd.check(0.0)
+    assert not ok and "probe raised" in reason
+
+
+def test_registry_register_replace_unregister():
+    reg = health_mod.HealthRegistry()
+    probe_a = lambda now: (True, "a")  # noqa: E731
+    probe_b = lambda now: (False, "b")  # noqa: E731
+    reg.register("c", probe_a)
+    reg.register("c", probe_b)                   # replace
+    assert reg.report(0.0)["c"] == {"healthy": False, "reason": "b"}
+    reg.unregister("c", probe_a)                 # stale unregister: no-op
+    assert reg.names() == ["c"]
+    reg.unregister("c", probe_b)
+    assert reg.names() == []
+
+    def raising(now):
+        raise ValueError("probe bug")
+
+    reg.register("r", raising)
+    assert not reg.report(0.0)["r"]["healthy"]
+
+
+# --- SLO burn + engine transitions --------------------------------------
+
+
+def _engine(tmp_path, fake, budget=0.0, window_s=30.0):
+    reg = metrics_mod.Registry()
+    lat = reg.histogram("lat", buckets=(0.01, 0.1, 1.0, float("inf")))
+    bus = events_mod.EventBus()
+    spec = sli_mod.SliSpec("lat_p95", "lat", "quantile", q=0.95)
+    slo = health_mod.Slo(name="latency", sli="lat_p95", target=0.1,
+                         window_s=window_s, budget=budget)
+    engine = health_mod.HealthEngine(
+        registry=reg, health=health_mod.HealthRegistry(), bus=bus,
+        slis=[spec], slos=[slo], window_s=window_s,
+        spool_dir=tmp_path / "flight", time_source=fake)
+    return engine, lat, bus
+
+
+def test_slo_breach_transition_event_metric_flight(tmp_path):
+    fake = FakeClock()
+    engine, lat, bus = _engine(tmp_path, fake)
+    sub = bus.subscribe(events_mod.SloBreach, size=16)
+    engine.tick()                                 # baseline snapshot
+    for _ in range(20):
+        lat.observe(0.005)
+    fake.advance(5.0)
+    rep = engine.tick()
+    assert rep["slos"]["latency"]["breached"] is False
+    assert rep["slos"]["latency"]["value"] <= 0.1
+    before = metrics_mod.slo_breaches.sample().get((("slo", "latency"),), 0)
+    for _ in range(20):
+        lat.observe(0.5)                          # violating era
+    fake.advance(5.0)
+    rep = engine.tick()
+    assert rep["slos"]["latency"]["breached"] is True
+    assert rep["slos"]["latency"]["value"] > 0.1
+    # transition artifacts: one counter inc, one bus event, one bundle
+    after = metrics_mod.slo_breaches.sample().get((("slo", "latency"),), 0)
+    assert after - before == 1
+    ev = sub.queue.get_nowait()
+    assert ev.slo == "latency" and ev.value > 0.1
+    bundles = engine.recorder.bundles()
+    assert len(bundles) == 1
+    manifest = json.loads((bundles[0] / "manifest.json").read_text())
+    assert manifest["reason"] == "slo:latency"
+    # a second tick while still breached is NOT a new transition
+    fake.advance(1.0)
+    engine.tick()
+    assert metrics_mod.slo_breaches.sample().get(
+        (("slo", "latency"),), 0) == after
+    # violating marks age out of the window -> recovery
+    fake.advance(40.0)
+    rep = engine.tick()
+    assert rep["slos"]["latency"]["breached"] is False
+
+
+def test_slo_budget_tolerates_brief_violation(tmp_path):
+    fake = FakeClock()
+    engine, lat, bus = _engine(tmp_path, fake, budget=0.5, window_s=30.0)
+    engine.tick()
+    for _ in range(5):
+        lat.observe(0.5)
+    fake.advance(5.0)
+    rep = engine.tick()                           # violating, burn ~0
+    assert rep["slos"]["latency"]["breached"] is False
+    # stay violating long enough to burn past half the window
+    for _ in range(4):
+        for _ in range(5):
+            lat.observe(0.5)
+        fake.advance(5.0)
+        rep = engine.tick()
+    assert rep["slos"]["latency"]["burn"] > 0.5
+    assert rep["slos"]["latency"]["breached"] is True
+
+
+def test_burn_freezes_when_sli_goes_unknown(tmp_path):
+    """A violating era followed by idleness: once the SLI window empties
+    (value None) the stale violating mark must stop accruing burn — one
+    bad tick plus silence is not a breach."""
+    fake = FakeClock()
+    reg = metrics_mod.Registry()
+    lat = reg.histogram("lat", buckets=(0.01, 0.1, 1.0, float("inf")))
+    spec = sli_mod.SliSpec("lat_p95", "lat", "quantile", q=0.95)
+    slo = health_mod.Slo(name="latency", sli="lat_p95", target=0.1,
+                         window_s=60.0, budget=0.3)
+    engine = health_mod.HealthEngine(
+        registry=reg, health=health_mod.HealthRegistry(),
+        slis=[spec], slos=[slo], window_s=10.0,   # short SLI window
+        time_source=fake)
+    engine.tick()
+    for _ in range(5):
+        lat.observe(0.5)                          # one violating burst
+    burns = []
+    for _ in range(10):                           # 50s of idle ticking
+        fake.advance(5.0)
+        rep = engine.tick()
+        burns.append(rep["slos"]["latency"]["burn"])
+    # the burst ages out of the 10s SLI window after ~2 ticks; burn must
+    # freeze at the observed violating time (~10s/60s), never trend to 1
+    assert max(burns) < 0.3, burns
+    assert rep["slos"]["latency"]["breached"] is False
+    assert burns[-1] <= burns[2]
+
+
+def test_flight_failed_dump_does_not_arm_rate_limit(tmp_path):
+    fake = FakeClock()
+    spool = tmp_path / "spool"
+    spool.parent.mkdir(parents=True, exist_ok=True)
+    spool.write_text("a file where the spool dir should be")
+    rec = flight_mod.FlightRecorder(spool, min_interval_s=60,
+                                    time_source=fake)
+    assert rec.dump("slo:x", now=fake()) is None   # mkdir fails: OSError
+    spool.unlink()                                 # condition clears
+    fake.advance(1.0)
+    # NOT forced, still within min_interval of the failure — must write
+    assert rec.dump("slo:x", now=fake()) is not None
+
+
+def test_live_tracks_loop_not_request_ticks():
+    """Once run() starts, request-driven /readyz ticks must not mask a
+    wedged background loop."""
+    fake = FakeClock()
+    engine = health_mod.HealthEngine(
+        registry=metrics_mod.Registry(), health=health_mod.HealthRegistry(),
+        slis=[], slos=[], interval_s=5.0, time_source=fake)
+
+    async def drive():
+        engine.ensure_running()
+        await asyncio.sleep(0)        # run() records _loop_started_at
+        assert engine.live()
+        fake.advance(60.0)            # loop never ticked (real sleep(5))
+        engine.tick()                 # a request-driven evaluation
+        assert not engine.live()      # ...does not revive liveness
+        engine.close()
+
+    asyncio.run(drive())
+
+
+def test_component_transition_emits_event_and_metric(tmp_path):
+    fake = FakeClock()
+    engine, lat, bus = _engine(tmp_path, fake)
+    sub = bus.subscribe(events_mod.ComponentHealth, size=16)
+    state = {"ok": True}
+    engine.health.register(
+        "widget", lambda now: (state["ok"], "because"))
+    engine.tick()
+    state["ok"] = False
+    fake.advance(1.0)
+    rep = engine.tick()
+    assert rep["ready"] is False
+    ev = sub.queue.get_nowait()
+    assert ev.component == "widget" and ev.healthy is False
+    state["ok"] = True
+    fake.advance(1.0)
+    assert engine.tick()["ready"] is True
+    assert sub.queue.get_nowait().healthy is True
+    # unregistered probes drop out of the report AND the gauge: a
+    # finished component must not pin component_healthy{...}=0 forever
+    state["ok"] = False
+    fake.advance(1.0)
+    engine.tick()
+    engine.health.unregister("widget")
+    fake.advance(1.0)
+    assert "widget" not in engine.tick()["components"]
+    assert (("component", "widget"),) not in \
+        metrics_mod.component_healthy.sample()
+
+
+# --- flight recorder ----------------------------------------------------
+
+
+def test_flight_recorder_rate_limit_force_prune(tmp_path):
+    fake = FakeClock()
+    rec = flight_mod.FlightRecorder(tmp_path / "spool", min_interval_s=60,
+                                    keep=2, time_source=fake)
+    p1 = rec.dump("slo:first", now=fake())
+    assert p1 is not None and p1.is_dir()
+    assert rec.dump("slo:second", now=fake.advance(10)) is None  # limited
+    p3 = rec.dump("manual", now=fake(), force=True)              # bypass
+    assert p3 is not None
+    p4 = rec.dump("stall:late", now=fake.advance(120))
+    assert p4 is not None
+    assert len(rec.bundles()) == 2                # keep=2 pruned oldest
+    bundle = flight_mod.read_bundle(p4)
+    assert bundle["manifest"]["reason"] == "stall:late"
+    tracing.validate(bundle["trace"])             # idempotent revalidate
+    assert bundle["metrics_samples"] > 0
+    doc = flight_mod.digest(bundle)
+    assert doc["reason"] == "stall:late"
+
+
+def test_flight_read_bundle_rejects_corruption(tmp_path):
+    rec = flight_mod.FlightRecorder(tmp_path / "spool")
+    p = rec.dump("manual", force=True)
+    (p / "trace.json").write_text('{"traceEvents": [{"bad": 1}]}')
+    with pytest.raises(ValueError):
+        flight_mod.read_bundle(p)
+    with pytest.raises(FileNotFoundError):
+        flight_mod.read_bundle(tmp_path / "nope")
+
+
+def test_flight_events_serialize_bytes(tmp_path):
+    bus = events_mod.EventBus()
+    bus.emit(events_mod.AtxEvent(atx_id=b"\xab" * 4, node_id=b"\x01" * 4,
+                                 epoch=3))
+    rec = flight_mod.FlightRecorder(tmp_path / "spool")
+    p = rec.dump("manual", force=True, events=list(bus.recent))
+    evs = json.loads((p / "events.json").read_text())
+    assert evs[-1]["type"] == "AtxEvent"
+    assert evs[-1]["event"]["atx_id"] == "ab" * 4
+
+
+# --- trace-correlated JSON logs -----------------------------------------
+
+
+def test_json_log_lines_carry_span_id():
+    root = pylogging.getLogger(slog.ROOT)
+    saved = root.handlers[:]
+    root.handlers = []
+    buf = io.StringIO()
+    tracing.stop()
+    try:
+        slog.configure(json_lines=True, stream=buf)
+        tracing.start(capacity=64)
+        log = slog.get("health")
+        with tracing.span("health.tick") as sp:
+            log.warning("SLO breach: %s", "latency")
+        log.warning("outside any span")
+        lines = [json.loads(l) for l in buf.getvalue().splitlines()]
+        assert lines[0]["msg"] == "SLO breach: latency"
+        assert lines[0]["level"] == "WARNING"
+        assert lines[0]["logger"] == "smtpu.health"
+        assert lines[0]["span"] == sp.id          # -> Perfetto args.id
+        assert "span" not in lines[1]
+        # the span id in the log line exists in the trace export
+        doc = tracing.export()
+        assert any(e["args"].get("id") == sp.id
+                   for e in doc["traceEvents"] if e.get("args"))
+    finally:
+        tracing.stop()
+        root.handlers = saved
+
+
+def test_log_json_env_knob(monkeypatch):
+    monkeypatch.setenv("SPACEMESH_LOG_JSON", "1")
+    assert slog.json_mode_enabled()
+    monkeypatch.setenv("SPACEMESH_LOG_JSON", "off")
+    assert not slog.json_mode_enabled()
+
+
+# --- HTTP surface -------------------------------------------------------
+
+
+def _with_server(api, coro):
+    async def run():
+        port = await api.start()
+        base = f"http://127.0.0.1:{port}"
+        try:
+            async with ClientSession() as s:
+                return await coro(s, base)
+        finally:
+            await api.stop()
+
+    return asyncio.run(run())
+
+
+def test_http_health_surface(tmp_path):
+    fake = FakeClock()
+    reg = metrics_mod.Registry()
+    engine = health_mod.HealthEngine(
+        registry=reg, health=health_mod.HealthRegistry(), slis=[],
+        slos=[], spool_dir=tmp_path / "flight", time_source=fake)
+    state = {"ok": True}
+    engine.health.register("widget", lambda now: (state["ok"], "r"))
+    node = SimpleNamespace(health_engine=engine)
+    api = ApiServer(node, listen="127.0.0.1:0")
+
+    async def go(s, base):
+        healthz = await (await s.get(f"{base}/healthz")).json()
+        ready_r = await s.get(f"{base}/readyz")
+        ready = (ready_r.status, await ready_r.json())
+        state["ok"] = False
+        fake.advance(1.0)
+        bad_r = await s.get(f"{base}/readyz")
+        bad = (bad_r.status, await bad_r.json())
+        flight_r = await s.post(f"{base}/debug/flight?reason=op-request")
+        return healthz, ready, bad, (flight_r.status,
+                                     await flight_r.json())
+
+    healthz, ready, bad, flight = _with_server(api, go)
+    assert healthz["status"] == "ok" and healthz["engine"] is True
+    assert ready[0] == 200 and ready[1]["ready"] is True
+    assert bad[0] == 503
+    assert bad[1]["components"]["widget"] == {"healthy": False,
+                                              "reason": "r"}
+    assert flight[0] == 200
+    assert flight[1]["reason"] == "op-request"
+    bundle = flight_mod.read_bundle(flight[1]["bundle"])
+    assert bundle["manifest"]["reason"] == "op-request"
+
+
+def test_http_health_without_engine():
+    """Stub embedders without an engine: alive, and /readyz still
+    answers from the global health registry."""
+    api = ApiServer(SimpleNamespace(), listen="127.0.0.1:0")
+
+    async def go(s, base):
+        h = await s.get(f"{base}/healthz")
+        r = await s.get(f"{base}/readyz")
+        f = await s.post(f"{base}/debug/flight")
+        return (h.status, await h.json()), (r.status, await r.json()), \
+            f.status
+
+    (hs, hj), (rs, rj), fs = _with_server(api, go)
+    assert hs == 200 and hj["engine"] is False
+    assert rs in (200, 503) and "components" in rj
+    assert fs == 409
+
+
+def test_healthz_reports_wedged_tick_loop(tmp_path):
+    fake = FakeClock()
+    engine = health_mod.HealthEngine(
+        registry=metrics_mod.Registry(), health=health_mod.HealthRegistry(),
+        slis=[], slos=[], interval_s=5.0, time_source=fake)
+    engine.tick()
+    assert engine.live()
+    fake.advance(60.0)                            # 12 intervals of silence
+    assert not engine.live()
+    api = ApiServer(SimpleNamespace(health_engine=engine),
+                    listen="127.0.0.1:0")
+
+    async def go(s, base):
+        r = await s.get(f"{base}/healthz")
+        return r.status, await r.json()
+
+    status, doc = _with_server(api, go)
+    assert status == 503 and doc["status"] == "wedged"
+
+
+# --- the ISSUE 7 acceptance capture -------------------------------------
+
+
+@pytest.mark.usefixtures("tmp_path")
+def test_acceptance_init_prove_farm_stall_flight(tmp_path):
+    """One init+prove+farm run with the engine enabled. Asserts, with no
+    sleep anywhere: windowed p99s for >= 3 SLIs; an artificially stalled
+    LabelWriter trips its watchdog within its deadline; /readyz reports
+    the component unhealthy with a reason; the flight bundle validates
+    (trace passes tracing.validate, metrics snapshot parses strictly);
+    and ``profiler --flight`` digests it."""
+    from spacemesh_tpu.post import workload
+    from spacemesh_tpu.post.data import LabelStore, PostMetadata
+    from spacemesh_tpu.verify.farm import VerificationFarm
+
+    from test_verify_farm import _sig_reqs
+
+    tracing.stop()
+    tracing.start(capacity=65536)
+    fake = FakeClock(1000.0)
+    bus = events_mod.EventBus()
+    engine = health_mod.HealthEngine(
+        bus=bus, spool_dir=tmp_path / "flight", window_s=300.0,
+        time_source=fake)
+    registered_writer = None
+    writer = None
+    gate = threading.Event()
+    try:
+        engine.tick()                             # SLI window baseline
+        # --- the workload: init + prove + farm -----------------------
+        prover = workload.build(str(tmp_path / "post"), labels=2048,
+                                batch=512)
+        proof = prover.prove(workload.CHALLENGE)
+        assert workload.verify_proof(proof, 2048)
+        # pipeline watchdogs unregistered cleanly on the way out
+        for name in ("post.init", "post.prove", "post.writer"):
+            assert name not in health_mod.HEALTH.names()
+
+        async def farm_run():
+            farm = VerificationFarm()
+            try:
+                got = await asyncio.gather(
+                    *(farm.submit(r) for r in _sig_reqs(24)))
+                assert all(got)
+            finally:
+                await farm.aclose()
+
+        asyncio.run(farm_run())
+        fake.advance(30.0)
+        report = engine.tick()
+        p99 = {k: v for k, v in report["slis"].items()
+               if k.endswith("_p99")}
+        assert len(p99) >= 3, report["slis"]
+        assert {"prove_window_p99", "farm_queue_wait_p99",
+                "farm_dispatch_p99"} <= set(p99)
+        assert all(v > 0 for v in p99.values())
+        assert report["slis"]["init_labels_per_sec"] > 0
+
+        # --- artificially stalled LabelWriter ------------------------
+        meta = PostMetadata(
+            node_id="00" * 32, commitment="11" * 32, scrypt_n=2,
+            num_units=1, labels_per_unit=256, max_file_size=1 << 20)
+        store = LabelStore(tmp_path / "stall", meta)
+        store.write_labels = lambda start, labels: gate.wait(60)
+        writer = store.start_writer(threads=1, queue_depth=4)
+        writer.submit(0, b"\x00" * 16 * 8)        # worker wedges on gate
+        wd = health_mod.writer_watchdog(writer, deadline_s=5.0)
+        registered_writer = wd.check
+        health_mod.HEALTH.register("post.writer", registered_writer)
+        assert engine.tick()["components"]["post.writer"]["healthy"]
+        fake.advance(4.0)                         # inside the deadline
+        assert engine.tick()["components"]["post.writer"]["healthy"]
+        fake.advance(2.0)                         # 6s > 5s deadline
+        report = engine.tick()
+        ent = report["components"]["post.writer"]
+        assert ent["healthy"] is False
+        assert "stalled" in ent["reason"] and "deadline" in ent["reason"]
+        assert report["ready"] is False
+
+        # --- /readyz over HTTP reports it with the reason ------------
+        api = ApiServer(SimpleNamespace(health_engine=engine),
+                        listen="127.0.0.1:0")
+
+        async def go(s, base):
+            r = await s.get(f"{base}/readyz")
+            return r.status, await r.json()
+
+        status, doc = _with_server(api, go)
+        assert status == 503
+        assert doc["components"]["post.writer"]["healthy"] is False
+        assert "stalled" in doc["components"]["post.writer"]["reason"]
+
+        # --- the stall transition auto-dumped a flight bundle --------
+        bundles = engine.recorder.bundles()
+        assert bundles, "stall transition did not spool a bundle"
+        bundle = flight_mod.read_bundle(bundles[-1])   # validates trace
+        assert "stall:post.writer" in bundle["manifest"]["reason"]
+        samples = parse_exposition(
+            (bundles[-1] / "metrics.prom").read_text())
+        names = {n for n, _, _ in samples}
+        assert "post_prove_window_seconds_bucket" in names
+        assert "component_healthy" in names
+        # the capture in the bundle is the REAL workload's trace
+        span_names = {e["name"] for e in bundle["trace"]["traceEvents"]}
+        assert {"init.run", "prove.run", "farm.batch"} <= span_names
+        # recent events rode along (ComponentHealth transition at least)
+        types = {e["type"] for e in bundle["events"]}
+        assert "ComponentHealth" in types
+
+        # --- profiler --flight digests it without error --------------
+        from spacemesh_tpu.tools import profiler
+
+        assert profiler.main(["--flight", str(bundles[-1])]) == 0
+    finally:
+        gate.set()
+        if writer is not None:
+            writer.close(drain=False)
+        if registered_writer is not None:
+            health_mod.HEALTH.unregister("post.writer", registered_writer)
+        tracing.stop()

@@ -131,9 +131,12 @@ def test_farm_dispatch_histogram_carries_kind():
 
 
 def test_event_bus_overflow_metrics():
+    import gc
+
     from spacemesh_tpu.node import events as events_mod
 
     async def run():
+        gc.collect()  # drop dead buses from earlier tests (WeakSet)
         bus = events_mod.EventBus()
         sub = bus.subscribe(events_mod.LayerUpdate, size=2)
         before = dict(metrics_mod.events_overflows._values)
@@ -144,9 +147,24 @@ def test_event_bus_overflow_metrics():
         dropped = (metrics_mod.events_overflows._values.get(key, 0)
                    - before.get(key, 0))
         assert dropped == 3
-        # depth gauge saw the full queue
+        # the depth gauge is recomputed at SCRAPE time (registry
+        # collector hook), not written on emit: a drained queue must
+        # read 0 on the next scrape instead of pinning the high-water
+        # mark of the last emission forever
+        metrics_mod.REGISTRY.run_collectors()
         assert metrics_mod.events_queue_depth._values.get(()) == 2
+        while not sub.queue.empty():
+            sub.queue.get_nowait()
+        metrics_mod.REGISTRY.run_collectors()
+        assert metrics_mod.events_queue_depth._values.get(()) == 0
+        # emit + close the deepest subscriber: scrape recomputes, never
+        # resurrects the closed queue's depth
+        bus.emit(events_mod.LayerUpdate(layer=9, status="tick"))
+        metrics_mod.REGISTRY.run_collectors()
+        assert metrics_mod.events_queue_depth._values.get(()) == 1
         sub.close()
+        metrics_mod.REGISTRY.run_collectors()
+        assert metrics_mod.events_queue_depth._values.get(()) == 0
 
     asyncio.run(run())
 
