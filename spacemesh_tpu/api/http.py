@@ -72,6 +72,7 @@ class ApiServer:
         r.add_post("/v1/admin/recover", self.admin_recover)
         r.add_post("/v1/admin/chaos/block", self.admin_chaos_block)
         r.add_post("/v1/admin/chaos/clear", self.admin_chaos_clear)
+        r.add_post("/v1/admin/chaos/link", self.admin_chaos_link)
         r.add_post("/v1/admin/chaos/timeskew", self.admin_chaos_timeskew)
         r.add_get("/v1/events", self.events)
         r.add_get("/metrics", self.metrics)
@@ -524,6 +525,30 @@ class ApiServer:
             raise web.HTTPConflict(text="no transport host")
         host.chaos_clear()
         return web.json_response({"ok": True})
+
+    async def admin_chaos_link(self, req) -> web.Response:
+        """Degrade this node's gossip relays (loss/delay/jitter/dup):
+        the link-quality lever for scripted scenarios over real
+        transports (Host.chaos_link; sim/faults.py link_policy is the
+        in-proc twin). Empty body = clean links."""
+        host = getattr(self.node, "host", None)
+        if host is None:
+            raise web.HTTPConflict(text="no transport host")
+        try:
+            body = await req.json() if req.can_read_body else {}
+            # AttributeError below: valid JSON that isn't an object
+            # ('[1]', 'null') must be a 400, not an unhandled 500
+            kwargs = {k: float(body.get(k, 0.0))
+                      for k in ("loss", "delay", "jitter", "dup")}
+            kwargs["seed"] = int(body.get("seed", 0))
+        except (json.JSONDecodeError, ValueError, TypeError,
+                AttributeError):
+            raise web.HTTPBadRequest(
+                text='expected {"loss": p, "delay": s, "jitter": s, '
+                     '"dup": p, "seed": n}')
+        host.chaos_link(**kwargs)
+        return web.json_response({"ok": True, **{
+            k: v for k, v in kwargs.items() if k != "seed"}})
 
     async def admin_chaos_timeskew(self, req) -> web.Response:
         """Shift this node's clock by offset seconds (0 heals)."""

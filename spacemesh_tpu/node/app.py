@@ -49,8 +49,10 @@ class App:
         self.cfg = cfg
         # mutable skew over real time (chaos timeskew scenarios,
         # reference systest/chaos/timeskew.go:12); explicit time_source
-        # injection (virtual-clock tests) bypasses it
+        # injection (virtual-clock tests, the sim scenario engine)
+        # bypasses it
         self.time_offset = 0.0
+        self._time_injected = time_source is not None
         if time_source is None:
             time_source = lambda: time.time() + self.time_offset  # noqa: E731
         self.time_source = time_source
@@ -158,8 +160,13 @@ class App:
         # under the data dir; served as /healthz + /readyz (api/http.py)
         from ..obs.health import HealthEngine
 
+        # with an injected time source the engine's windows/burn math
+        # follow it too (deterministic SLO evaluation on a virtual
+        # clock); production keeps the monotonic default
         self.health_engine = HealthEngine(
-            bus=self.events, spool_dir=self.data / "flight")
+            bus=self.events, spool_dir=self.data / "flight",
+            **({"time_source": self.time_source}
+               if self._time_injected else {}))
         self.atx_handler = activation.Handler(
             db=self.state, cache=self.cache, verifier=self.verifier,
             golden_atx=self.golden_atx, post_params=self.post_params,
@@ -846,8 +853,12 @@ class App:
         from .peersync import PeerSync
         from . import events as _ev
 
+        # wall rides the node's time source: under a virtual clock the
+        # drift rounds measure SIM offsets (and a scripted timeskew
+        # really registers); in production this is wall time + chaos
+        # offset, exactly what peers observe of us
         self.peersync = PeerSync(
-            self.server, self.fetch,
+            self.server, self.fetch, wall=self.time_source,
             on_drift=lambda off: self.events.emit(
                 _ev.ClockDrift(offset=off)))
         self._tasks.append(asyncio.ensure_future(self.peersync.run()))

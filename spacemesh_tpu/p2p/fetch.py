@@ -17,7 +17,9 @@ Protocols:
 from __future__ import annotations
 
 import asyncio
+import random
 import struct
+import time
 from typing import Awaitable, Callable
 
 from ..core import codec
@@ -66,19 +68,47 @@ Validator = Callable[[bytes, bytes], Awaitable[bool]]  # (id, blob) -> ok
 
 class Fetch:
     def __init__(self, server: Server, batch_size: int = 128,
-                 bad_peer_threshold: int = 10):
+                 bad_peer_threshold: int = 10, *,
+                 retry_rounds: int = 3, backoff_base: float = 0.05,
+                 backoff_cap: float = 2.0, penalty_base: float = 0.5,
+                 penalty_cap: float = 30.0,
+                 rng: random.Random | None = None):
         self.server = server
         self.batch = batch_size
         self.bad_peer_threshold = bad_peer_threshold
+        # failed-chunk retry policy: bounded rounds with capped
+        # exponential backoff + jitter between them, and a per-peer
+        # penalty WINDOW after a transport-level chunk failure — the
+        # old behavior (retry the whole chunk elsewhere immediately,
+        # then hammer the same flapping peer set on the next call)
+        # turned one flaky peer into synchronized retry storms
+        self.retry_rounds = max(int(retry_rounds), 1)
+        self.backoff_base = backoff_base
+        self.backoff_cap = backoff_cap
+        self.penalty_base = penalty_base
+        self.penalty_cap = penalty_cap
+        self._rng = rng or random.Random(0x5EED5)
         self._readers: dict[int, Reader] = {}
         self._validators: dict[int, Validator] = {}
         # peer scoring (reference fetch/peers/peers.go): failures — bad
         # blobs, short answers, timeouts — push a peer down the selection
         # order and eventually out of it; successes slowly rehabilitate
         self._peer_score: dict[bytes, int] = {}
+        self._penalty_until: dict[bytes, float] = {}
+        self._consec_fail: dict[bytes, int] = {}
         server.register(P_HASH, self._serve_hashes)
 
     # --- peer selection ---------------------------------------------
+
+    @staticmethod
+    def _now() -> float:
+        """Loop clock when one is running (virtual-clock-aware: penalty
+        windows expire in SIM time under a VirtualClockLoop), wall
+        monotonic otherwise."""
+        try:
+            return asyncio.get_running_loop().time()
+        except RuntimeError:
+            return time.monotonic()
 
     def report_failure(self, peer: bytes, weight: int = 1) -> None:
         self._peer_score[peer] = self._peer_score.get(peer, 0) + weight
@@ -87,22 +117,49 @@ class Fetch:
         s = self._peer_score.get(peer, 0)
         if s > 0:
             self._peer_score[peer] = s - 1
+        self._consec_fail.pop(peer, None)
+        self._penalty_until.pop(peer, None)
+
+    def _chunk_failure(self, peer: bytes) -> None:
+        """Transport-level chunk failure (timeout / error / short
+        answer): score it AND open an escalating penalty window during
+        which the peer is skipped by selection."""
+        self.report_failure(peer)
+        n = self._consec_fail.get(peer, 0) + 1
+        self._consec_fail[peer] = n
+        window = min(self.penalty_cap,
+                     self.penalty_base * (2 ** (n - 1)))
+        self._penalty_until[peer] = self._now() + window
 
     def failure_score(self, peer: bytes) -> int:
         """Accumulated failure score — HIGHER is WORSE; peers at or above
         bad_peer_threshold are dropped from selection."""
         return self._peer_score.get(peer, 0)
 
+    def penalized(self, peer: bytes) -> bool:
+        return self._penalty_until.get(peer, 0.0) > self._now()
+
     def peers(self) -> list[bytes]:
-        """Connected peers, best score first, chronically bad ones dropped
-        from selection entirely."""
+        """Connected peers, best score first: chronically bad ones are
+        dropped from selection entirely and peers inside a penalty
+        window are skipped while anyone else is available."""
         ranked = sorted(self.server.peers(),
                         key=lambda p: self._peer_score.get(p, 0))
         good = [p for p in ranked
                 if self._peer_score.get(p, 0) < self.bad_peer_threshold]
-        # if everyone looks bad, fall back to the least-bad peers rather
-        # than stalling sync forever
+        usable = [p for p in good if not self.penalized(p)]
+        if usable:
+            return usable
+        # if everyone looks bad/penalized, fall back to the least-bad
+        # peers rather than stalling sync forever
         return good or ranked[:2]
+
+    async def _backoff(self, round_no: int) -> None:
+        """Jittered capped exponential delay between retry rounds (the
+        jitter de-synchronizes many nodes retrying the same flap)."""
+        delay = min(self.backoff_cap,
+                    self.backoff_base * (2 ** round_no))
+        await asyncio.sleep(delay * (0.5 + self._rng.random() * 0.5))
 
     # --- wiring -----------------------------------------------------
 
@@ -129,7 +186,14 @@ class Fetch:
     async def get_hashes(self, hint: int, ids: list[bytes]) -> dict[bytes, bool]:
         """Resolve ids across peers in batches; each retrieved blob goes
         through the hint's validator. Ids already present locally (the
-        hint's reader answers) are skipped. Returns id -> success."""
+        hint's reader answers) are skipped. Returns id -> success.
+
+        Retry shape: one pass over the (penalty-filtered) peer set per
+        round; a round is re-run — after a capped, jittered exponential
+        backoff — only while ids remain AND some chunk failed at the
+        TRANSPORT level (timeout/error/short answer). Peers that simply
+        don't hold an id answer definitively (empty blob) and never
+        trigger a retry round."""
         result = {i: False for i in ids}
         reader = self._readers.get(hint)
         missing = []
@@ -138,44 +202,57 @@ class Fetch:
                 result[i] = True  # already stored locally
             else:
                 missing.append(i)
-        peers = self.peers()
-        if not peers:
-            return result
         validator = self._validators.get(hint)
-        for pi, peer in enumerate(peers):
+        for round_no in range(self.retry_rounds):
             if not missing:
                 break
-            still = []
-            for k in range(0, len(missing), self.batch):
-                chunk = missing[k:k + self.batch]
-                try:
-                    resp = HashResponse.from_bytes(await self.server.request(
-                        peer, P_HASH,
-                        HashRequest(hint=hint, hashes=chunk).to_bytes()))
-                except (RequestError, asyncio.TimeoutError, codec.DecodeError):
-                    self.report_failure(peer)
-                    still.extend(chunk)
-                    continue
-                if len(resp.blobs) != len(chunk):
-                    # short answer: nothing in it is trustworthy-complete;
-                    # retry the whole chunk elsewhere
-                    self.report_failure(peer)
-                    still.extend(chunk)
-                    continue
-                for h, blob in zip(chunk, resp.blobs):
-                    if not blob:
-                        still.append(h)
+            if round_no:
+                await self._backoff(round_no - 1)
+            peers = self.peers()
+            if not peers:
+                break
+            transient = False
+            for peer in peers:
+                if not missing:
+                    break
+                still = []
+                for k in range(0, len(missing), self.batch):
+                    chunk = missing[k:k + self.batch]
+                    try:
+                        resp = HashResponse.from_bytes(
+                            await self.server.request(
+                                peer, P_HASH,
+                                HashRequest(hint=hint,
+                                            hashes=chunk).to_bytes()))
+                    except (RequestError, asyncio.TimeoutError,
+                            codec.DecodeError):
+                        self._chunk_failure(peer)
+                        transient = True
+                        still.extend(chunk)
                         continue
-                    ok = await validator(h, blob) if validator else True
-                    result[h] = bool(ok)
-                    if ok:
-                        self.report_success(peer)
-                    else:
-                        # an invalid blob for a requested id is strong
-                        # evidence of a bad peer (content-hash-addressed)
-                        self.report_failure(peer, weight=3)
-                        still.append(h)
-            missing = still
+                    if len(resp.blobs) != len(chunk):
+                        # short answer: nothing in it is trustworthy-
+                        # complete; retry the whole chunk elsewhere
+                        self._chunk_failure(peer)
+                        transient = True
+                        still.extend(chunk)
+                        continue
+                    for h, blob in zip(chunk, resp.blobs):
+                        if not blob:
+                            still.append(h)
+                            continue
+                        ok = await validator(h, blob) if validator else True
+                        result[h] = bool(ok)
+                        if ok:
+                            self.report_success(peer)
+                        else:
+                            # an invalid blob for a requested id is strong
+                            # evidence of a bad peer (content-addressed)
+                            self.report_failure(peer, weight=3)
+                            still.append(h)
+                missing = still
+            if not transient:
+                break
         return result
 
     async def get_epoch_atxs(self, epoch: int) -> list[bytes]:

@@ -27,6 +27,22 @@ GRAFT, PRUNE, IHAVE, IWANT = range(4)
 
 _ID = 32  # gossip message ids are sum256 digests
 
+SEEN_CAP = 1 << 14
+
+
+def mark_seen(seen: dict, msg_id: bytes, cap: int = SEEN_CAP) -> bool:
+    """Insert into an insertion-ordered seen-cache; True if newly seen.
+    Evicts the oldest quarter when full. ONE implementation shared by
+    the socket transport and the sim hub so their dedup windows can
+    never silently diverge."""
+    if msg_id in seen:
+        return False
+    seen[msg_id] = None
+    if len(seen) > cap:
+        for key in list(seen)[:cap // 4]:
+            del seen[key]
+    return True
+
 
 def encode_ctrl(subtype: int, topic: str, ids: list[bytes] = ()) -> bytes:
     tb = topic.encode()
@@ -89,6 +105,8 @@ class GossipMesh:
     """Mesh membership + control-plane logic; the Host owns the sockets
     and calls in with peer ids, getting (peer, frame-payload) sends out."""
 
+    MAX_TOPICS = 64  # control-frame topic-spam guard (see on_control)
+
     def __init__(self, *, degree: int = 6, d_lo: int = 4, d_hi: int = 8,
                  lazy: int = 3, history: int = 20,
                  rng: random.Random | None = None):
@@ -118,15 +136,23 @@ class GossipMesh:
     def eager_targets(self, topic: str, connected: set[bytes],
                       exclude: bytes | None = None) -> set[bytes]:
         """Peers that get the full frame NOW.  Until the mesh for a topic
-        has formed (bootstrap), fall back to flood so nothing stalls."""
-        mesh = self._mesh(topic) & connected
+        has formed (bootstrap), fall back to flood so nothing stalls.
+        Read-only on the topic table: relaying must not grow it (the
+        spam cap in on_message owns admission)."""
+        mesh = self.mesh.get(topic, set()) & connected
         targets = mesh if mesh else set(connected)
         if exclude is not None:
             targets = targets - {exclude}
         return targets
 
     def on_message(self, msg_id: bytes, topic: str, frame: bytes) -> None:
-        self._mesh(topic)  # learn the topic
+        # learn the topic — but attacker-chosen topic strings on DATA
+        # frames must not grow the per-topic tables (and with them the
+        # heartbeat's GRAFT/IHAVE work) without bound, same cap as the
+        # control plane; the frame still lands in the (size-bounded)
+        # cache so IWANT can serve it
+        if topic in self.mesh or len(self.mesh) < self.MAX_TOPICS:
+            self._mesh(topic)
         self.cache.put(msg_id, topic, frame)
 
     # -- control plane -----------------------------------------------
@@ -137,6 +163,13 @@ class GossipMesh:
         ids)] to send back to ``peer``.  ``seen(msg_id)`` tells whether
         we already hold a message."""
         subtype, topic, ids = decode_ctrl(payload)
+        if topic not in self.mesh and len(self.mesh) >= self.MAX_TOPICS:
+            # topic-spam guard: a hostile peer must not grow the
+            # per-topic tables without bound — unknown topics past the
+            # cap answer GRAFT with PRUNE and drop the rest (data
+            # frames hit the same cap in on_message; the node's own
+            # topics were learned long before any attacker fills it)
+            return [(PRUNE, topic, [])] if subtype == GRAFT else []
         mesh = self._mesh(topic)
         if subtype == GRAFT:
             if len(mesh) >= self.d_hi:

@@ -65,12 +65,21 @@ class Syncer:
         self.on_fork = on_fork
         self.state = SyncState.NOT_SYNCED
         self._stop = False
+        # one pass at a time: the background run() loop and an external
+        # driver (the sim scenario engine's convergence wait, a test's
+        # heal loop) may both call synchronize(); interleaved passes
+        # would double-process layers mid-flight
+        self._busy = asyncio.Lock()
 
     def is_synced(self) -> bool:
         return self.state == SyncState.SYNCED
 
     async def synchronize(self) -> bool:
         """One sync pass; returns True when caught up to the tip."""
+        async with self._busy:
+            return await self._synchronize()
+
+    async def _synchronize(self) -> bool:
         tip = self.current_layer()
         cur_epoch = tip // self.layers_per_epoch
         # 1) per epoch: beacon, poet proofs, then ATXs (validation order)

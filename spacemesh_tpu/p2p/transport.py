@@ -170,9 +170,11 @@ class Host:
         self._disc_pending: dict[int, asyncio.Future] = {}
         # chaos fault injection (systest partition tooling; reference
         # systest/chaos/partition.go does this with iptables — here the
-        # transport refuses the blocked peers itself)
+        # transport refuses the blocked peers itself). chaos_link adds
+        # seeded loss/delay/duplication on gossip relays.
         self._blocked_addrs: set[tuple] = set()
         self._blocked_ids: set[bytes] = set()
+        self._chaos_link: dict | None = None
         self._tasks: list[asyncio.Task] = []
         self._listener: asyncio.AbstractServer | None = None
         self._pubsub = None
@@ -326,9 +328,27 @@ class Host:
                     and tuple(conn.listen_addr) in self._blocked_addrs):
                 self._drop(conn)
 
+    def chaos_link(self, *, loss: float = 0.0, delay: float = 0.0,
+                   jitter: float = 0.0, dup: float = 0.0,
+                   seed: int = 0) -> None:
+        """Degrade every outbound gossip relay until chaos_clear():
+        ``loss`` drops frames, ``delay``+``jitter`` defers them,
+        ``dup`` sends twice. The link-quality sibling of chaos_block
+        for scripted scenarios (sim/faults.py vocabulary; the netem/tc
+        analogue of the reference's iptables chaos) — seeded, so a
+        scenario's drop pattern replays exactly."""
+        if loss or delay or jitter or dup:
+            self._chaos_link = {
+                "loss": float(loss), "delay": float(delay),
+                "jitter": float(jitter), "dup": float(dup),
+                "rng": random.Random(("chaos-link", seed).__repr__())}
+        else:
+            self._chaos_link = None
+
     def chaos_clear(self) -> None:
         self._blocked_addrs.clear()
         self._blocked_ids.clear()
+        self._chaos_link = None
 
     async def _dial(self, addr: tuple[str, int]) -> None:
         if tuple(addr) in self._blocked_addrs:
@@ -489,14 +509,12 @@ class Host:
         return msg_id, struct.pack("<B", len(tb)) + tb + msg_id + data
 
     def _mark_seen(self, msg_id: bytes) -> bool:
-        """True if newly seen."""
-        if msg_id in self._seen:
-            return False
-        self._seen[msg_id] = None
-        if len(self._seen) > SEEN_CAP:  # LRU-ish: evict oldest insertions
-            for key in list(self._seen)[:SEEN_CAP // 4]:
-                del self._seen[key]
-        return True
+        """True if newly seen (shared insert/evict policy —
+        gossipmesh.mark_seen — so the sim hub's dedup window can never
+        silently diverge from the transport it models)."""
+        from .gossipmesh import mark_seen
+
+        return mark_seen(self._seen, msg_id, SEEN_CAP)
 
     async def _handle_gossip(self, conn: _Conn, payload: bytes) -> None:
         tlen = payload[0]
@@ -554,15 +572,50 @@ class Host:
 
     async def _relay(self, frame_payload: bytes,
                      targets: set[bytes]) -> None:
+        pol = self._chaos_link
         for peer_id in targets:
             conn = self._conns.get(peer_id)
             if conn is None:
                 continue
+            copies = 1
+            if pol is not None:
+                rng = pol["rng"]
+                if pol["loss"] and rng.random() < pol["loss"]:
+                    continue
+                if pol["dup"] and rng.random() < pol["dup"]:
+                    copies = 2
+                wait = pol["delay"] + (rng.random() * pol["jitter"]
+                                       if pol["jitter"] else 0.0)
+                if wait > 0:
+                    asyncio.get_running_loop().call_later(
+                        wait, self._relay_later, conn, frame_payload,
+                        copies)
+                    continue
+            for _ in range(copies):
+                self.stats["gossip_tx"] += 1
+                try:
+                    await conn.send(MSG_GOSSIP, frame_payload)
+                except (OSError, ConnectionError):
+                    self._drop(conn)
+                    break
+
+    def _relay_later(self, conn: _Conn, frame_payload: bytes,
+                     copies: int) -> None:
+        """Deferred chaos_link delivery; the peer may be gone by now.
+        Encrypt-at-enqueue is preserved (nonce order == queue order ==
+        wire order), as is the send-queue overflow contract."""
+        if conn.closed.is_set():
+            return
+        for _ in range(copies):
+            if conn.send_queue.qsize() >= SEND_QUEUE_CAP:
+                conn.close()
+                return
             self.stats["gossip_tx"] += 1
             try:
-                await conn.send(MSG_GOSSIP, frame_payload)
-            except (OSError, ConnectionError):
-                self._drop(conn)
+                conn.send_queue.put_nowait(
+                    conn.channel.encrypt_frame(MSG_GOSSIP, frame_payload))
+            except Exception:  # noqa: BLE001 — chaos must not kill the caller
+                return
 
     async def _handle_req(self, conn: _Conn, payload: bytes) -> None:
         try:

@@ -1,0 +1,25 @@
+"""Deterministic thousand-node scenario engine (ROADMAP item 4).
+
+Runs hundreds-to-thousands of lightweight in-proc nodes on ONE
+VirtualClockLoop through scripted scenarios: partitions and healing,
+eclipse, churn, link degradation (delay/loss/duplication/reorder) and
+adversarial payloads — asserting health from the PR-7 SLO engine
+(obs/sli.py windowed SLIs) and PR-5 span traces instead of wall-clock
+sleeps. Same seed => same event digest, so any failure replays exactly.
+
+Layout:
+  net.py        SimNetwork (topology + fault state) + MeshHub (gossip
+                over p2p/gossipmesh.py meshes) + SimNet (req/resp)
+  node.py       LightNode / FullNode factories (shared event loop)
+  scenario.py   the declarative engine: phases, traffic, faults,
+                SLI/trace assertions, event digest
+  scenarios.py  built-in scripts (partition-heal, storm-256,
+                timeskew-kill, ...)
+  __main__.py   CLI: python -m spacemesh_tpu.sim --scenario ... --seed N
+
+See docs/SCENARIOS.md for the script format and the replay workflow.
+"""
+
+from .net import LinkPolicy, MeshHub, SimNet, SimNetwork  # noqa: F401
+from .scenario import ScenarioResult, run_scenario  # noqa: F401
+from .scenarios import builtin, builtin_names  # noqa: F401

@@ -1,0 +1,442 @@
+"""Simulated network: topology, fault state, gossip hub, req/resp.
+
+One :class:`SimNetwork` owns the ground truth the fault-injection layer
+mutates — the scenario engine's scripted faults are all method calls
+here, the in-proc analogue of the reference's systest chaos tooling
+(iptables partitions, systest/chaos/partition.go) and of the transport's
+own ``Host.chaos_block`` hooks:
+
+* **topology**: a seeded ring+chords graph of degree ~k — gossip frames
+  only travel along edges, so a partition really separates islands;
+* **partition groups / eclipse / blocked links / downed nodes** decide
+  :meth:`SimNetwork.reachable`;
+* **link policies** (loss, delay, jitter, duplication, reorder) apply
+  per send with the network's seeded RNG — deterministic on the virtual
+  clock, every delayed delivery lands at an exact virtual instant.
+
+:class:`MeshHub` is the pubsub hub surface (``PubSub._hub``) running the
+REAL gossipsub-lite control plane (p2p/gossipmesh.py): per-node
+degree-bounded topic meshes, GRAFT/PRUNE, lazy IHAVE/IWANT repair —
+exactly what ``p2p/transport.py`` runs over sockets, minus the sockets.
+:class:`SimNet` is the req/resp surface (``Server._net``); requests may
+reach any live peer in the same partition group (the real transport
+dials any learned address, so adjacency does not constrain req/resp).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import random
+from typing import Iterable, Optional
+
+from ..p2p.gossipmesh import (
+    IHAVE,
+    SEEN_CAP,
+    GossipMesh,
+    encode_ctrl,
+    mark_seen,
+)
+from ..p2p.server import RequestError, Server
+
+
+@dataclasses.dataclass
+class LinkPolicy:
+    """Per-link degradation; probabilities in [0,1], delays in virtual
+    seconds. ``reorder`` is the probability a frame takes an extra
+    ``reorder_delay`` detour — later frames overtake it."""
+
+    loss: float = 0.0
+    delay: float = 0.0
+    jitter: float = 0.0
+    dup: float = 0.0
+    reorder: float = 0.0
+    reorder_delay: float = 0.5
+
+
+class SimNetwork:
+    """Topology + fault ground truth shared by MeshHub and SimNet."""
+
+    def __init__(self, seed: int, *, degree: int = 6):
+        self.seed = int(seed)
+        self.degree = int(degree)
+        self.rng = random.Random(("simnet", self.seed).__repr__())
+        self.names: list[bytes] = []        # join order (deterministic)
+        self.adj: dict[bytes, set[bytes]] = {}
+        self.group: dict[bytes, int] = {}
+        self.eclipsed: dict[bytes, frozenset] = {}
+        self.blocked: set[frozenset] = set()
+        self.down: set[bytes] = set()
+        self.default_policy = LinkPolicy()
+        self.link_policy: dict[frozenset, LinkPolicy] = {}
+        self.stats = {"loss": 0, "dup": 0, "reorder": 0, "blocked": 0}
+
+    # --- membership / topology ---------------------------------------
+
+    def add_node(self, name: bytes) -> None:
+        if name in self.adj:
+            return
+        self.names.append(name)
+        self.adj[name] = set()
+        self.group.setdefault(name, 0)
+
+    def build_topology(self, degree: int | None = None) -> None:
+        """Ring (connectivity guarantee) + seeded random chords up to
+        ~``degree`` per node. Deterministic for a given (seed, join
+        order)."""
+        k = degree if degree is not None else self.degree
+        n = len(self.names)
+        for s in self.adj.values():
+            s.clear()
+        if n <= 1:
+            return
+        for i, a in enumerate(self.names):
+            b = self.names[(i + 1) % n]
+            self._connect(a, b)
+        rng = random.Random(("topology", self.seed).__repr__())
+        for a in self.names:
+            tries = 0
+            while len(self.adj[a]) < k and tries < 8 * k:
+                tries += 1
+                b = self.names[rng.randrange(n)]
+                if b == a or b in self.adj[a] or len(self.adj[b]) >= k + 2:
+                    continue
+                self._connect(a, b)
+
+    def _connect(self, a: bytes, b: bytes) -> None:
+        self.adj[a].add(b)
+        self.adj[b].add(a)
+
+    # --- reachability -------------------------------------------------
+
+    def alive(self, name: bytes) -> bool:
+        return name in self.adj and name not in self.down
+
+    def reachable(self, a: bytes, b: bytes) -> bool:
+        """May a and b exchange ANY traffic right now (req/resp or a
+        gossip edge, if one exists)?"""
+        if a == b:
+            return False
+        if not self.alive(a) or not self.alive(b):
+            return False
+        if frozenset((a, b)) in self.blocked:
+            return False
+        if self.group.get(a, 0) != self.group.get(b, 0):
+            return False
+        ea, eb = self.eclipsed.get(a), self.eclipsed.get(b)
+        if ea is not None and b not in ea:
+            return False
+        if eb is not None and a not in eb:
+            return False
+        return True
+
+    def neighbors(self, name: bytes) -> set[bytes]:
+        """Gossip-edge peers usable right now."""
+        if not self.alive(name):
+            return set()
+        return {p for p in self.adj.get(name, ())
+                if self.reachable(name, p)}
+
+    def policy(self, a: bytes, b: bytes) -> LinkPolicy:
+        return self.link_policy.get(frozenset((a, b)), self.default_policy)
+
+    # --- the fault vocabulary ----------------------------------------
+
+    def partition(self, groups: Iterable[Iterable[bytes]]) -> None:
+        """Split the net: listed groups get ids 1..n, everyone else
+        stays in group 0 (so an unlisted bulk forms its own island
+        exactly when some nodes ARE listed)."""
+        for name in self.group:
+            self.group[name] = 0
+        for gid, members in enumerate(groups, start=1):
+            for name in members:
+                self.group[name] = gid
+
+    def heal(self) -> None:
+        """Clear partitions, eclipses, and blocked links (downed nodes
+        stay down — churn is a separate fault)."""
+        for name in self.group:
+            self.group[name] = 0
+        self.eclipsed.clear()
+        self.blocked.clear()
+
+    def eclipse(self, victim: bytes, allowed: Iterable[bytes]) -> None:
+        """The victim may only talk to ``allowed`` (its attackers)."""
+        self.eclipsed[victim] = frozenset(allowed)
+
+    def clear_eclipse(self, victim: bytes) -> None:
+        self.eclipsed.pop(victim, None)
+
+    def block_link(self, a: bytes, b: bytes) -> None:
+        self.blocked.add(frozenset((a, b)))
+
+    def unblock_link(self, a: bytes, b: bytes) -> None:
+        self.blocked.discard(frozenset((a, b)))
+
+    def set_down(self, name: bytes, is_down: bool = True) -> None:
+        if is_down:
+            self.down.add(name)
+        else:
+            self.down.discard(name)
+
+    def set_link_policy(self, policy: LinkPolicy,
+                        a: bytes | None = None,
+                        b: bytes | None = None) -> None:
+        """Set one link's policy, or the network default (a=b=None)."""
+        if a is None and b is None:
+            self.default_policy = policy
+        else:
+            self.link_policy[frozenset((a, b))] = policy
+
+
+class MeshHub:
+    """Gossip over SimNetwork edges with the gossipsub-lite control
+    plane: per-node topic meshes, eager push along the mesh, lazy
+    IHAVE/IWANT repair on :meth:`heartbeat`. The ``PubSub._hub``
+    surface, like LoopbackHub — but topology-aware and fault-injected.
+    """
+
+    def __init__(self, network: SimNetwork, *, gossip_degree: int = 4):
+        self.network = network
+        self.gossip_degree = gossip_degree
+        self._nodes: dict[bytes, object] = {}      # name -> PubSub
+        self._gossip: dict[bytes, GossipMesh] = {}
+        self._seen: dict[bytes, dict[bytes, None]] = {}
+        self._inboxes: dict[bytes, asyncio.Queue] = {}
+        self._consumers: dict[bytes, asyncio.Task] = {}
+        self.stats = {"published": 0, "delivered": 0, "dup": 0,
+                      "rejected": 0, "relayed": 0, "ihave": 0,
+                      "iwant_served": 0, "dropped": 0}
+
+    # --- membership ----------------------------------------------------
+
+    def join(self, ps) -> None:
+        name = ps.name
+        ps._hub = self
+        self.network.add_node(name)
+        self._nodes[name] = ps
+        d = self.gossip_degree
+        self._gossip[name] = GossipMesh(
+            degree=d, d_lo=max(2, d - 1), d_hi=d + 2,
+            rng=random.Random(("gossip", self.network.seed, name)
+                              .__repr__()))
+        self._seen[name] = {}
+        self._ensure_consumer(name)
+
+    def leave(self, ps) -> None:
+        self.suspend(ps.name)
+        self._nodes.pop(ps.name, None)
+
+    def suspend(self, name: bytes) -> None:
+        """Churn: the node's consumer dies and queued frames are lost
+        (its identity and stores survive for a later :meth:`resume`)."""
+        task = self._consumers.pop(name, None)
+        if task is not None:
+            task.cancel()
+        self._inboxes.pop(name, None)
+        self.network.set_down(name, True)
+
+    def resume(self, name: bytes) -> None:
+        self.network.set_down(name, False)
+        if name in self._nodes:
+            self._ensure_consumer(name)
+
+    def _ensure_consumer(self, name: bytes) -> None:
+        if name in self._consumers and not self._consumers[name].done():
+            return
+        q = self._inboxes.get(name)
+        if q is None:
+            q = self._inboxes[name] = asyncio.Queue()
+        self._consumers[name] = asyncio.ensure_future(
+            self._consume(name, q))
+
+    # --- data plane ----------------------------------------------------
+
+    async def broadcast(self, sender, topic: str, data: bytes) -> None:
+        """PubSub._hub surface: the publisher floods its topic mesh."""
+        from ..core.hashing import sum256
+
+        name = sender.name
+        if not self.network.alive(name):
+            return
+        msg_id = sum256(topic.encode(), data)
+        self._mark_seen(name, msg_id)
+        mesh = self._gossip.get(name)
+        if mesh is None:
+            return
+        mesh.on_message(msg_id, topic, (topic, msg_id, data))
+        self.stats["published"] += 1
+        targets = mesh.eager_targets(topic, self.network.neighbors(name))
+        for dst in targets:
+            self._send(name, dst, ("msg", name, (topic, msg_id, data)))
+
+    def _mark_seen(self, name: bytes, msg_id: bytes) -> bool:
+        # the transport's exact dedup policy (shared helper), per node
+        return mark_seen(self._seen[name], msg_id, SEEN_CAP)
+
+    def _send(self, src: bytes, dst: bytes, item: tuple) -> None:
+        """One frame over one link, with the link's fault policy."""
+        net = self.network
+        if not net.reachable(src, dst):
+            self.stats["dropped"] += 1
+            net.stats["blocked"] += 1
+            return
+        q = self._inboxes.get(dst)
+        if q is None:
+            self.stats["dropped"] += 1
+            return
+        pol = net.policy(src, dst)
+        rng = net.rng
+        copies = 1
+        if pol.loss and rng.random() < pol.loss:
+            net.stats["loss"] += 1
+            return
+        if pol.dup and rng.random() < pol.dup:
+            net.stats["dup"] += 1
+            copies = 2
+        for _ in range(copies):
+            delay = pol.delay
+            if pol.jitter:
+                delay += rng.random() * pol.jitter
+            if pol.reorder and rng.random() < pol.reorder:
+                net.stats["reorder"] += 1
+                delay += pol.reorder_delay
+            if delay > 0:
+                asyncio.get_running_loop().call_later(
+                    delay, self._deliver_later, dst, q, item)
+            else:
+                q.put_nowait(item)
+
+    def _deliver_later(self, dst: bytes, q: asyncio.Queue,
+                       item: tuple) -> None:
+        # the node may have churned (and its queue been replaced) while
+        # the frame was in flight — deliver only to the live queue
+        if self._inboxes.get(dst) is q:
+            q.put_nowait(item)
+
+    async def _consume(self, name: bytes, q: asyncio.Queue) -> None:
+        while True:
+            kind, src, payload = await q.get()
+            try:
+                if kind == "msg":
+                    await self._on_msg(name, src, payload)
+                else:
+                    self._on_ctrl(name, src, payload)
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001 — bad frame must not kill the node
+                pass
+            finally:
+                q.task_done()
+
+    async def _on_msg(self, name: bytes, src: bytes, frame: tuple) -> None:
+        topic, msg_id, data = frame
+        if not self._mark_seen(name, msg_id):
+            self.stats["dup"] += 1
+            return
+        mesh = self._gossip[name]
+        mesh.on_message(msg_id, topic, frame)
+        ps = self._nodes.get(name)
+        if ps is None:
+            return
+        ok = await ps.deliver(topic, src, data)
+        self.stats["delivered"] += 1
+        if ok is True:
+            targets = mesh.eager_targets(
+                topic, self.network.neighbors(name), exclude=src)
+            for dst in targets:
+                self.stats["relayed"] += 1
+                self._send(name, dst, ("msg", name, frame))
+        elif ok is False:
+            self.stats["rejected"] += 1
+
+    # --- control plane -------------------------------------------------
+
+    def _on_ctrl(self, name: bytes, src: bytes, payload: bytes) -> None:
+        mesh = self._gossip[name]
+        seen = self._seen[name]
+        replies = mesh.on_control(src, payload,
+                                  seen=lambda mid: mid in seen)
+        for subtype, topic, ids in replies:
+            if subtype == -1:  # answer IWANT with the full frames
+                for mid in ids:
+                    frame = mesh.cache.get(mid)
+                    if frame is not None:
+                        self.stats["iwant_served"] += 1
+                        self._send(name, src, ("msg", name, frame))
+            else:
+                self._send(name, src,
+                           ("ctrl", name, encode_ctrl(subtype, topic, ids)))
+
+    def heartbeat(self) -> None:
+        """One gossip heartbeat for every live node: mesh maintenance
+        (GRAFT/PRUNE) + lazy IHAVE. The scenario engine calls this on a
+        virtual-time cadence."""
+        for name in list(self._nodes):
+            if not self.network.alive(name):
+                continue
+            mesh = self._gossip[name]
+            sends = mesh.heartbeat(self.network.neighbors(name))
+            for peer, subtype, topic, ids in sends:
+                if subtype == IHAVE:
+                    self.stats["ihave"] += 1
+                self._send(name, peer,
+                           ("ctrl", name, encode_ctrl(subtype, topic, ids)))
+
+    async def drain(self) -> None:
+        """Wait until every queued frame is fully processed."""
+        await asyncio.gather(*(q.join() for q in self._inboxes.values()))
+
+
+class _NetView:
+    """One server's view of the SimNet: ``nodes`` lists only peers it
+    can currently reach (partition/eclipse/down honored), so
+    ``Server.peers()`` and everything built on it (fetch peer
+    selection, peersync quorums) see the faulted world."""
+
+    def __init__(self, simnet: "SimNet", me: bytes):
+        self._simnet = simnet
+        self._me = me
+
+    @property
+    def nodes(self) -> dict[bytes, Server]:
+        net = self._simnet.network
+        return {n: s for n, s in self._simnet.servers.items()
+                if n == self._me or net.reachable(self._me, n)}
+
+    async def route(self, src: bytes, dst: bytes, protocol: str,
+                    data: bytes) -> bytes:
+        return await self._simnet.route(src, dst, protocol, data)
+
+
+class SimNet:
+    """Req/resp transport over the SimNetwork (``Server._net``)."""
+
+    def __init__(self, network: SimNetwork):
+        self.network = network
+        self.servers: dict[bytes, Server] = {}
+
+    def join(self, server: Server) -> None:
+        self.network.add_node(server.node_id)
+        self.servers[server.node_id] = server
+        server._net = _NetView(self, server.node_id)
+
+    def leave(self, server: Server) -> None:
+        self.servers.pop(server.node_id, None)
+        server._net = None
+
+    async def route(self, src: bytes, dst: bytes, protocol: str,
+                    data: bytes) -> bytes:
+        net = self.network
+        target = self.servers.get(dst)
+        if target is None or not net.reachable(src, dst):
+            raise RequestError(f"peer {dst.hex()[:8]} not reachable")
+        pol = net.policy(src, dst)
+        if pol.loss and net.rng.random() < pol.loss:
+            net.stats["loss"] += 1
+            raise RequestError(f"request to {dst.hex()[:8]} lost (chaos)")
+        delay = pol.delay + (net.rng.random() * pol.jitter
+                             if pol.jitter else 0.0)
+        if delay > 0:
+            await asyncio.sleep(delay)  # virtual under VirtualClockLoop
+        return await target.handle(protocol, src, data)

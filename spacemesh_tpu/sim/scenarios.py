@@ -1,0 +1,201 @@
+"""Built-in scenario scripts.
+
+Each builder returns a plain script dict (sim/scenario.py documents the
+schema); ``builtin(name, **overrides)`` is the registry the CLI and CI
+use. All numbers are DETERMINISTIC functions of the seed — nothing here
+reads wall time.
+
+* ``partition-heal`` — the bread-and-butter robustness drill (and the
+  CI scenario-smoke workload): majority/minority islands, storm + tx
+  traffic, malformed-ATX adversary, heal, SLI/SLO + convergence
+  assertions. Replaces the wall-clock partition half of the old
+  subprocess chaos suite with a seeded, replayable run.
+* ``storm-256`` — the 256-node acceptance scenario: gossip storm at
+  production fan-out, a 3-way partition (no island holds a certifying
+  majority for part of it), link degradation, light-node churn, the
+  full adversarial payload set, heal + Tortoise re-convergence with
+  zero consensus divergence.
+* ``timeskew-kill`` — ports the assertions of the randomly-seeded
+  multi-process cluster chaos test (tests/test_cluster_chaos.py —
+  systest timeskew.go + fail.go): one node's clock skews ahead and
+  returns, another dies for good; the survivors keep applying layers
+  and agree on applied blocks and state roots.
+* ``smoke`` — tiny engine self-test (2 full, 8 light, one storm).
+"""
+
+from __future__ import annotations
+
+
+def smoke(seed: int = 1, light: int = 8) -> dict:
+    return {
+        "name": "smoke", "seed": seed,
+        "nodes": {"full": 2, "light": light},
+        "layer_sec": 2.0, "lpe": 3, "until_layer": 6,
+        "digest_frontier": 5,
+        "phases": [
+            {"name": "run", "until_layer": 5,
+             "traffic": {"storm": {"publishers": 3, "messages": 8,
+                                   "interval": 0.2}}},
+            {"name": "end",
+             "converge": {"frontier": 5, "deadline": 180.0},
+             "asserts": [
+                 {"kind": "converged", "frontier": 5},
+                 {"kind": "storm_coverage", "min_fraction": 0.9},
+                 {"kind": "sli_present", "name": "layer_apply_p99"},
+                 {"kind": "slo_green"},
+             ]},
+        ],
+    }
+
+
+def partition_heal(seed: int = 7, light: int = 60) -> dict:
+    """Majority island (4/6 identities) keeps deciding layers through
+    the split; the minority islands coast and must re-converge after
+    the merge. Healing has BOTH reference paths available: validated
+    certificate adoption where the island's certifier hit threshold,
+    and tortoise vote weight once the divergent layers leave the hdist
+    window — which is why the run continues well past the merge
+    (test_partition.healed3 uses the same geometry)."""
+    return {
+        "name": "partition-heal", "seed": seed,
+        "nodes": {"full": 4, "light": light,
+                  "identities": [3, 1, 1, 1]},
+        "layer_sec": 2.0, "lpe": 8, "until_layer": 20,
+        "digest_frontier": 12,
+        "phases": [
+            {"name": "warmup", "until_layer": 10,
+             "traffic": {"storm": {"publishers": 6, "messages": 16,
+                                   "interval": 0.3},
+                         "tx_spawn": {}},
+             "asserts": [
+                 {"kind": "storm_coverage", "min_fraction": 0.9},
+             ]},
+            {"name": "partition", "until_layer": 13,
+             "faults": [
+                 {"kind": "partition", "islands": [[0, 1], [2], [3]]},
+                 {"kind": "adversary", "what": "malformed_atx",
+                  "count": 6, "via": 1},
+             ],
+             "traffic": {"storm": {"publishers": 6, "messages": 8,
+                                   "interval": 0.4}}},
+            {"name": "heal",
+             "faults": [{"kind": "heal"}],
+             "converge": {"frontier": 12, "deadline": 240.0},
+             "asserts": [
+                 {"kind": "converged", "frontier": 12},
+                 {"kind": "progress", "min_layer": 12},
+                 {"kind": "sli_present", "name": "layer_apply_p99"},
+                 {"kind": "sli_present", "name": "gossip_handler_p99"},
+                 {"kind": "slo_green"},
+                 {"kind": "span", "name": "mesh.process_layer",
+                  "min": 8},
+                 {"kind": "span", "name": "gossip.deliver", "min": 16},
+             ]},
+        ],
+    }
+
+
+def storm_256(seed: int = 11, light: int = 252) -> dict:
+    """The acceptance scenario: 256 nodes, gossip storm, 3-way
+    partition with link degradation and churn, adversarial payloads,
+    heal, Tortoise re-convergence, zero consensus divergence."""
+    churned = list(range(8, 32))
+    return {
+        "name": "storm-256", "seed": seed,
+        "nodes": {"full": 4, "light": light,
+                  "identities": [3, 1, 1, 1]},
+        "layer_sec": 2.0, "lpe": 8, "until_layer": 20,
+        "digest_frontier": 12,
+        "topology": {"degree": 6, "gossip_degree": 4},
+        "phases": [
+            {"name": "storm", "until_layer": 10,
+             "traffic": {"storm": {"publishers": 12, "messages": 30,
+                                   "interval": 0.15},
+                         "tx_spawn": {}},
+             "asserts": [
+                 {"kind": "storm_coverage", "min_fraction": 0.9},
+             ]},
+            {"name": "partition", "until_layer": 13,
+             "faults": [
+                 {"kind": "partition", "islands": [[0, 1], [2], [3]]},
+                 {"kind": "link_policy", "loss": 0.05, "delay": 0.02,
+                  "jitter": 0.05, "dup": 0.02, "reorder": 0.02},
+                 {"kind": "churn", "light": churned},
+                 {"kind": "adversary", "what": "malformed_atx",
+                  "count": 6, "via": 40},
+                 {"kind": "adversary", "what": "torsion_sig",
+                  "count": 4, "via": 41},
+                 {"kind": "adversary", "what": "dup_flood",
+                  "count": 12, "via": 42, "interval": 0.1},
+             ],
+             "traffic": {"storm": {"publishers": 8, "messages": 10,
+                                   "interval": 0.3}}},
+            {"name": "heal",
+             "faults": [
+                 {"kind": "link_policy"},   # back to clean links
+                 {"kind": "heal"},
+                 {"kind": "resume", "light": churned},
+             ],
+             "converge": {"frontier": 12, "deadline": 240.0},
+             "asserts": [
+                 {"kind": "converged", "frontier": 12},
+                 {"kind": "progress", "min_layer": 12},
+                 {"kind": "sli_present", "name": "layer_apply_p99"},
+                 {"kind": "sli_present", "name": "gossip_handler_p99"},
+                 {"kind": "slo_green"},
+                 {"kind": "span", "name": "mesh.process_layer",
+                  "min": 8},
+                 {"kind": "span", "name": "gossip.deliver", "min": 32},
+             ]},
+        ],
+    }
+
+
+def timeskew_kill(seed: int = 5, light: int = 16) -> dict:
+    """tests/test_cluster_chaos.py's assertions on the deterministic
+    fabric: skew one node's clock layers ahead mid-run, reset it, then
+    SIGKILL another node — the survivors (including the formerly
+    skewed one) must keep applying layers and agree on state."""
+    return {
+        "name": "timeskew-kill", "seed": seed,
+        "nodes": {"full": 3, "light": light, "identities": [2, 1, 1]},
+        "layer_sec": 2.0, "lpe": 3, "until_layer": 14,
+        "digest_frontier": 9,
+        "phases": [
+            {"name": "warmup", "until_layer": 4},
+            {"name": "skew", "until_layer": 6,
+             "faults": [{"kind": "timeskew", "full": 2, "offset": 4.0}]},
+            {"name": "reset", "until_layer": 8,
+             "faults": [{"kind": "timeskew", "full": 2, "offset": 0.0}]},
+            {"name": "kill", "until_layer": 11,
+             "faults": [{"kind": "kill", "full": 1}]},
+            {"name": "end",
+             "converge": {"frontier": 9, "deadline": 240.0},
+             "asserts": [
+                 {"kind": "converged", "frontier": 9},
+                 {"kind": "progress", "min_layer": 9},
+                 {"kind": "slo_green"},
+             ]},
+        ],
+    }
+
+
+_BUILTINS = {
+    "smoke": smoke,
+    "partition-heal": partition_heal,
+    "storm-256": storm_256,
+    "timeskew-kill": timeskew_kill,
+}
+
+
+def builtin_names() -> list[str]:
+    return sorted(_BUILTINS)
+
+
+def builtin(name: str, **kwargs) -> dict:
+    try:
+        builder = _BUILTINS[name]
+    except KeyError:
+        raise KeyError(f"unknown scenario {name!r}; "
+                       f"have {builtin_names()}") from None
+    return builder(**kwargs)

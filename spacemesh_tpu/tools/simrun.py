@@ -1,0 +1,19 @@
+"""Operator alias for the scenario engine CLI.
+
+The engine lives in ``spacemesh_tpu/sim`` (docs/SCENARIOS.md); this
+alias keeps it discoverable beside the other operator tools:
+
+    python -m spacemesh_tpu.tools.simrun --scenario partition-heal \
+        --light 64 --seed 7 --repeat 2
+
+is exactly ``python -m spacemesh_tpu.sim ...``.
+"""
+
+from __future__ import annotations
+
+import sys
+
+from ..sim.__main__ import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,10 +1,18 @@
-"""API surface over a live standalone node."""
+"""API surface over a live standalone node.
+
+De-flaked (ISSUE 8 satellite): the node's signer is a FIXED seed (a
+random key redraws the VRF proposal-slot lottery per run) and the tx
+lifecycle is awaited on CONDITIONS — poll the API until the result
+lands, bounded by virtual time — instead of sleeping a fixed number of
+layers and hoping the spawn got into one of them."""
 
 import asyncio
+import hashlib
 
 import pytest
 from aiohttp import ClientSession
 
+from spacemesh_tpu.core.signing import EdSigner
 from spacemesh_tpu.node import clock as clock_mod
 from spacemesh_tpu.node.app import App
 from spacemesh_tpu.node.config import load
@@ -34,7 +42,9 @@ def api_env(tmp_path_factory):
         "tortoise": {"hdist": 4, "window_size": 50},
     })
     loop = VirtualClockLoop()
-    app = App(cfg, time_source=loop.time)
+    signer = EdSigner(seed=hashlib.sha256(b"api-node").digest(),
+                      prefix=cfg.genesis.genesis_id)
+    app = App(cfg, signer=signer, time_source=loop.time)
     results = {}
 
     async def go():
@@ -68,9 +78,18 @@ def api_env(tmp_path_factory):
                 f"{base}/v1/tx/submit", json={"raw": "zz"})).status
             results["tx_lookup_404"] = (await s.get(
                 f"{base}/v1/tx/{'00'*32}")).status
-            await asyncio.sleep(LAYER_SEC * 2.2)
-            results["tx_after"] = await (await s.get(
-                f"{base}/v1/tx/{results['submit'][1]['tx_id']}")).json()
+            # condition wait: the spawn lands in whichever later layer
+            # includes it — poll the API until the result exists
+            # (bounded by VIRTUAL time, costs no wall clock) instead of
+            # sleeping an exact layer count and hoping
+            tx_id = results["submit"][1]["tx_id"]
+            deadline = loop.time() + 8 * LAYER_SEC
+            while loop.time() < deadline:
+                tx_doc = await (await s.get(f"{base}/v1/tx/{tx_id}")).json()
+                if tx_doc.get("result") is not None:
+                    break
+                await asyncio.sleep(LAYER_SEC / 4)
+            results["tx_after"] = tx_doc
             results["layer3"] = await (await s.get(f"{base}/v1/mesh/layer/3")).json()
             results["root"] = await (await s.get(f"{base}/v1/globalstate/root")).json()
             results["debug"] = await (await s.get(f"{base}/v1/debug/state")).json()

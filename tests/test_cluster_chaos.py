@@ -1,12 +1,24 @@
 """Cluster harness chaos run: timeskew + kill on one subprocess cluster.
 
-Drives the same ``Cluster`` class the one-command harness
-(`python -m spacemesh_tpu.tools.cluster`) uses; scenario provenance:
-reference systest/chaos/timeskew.go:12, fail.go:31 and the watcher
-pattern of systest/tests/common.go.  The partition scenario is covered
-by the harness CLI and the deterministic vclock suite
-(tests/test_partition.py); running all three here would double the
-suite's wall clock for no new code path.
+SUPERSEDED for day-to-day regression coverage by the deterministic
+scenario engine (ISSUE 8): the ``timeskew-kill`` sim scenario
+(spacemesh_tpu/sim/scenarios.py, asserted tier-1 in
+tests/test_sim_scenarios.py) ports these assertions — skewed clock
+ahead and back, SIGKILL a node, survivors keep applying and agree on
+state — onto seeded virtual-clock nodes where any failure replays
+exactly from its seed. This subprocess version stays TIER-2 ONLY as
+the real-process/real-socket integration check: it drives the same
+``Cluster`` class the one-command harness
+(`python -m spacemesh_tpu.tools.cluster`) uses, with wall-clock sleeps
+and per-run random keys (the flake class ADVICE.md kept flagging —
+acceptable in tier-2, where reruns are cheap and the point is the
+subprocess plumbing, not the consensus logic).
+
+Scenario provenance: reference systest/chaos/timeskew.go:12, fail.go:31
+and the watcher pattern of systest/tests/common.go.  The partition
+scenario is covered by the harness CLI and the deterministic vclock
+suite (tests/test_partition.py + the sim ``partition-heal``/
+``storm-256`` scenarios).
 """
 
 import time
@@ -16,7 +28,10 @@ import pytest
 from spacemesh_tpu.tools.cluster import Cluster
 
 # tier-2: a five-subprocess cluster needs minutes of real wall clock
-# (POST init + jit warmup per node); tier-1 (-m 'not slow') skips it
+# (POST init + jit warmup per node), and its random seeds make it
+# statistically, not deterministically, green; the seeded sim port
+# (tests/test_sim_scenarios.py::test_timeskew_kill_ports_cluster_chaos_assertions)
+# is the tier-1 version of this coverage
 pytestmark = pytest.mark.slow
 
 N = 5

@@ -1,0 +1,182 @@
+"""Fetch retry hardening: capped exponential backoff + jitter between
+retry rounds, per-peer penalty windows after transport-level chunk
+failures, and the definitive-miss fast path (ISSUE 8 satellite —
+previously a failed chunk retried the whole id set elsewhere
+immediately, hammering a flapping peer set)."""
+
+import asyncio
+import random
+
+import pytest
+
+from spacemesh_tpu.core.hashing import sum256
+from spacemesh_tpu.p2p import fetch as fetch_mod
+from spacemesh_tpu.p2p.fetch import Fetch, HashRequest, HashResponse
+from spacemesh_tpu.p2p.server import LoopbackNet, RequestError, Server
+
+A, B, C = (b"A" * 32), (b"B" * 32), (b"C" * 32)
+
+
+class FlakyServer(Server):
+    """Serves hs/1 from a blob dict, failing the first ``fail_first``
+    requests with a transport error; counts every request."""
+
+    def __init__(self, node_id, blobs=None, fail_first=0):
+        super().__init__(node_id)
+        self.blobs = dict(blobs or {})
+        self.fail_first = fail_first
+        self.requests = 0
+
+        async def serve(peer, data):
+            self.requests += 1
+            if self.requests <= self.fail_first:
+                raise RequestError("flap")
+            req = HashRequest.from_bytes(data)
+            return HashResponse(
+                blobs=[self.blobs.get(h, b"") for h in req.hashes]
+            ).to_bytes()
+
+        self.register(fetch_mod.P_HASH, serve)
+
+
+def _fetch(server, **kw):
+    kw.setdefault("backoff_base", 0.01)
+    kw.setdefault("backoff_cap", 0.05)
+    kw.setdefault("penalty_base", 0.05)
+    kw.setdefault("rng", random.Random(1))
+    return Fetch(server, **kw)
+
+
+def _ids(blobs):
+    return {sum256(b): b for b in blobs}
+
+
+def test_transient_failure_retries_with_backoff_and_succeeds():
+    """A peer that flaps once serves the chunk on the retry round —
+    within ONE get_hashes call, after a backoff."""
+    blobs = _ids([b"one", b"two"])
+    net = LoopbackNet()
+    me = Server(A)
+    net.join(me)
+    peer = FlakyServer(B, blobs, fail_first=1)
+    net.join(peer)
+    f = _fetch(me)
+
+    async def go():
+        return await f.get_hashes(99, list(blobs))
+
+    result = asyncio.run(go())
+    assert all(result.values()), result
+    assert peer.requests >= 2, "no retry round happened"
+
+
+def test_definitive_miss_does_not_retry():
+    """Peers that ANSWER (empty blob = don't have it) are definitive:
+    no extra retry rounds, no backoff sleeps."""
+    net = LoopbackNet()
+    me = Server(A)
+    net.join(me)
+    peer = FlakyServer(B, {})          # healthy but empty
+    net.join(peer)
+    f = _fetch(me, retry_rounds=5)
+
+    async def go():
+        return await f.get_hashes(99, [sum256(b"nope")])
+
+    result = asyncio.run(go())
+    assert result == {sum256(b"nope"): False}
+    assert peer.requests == 1, \
+        f"definitive miss must not re-poll the peer ({peer.requests})"
+
+
+def test_penalty_window_skips_flapping_peer_and_expires():
+    net = LoopbackNet()
+    me = Server(A)
+    net.join(me)
+    net.join(FlakyServer(B))
+    net.join(FlakyServer(C))
+    f = _fetch(me, penalty_base=0.5, penalty_cap=30.0)
+
+    async def go():
+        f._chunk_failure(B)
+        assert f.penalized(B)
+        assert f.peers() == [C], "penalized peer selected"
+        # escalation: consecutive failures double the window
+        w1 = f._penalty_until[B] - f._now()
+        f._chunk_failure(B)
+        w2 = f._penalty_until[B] - f._now()
+        assert w2 > w1 * 1.5
+        # success clears both the penalty and the escalation state
+        f.report_success(B)
+        assert not f.penalized(B) and B in f.peers()
+        # everyone penalized -> fall back rather than stall sync
+        f._chunk_failure(B)
+        f._chunk_failure(C)
+        assert set(f.peers()) == {B, C}
+
+    asyncio.run(go())
+
+
+def test_penalty_window_expires_on_the_loop_clock():
+    net = LoopbackNet()
+    me = Server(A)
+    net.join(me)
+    net.join(FlakyServer(B))
+    net.join(FlakyServer(C))
+    f = _fetch(me, penalty_base=0.05)
+
+    async def go():
+        f._chunk_failure(B)
+        assert f.peers() == [C]
+        await asyncio.sleep(0.1)
+        assert set(f.peers()) == {B, C}, "window did not expire"
+
+    asyncio.run(go())
+
+
+def test_backoff_is_capped_and_jittered():
+    net = LoopbackNet()
+    me = Server(A)
+    net.join(me)
+    f = _fetch(me, backoff_base=0.01, backoff_cap=0.02,
+               rng=random.Random(7))
+    delays = []
+
+    async def go():
+        loop = asyncio.get_running_loop()
+        for rnd in (0, 1, 5, 9):
+            t0 = loop.time()
+            await f._backoff(rnd)
+            delays.append(loop.time() - t0)
+
+    asyncio.run(go())
+    assert all(d <= 0.02 * 1.1 + 0.02 for d in delays), delays  # capped
+    assert delays[0] < 0.02, "jitter floor"
+
+
+def test_bad_blob_still_penalizes_score_not_window():
+    """A VALIDATOR reject (bad content from a responsive peer) keeps
+    the heavier score penalty but is not a transport flap — the peer
+    stays selectable for other hints."""
+    blob = b"payload"
+    wrong_id = sum256(b"something-else")
+    net = LoopbackNet()
+    me = Server(A)
+    net.join(me)
+    peer = FlakyServer(B, {wrong_id: blob})
+    net.join(peer)
+    f = _fetch(me, retry_rounds=2)
+
+    async def never_ok(h, b):
+        return False
+
+    f.set_validator(99, never_ok)
+
+    async def go():
+        return await f.get_hashes(99, [wrong_id])
+
+    result = asyncio.run(go())
+    assert result == {wrong_id: False}
+    assert f.failure_score(B) >= 3
+    assert not f.penalized(B)
+    assert peer.requests == 1, "validator reject is definitive too"

@@ -251,3 +251,33 @@ def test_sixteen_node_mesh_beats_flood_duplication():
                 await h.stop()
 
     asyncio.run(go())
+
+
+def test_topic_spam_capped_on_both_planes():
+    """Attacker-chosen topic strings must not grow the per-topic tables
+    without bound — on the CONTROL plane (GRAFT past the cap answers
+    PRUNE) or on the DATA plane (on_message refuses to learn new topics
+    past the cap; the frame still lands in the size-bounded cache), and
+    relaying (eager_targets) never creates entries at all."""
+    m = GossipMesh()
+    peer = b"p" * 32
+    for i in range(m.MAX_TOPICS):
+        m.on_message(b"%032d" % i, "t%d" % i, b"frame")
+    assert len(m.mesh) == m.MAX_TOPICS
+    # data-plane spam past the cap: cached but not learned
+    m.on_message(b"x" * 32, "junk-data", b"frame")
+    assert "junk-data" not in m.mesh and len(m.mesh) == m.MAX_TOPICS
+    assert m.cache.get(b"x" * 32) is not None, "IWANT can still serve it"
+    # relay path is read-only on the table
+    m.eager_targets("junk-relay", {peer})
+    assert "junk-relay" not in m.mesh
+    # control-plane spam past the cap: GRAFT -> PRUNE, others dropped
+    replies = m.on_control(peer, encode_ctrl(GRAFT, "junk-ctrl"),
+                           seen=lambda mid: False)
+    assert replies == [(PRUNE, "junk-ctrl", [])]
+    assert "junk-ctrl" not in m.mesh
+    # KNOWN topics keep working past the cap
+    m.on_message(b"y" * 32, "t0", b"frame2")
+    assert m.on_control(peer, encode_ctrl(GRAFT, "t0"),
+                        seen=lambda mid: False) == []
+    assert peer in m.mesh["t0"]

@@ -264,3 +264,33 @@ def test_trace_capture_endpoints(stub_api):
     assert stopped["spans_recorded"] >= 1
     assert bad == 400
     assert not tracing.is_enabled()
+
+
+def test_admin_chaos_link_validates_bodies(stub_api):
+    """POST /v1/admin/chaos/link: a real Host gains a link policy; a
+    non-object JSON body (valid JSON, wrong shape) is a 400, never an
+    unhandled 500; no host at all is a 409."""
+    class FakeHost:
+        def __init__(self):
+            self.calls = []
+
+        def chaos_link(self, **kw):
+            self.calls.append(kw)
+
+    async def go(s, base):
+        r409 = await s.post(f"{base}/v1/admin/chaos/link",
+                            json={"loss": 0.5})
+        assert r409.status == 409, r409.status  # no transport host yet
+        stub_api.node.host = FakeHost()
+        r = await s.post(f"{base}/v1/admin/chaos/link",
+                         json={"loss": 0.25, "delay": 0.1, "seed": 3})
+        assert r.status == 200, await r.text()
+        for bad in ("[1, 2]", "null", '"str"', "3"):
+            rb = await s.post(f"{base}/v1/admin/chaos/link", data=bad,
+                              headers={"Content-Type": "application/json"})
+            assert rb.status == 400, (bad, rb.status)
+        return stub_api.node.host.calls
+
+    calls = _with_server(stub_api, go)
+    assert calls == [{"loss": 0.25, "delay": 0.1, "jitter": 0.0,
+                      "dup": 0.0, "seed": 3}]

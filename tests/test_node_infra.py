@@ -172,3 +172,86 @@ def test_event_bus_overflow():
         bus.emit(events_mod.LayerUpdate(layer=i, status="tick"))
     assert sub.overflowed
     assert sub.queue.qsize() == 2
+
+
+def test_clock_await_layer_across_jump_with_notify():
+    """A big injected-time jump (chaos timeskew / virtual clock): every
+    await_layer waiter wakes IMMEDIATELY on notify_time_changed() and
+    observes the post-jump layer — no poll-interval latency, no missed
+    wakeups (ISSUE 8 satellite)."""
+
+    async def run():
+        ft = clock_mod.FakeTime(start=1000.0)
+        c = clock_mod.LayerClock(1000.0, 10.0, time_source=ft,
+                                 poll_interval=30.0)
+        # poll_interval is deliberately huge: only the notify can wake
+        # the waiters within the test timeout
+        w5 = asyncio.create_task(c.await_layer(5))
+        w2 = asyncio.create_task(c.await_layer(2))
+        await asyncio.sleep(0.05)
+        assert not w5.done() and not w2.done()
+        ft.advance(57)           # jump straight into layer 5
+        c.notify_time_changed()
+        assert await asyncio.wait_for(w5, 1.0) == 5
+        assert await asyncio.wait_for(w2, 1.0) == 5
+        # an already-begun layer returns without any waiting
+        assert await asyncio.wait_for(c.await_layer(3), 1.0) == 5
+
+    asyncio.run(run())
+
+
+def test_clock_ticks_order_and_completeness_across_jump():
+    """A jump spanning several layers must yield EVERY skipped layer,
+    in order, exactly once — consumers (the App layer loop) depend on
+    gapless tick streams for epoch bookkeeping."""
+
+    async def run():
+        ft = clock_mod.FakeTime(start=1000.0)
+        c = clock_mod.LayerClock(1000.0, 10.0, time_source=ft)
+        seen = []
+
+        async def consume():
+            async for lyr in c.ticks():
+                seen.append(int(lyr))
+                if len(seen) >= 6:
+                    return
+
+        task = asyncio.create_task(consume())
+        await asyncio.sleep(0.05)
+        ft.advance(41)           # jump over layers 1..4 at once
+        c.notify_time_changed()
+        await asyncio.sleep(0.1)
+        assert seen == [1, 2, 3, 4]
+        ft.advance(8.9)          # t=1049.9: not yet layer 5 (1050)
+        c.notify_time_changed()
+        await asyncio.sleep(0.05)
+        assert seen == [1, 2, 3, 4]
+        ft.advance(11.2)         # layers 5 and 6 land together
+        c.notify_time_changed()
+        await asyncio.wait_for(task, timeout=2)
+        assert seen == [1, 2, 3, 4, 5, 6]
+
+    asyncio.run(run())
+
+
+def test_clock_backward_jump_keeps_waiters_sane():
+    """A BACKWARD jump (timeskew healing) must not fire layers early:
+    waiters re-arm against the corrected time and fire at the true
+    layer start."""
+
+    async def run():
+        ft = clock_mod.FakeTime(start=1000.0)
+        c = clock_mod.LayerClock(1000.0, 10.0, time_source=ft)
+        ft.advance(35)                       # layer 3
+        assert int(c.current_layer()) == 3
+        w = asyncio.create_task(c.await_layer(4))
+        await asyncio.sleep(0.05)
+        ft.t = 1005.0                        # heal: back to layer 0
+        c.notify_time_changed()
+        await asyncio.sleep(0.1)
+        assert not w.done(), "waiter fired during the backward jump"
+        ft.t = 1041.0                        # true layer 4 start
+        c.notify_time_changed()
+        assert await asyncio.wait_for(w, 1.0) == 4
+
+    asyncio.run(run())

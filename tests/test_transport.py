@@ -320,3 +320,108 @@ def test_fallback_aead_mac_is_length_framed():
     # and vice versa: last aad byte moved into the ciphertext
     with pytest.raises(ValueError):
         aead.decrypt(nonce, aad[-1:] + ct + tag, aad[:-1])
+
+
+# --- chaos hooks under concurrency (ISSUE 8 satellite) ----------------
+
+
+def test_chaos_block_under_concurrent_dials():
+    """chaos_block must hold while BOTH sides dial simultaneously and
+    repeatedly: no connection forms in either direction while the block
+    stands, and chaos_clear restores dialing."""
+
+    async def go():
+        a, _, _ = _mk(b"a", min_peers=0)
+        b, _, _ = _mk(b"b", min_peers=0)
+        await a.start()
+        await b.start()
+        a.chaos_block(node_ids=[b.node_id], addrs=[b.address])
+        # a storm of simultaneous dials from both sides
+        await asyncio.gather(*(
+            [a._dial(b.address) for _ in range(5)]
+            + [b._dial(a.address) for _ in range(5)]))
+        await asyncio.sleep(0.5)
+        assert b.node_id not in a.nodes, "blocked peer connected"
+        assert a.node_id not in b.nodes, \
+            "accept side ignored the chaos block"
+        # clear + re-dial (again concurrently) -> exactly one connection
+        a.chaos_clear()
+        await asyncio.gather(*(
+            [a._dial(b.address) for _ in range(3)]
+            + [b._dial(a.address) for _ in range(3)]))
+        await _wait(lambda: b.node_id in a.nodes
+                    and a.node_id in b.nodes)
+        assert not a.nodes[b.node_id].closed.is_set()
+        await a.stop()
+        await b.stop()
+
+    asyncio.run(asyncio.wait_for(go(), 30))
+
+
+def test_chaos_block_severs_live_connection_midstream():
+    """Blocking an already-connected peer drops the live connection;
+    the dial maintainer must not silently resurrect it while blocked."""
+
+    async def go():
+        a, psa, _ = _mk(b"a")
+        b, psb, _ = _mk(b"b")
+        await a.start()
+        await b.start()
+        await a._dial(b.address)
+        await _wait(lambda: b.node_id in a.nodes)
+        a.chaos_block(node_ids=[b.node_id],
+                      addrs=[b.address])
+        await _wait(lambda: b.node_id not in a.nodes)
+        # give the maintainers a couple of cycles to try to reconnect
+        await asyncio.sleep(1.2)
+        assert b.node_id not in a.nodes
+        await a.stop()
+        await b.stop()
+
+    asyncio.run(asyncio.wait_for(go(), 30))
+
+
+def test_chaos_link_loss_delay_and_dup():
+    """chaos_link degrades gossip relays: full loss drops them, delay
+    defers them, duplication is absorbed by the receiver's dedup."""
+
+    async def go():
+        a, psa, _ = _mk(b"a")
+        b, psb, _ = _mk(b"b")
+        got = []
+
+        async def hb(peer, data):
+            got.append(data)
+            return True
+
+        psb.register("t1", hb)
+        await a.start()
+        await b.start()
+        await a._dial(b.address)
+        await _wait(lambda: b.node_id in a.nodes)
+
+        a.chaos_link(loss=1.0)
+        await psa.publish("t1", b"lost")
+        await asyncio.sleep(0.4)
+        assert got == [], "full loss still delivered"
+
+        a.chaos_clear()
+        await psa.publish("t1", b"clean")
+        await _wait(lambda: b"clean" in got)
+
+        a.chaos_link(dup=1.0)
+        dup_before = b.stats["gossip_dup"]
+        await psa.publish("t1", b"doubled")
+        await _wait(lambda: b"doubled" in got)
+        await _wait(lambda: b.stats["gossip_dup"] > dup_before)
+        assert got.count(b"doubled") == 1, "dedup must absorb the copy"
+
+        a.chaos_link(delay=0.5)
+        await psa.publish("t1", b"late")
+        await asyncio.sleep(0.15)
+        assert b"late" not in got, "delayed frame arrived early"
+        await _wait(lambda: b"late" in got, timeout=3.0)
+        await a.stop()
+        await b.stop()
+
+    asyncio.run(asyncio.wait_for(go(), 30))
