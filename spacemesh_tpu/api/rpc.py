@@ -650,11 +650,19 @@ class GrpcApiServer:
             path = f.name
         try:
             await asyncio.to_thread(checkpoint_mod.write, self.node.state, path)
-            with open(path, "rb") as f:
-                while chunk := f.read(64 << 10):
+            # open + chunk reads off the loop: a mainnet-shape checkpoint
+            # is large and every sync 64KiB read stalled the event loop
+            # between yielded chunks (spacecheck SC002)
+            f = await asyncio.to_thread(open, path, "rb")
+            try:
+                while chunk := await asyncio.to_thread(f.read, 64 << 10):
                     yield cpb.CheckpointStreamResponse(data=chunk)
+            finally:
+                f.close()
         finally:
-            os.unlink(path)
+            # unlinking a multi-GB checkpoint can take hundreds of ms
+            # in the kernel — off the loop like the reads
+            await asyncio.to_thread(os.unlink, path)
 
     async def _recover(self, req, ctx):
         await asyncio.to_thread(

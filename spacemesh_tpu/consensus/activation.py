@@ -297,6 +297,7 @@ class Builder:
             # result() may do blocking I/O (remote poet) — poll off-loop
             while (result := await asyncio.to_thread(
                     self.poet.result, round_id)) is None:
+                # spacecheck: ok=SC001 off-loop poll pacing, not a protocol delay; elapses instantly in virtual time
                 await asyncio.sleep(0.05)
         membership = result.membership(challenge)
         if membership is None:

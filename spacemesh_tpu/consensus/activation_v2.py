@@ -298,6 +298,7 @@ async def build_merged_atx(*, primary: EdSigner, partners: list[EdSigner],
     else:
         while (result := await asyncio.to_thread(poet.result,
                                                  round_id)) is None:
+            # spacecheck: ok=SC001 off-loop poll pacing, not a protocol delay; elapses instantly in virtual time
             await asyncio.sleep(0.05)
 
     from .activation import store_poet_blob

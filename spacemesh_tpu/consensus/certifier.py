@@ -68,11 +68,15 @@ class CertifierService:
     """Verify a POST proof, sign a certificate (certifier.go:246 flow)."""
 
     def __init__(self, signer: EdSigner, params: ProofParams,
-                 scrypt_n: int, validity: float = 0.0):
+                 scrypt_n: int, validity: float = 0.0,
+                 time_source=time.time):
         self.signer = signer
         self.params = params
         self.scrypt_n = scrypt_n
         self.validity = validity  # seconds; 0 = certs never expire
+        # injected so sim/chaos scenarios can skew cert expiries along
+        # with the rest of the node (SC001 clock discipline)
+        self._now = time_source
 
     @property
     def pubkey(self) -> bytes:
@@ -89,7 +93,7 @@ class CertifierService:
             raise ValueError("POST proof failed verification")
         cert = PoetCert(
             node_id=node_id,
-            expiry=time.time() + self.validity if self.validity else 0.0,
+            expiry=self._now() + self.validity if self.validity else 0.0,
             signature=b"")
         cert.signature = self.signer.sign(Domain.POET_CERT,
                                           cert.signed_bytes())
@@ -186,9 +190,11 @@ class CertifierClient:
     """Node side: obtain + cache one cert per identity (reference
     Certifier.Certificate caches in the local DB)."""
 
-    def __init__(self, address: tuple[str, int], timeout: float = 120.0):
+    def __init__(self, address: tuple[str, int], timeout: float = 120.0,
+                 time_source=time.time):
         self.address = tuple(address)
         self.timeout = timeout
+        self._now = time_source  # cert-expiry checks follow the node clock
         self._certs: dict[bytes, PoetCert] = {}
 
     def _call(self, req: dict) -> dict:
@@ -208,7 +214,7 @@ class CertifierClient:
                     labels_per_unit: int) -> PoetCert:
         cached = self._certs.get(node_id)
         if cached is not None and (not cached.expiry
-                                   or cached.expiry > time.time()):
+                                   or cached.expiry > self._now()):
             return cached
         d = self._call({
             "method": "certify", "proof": proof.to_dict(),

@@ -18,6 +18,8 @@ from __future__ import annotations
 import dataclasses
 from typing import Optional
 
+from ..utils import logging as slog
+
 from ..core import codec
 from ..core.hashing import sum256
 from ..core.signing import Domain, EdSigner, EdVerifier
@@ -37,6 +39,8 @@ from ..txs import ConservativeState
 from .eligibility import Oracle
 from .mesh import ProposalStore
 from .tortoise import Tortoise
+
+_log = slog.get("miner")
 
 MAX_TXS_PER_PROPOSAL = 700
 
@@ -287,8 +291,12 @@ class ProposalHandler:
             if ref is None and self.fetch_ballot is not None:
                 try:
                     await self.fetch_ballot(ballot.ref_ballot)
-                except Exception:
-                    pass
+                except Exception as e:  # noqa: BLE001 — a failed fetch
+                    # only delays validation (sync redelivers in layer
+                    # order); log it so a systematically failing peer
+                    # set is visible (spacecheck SC006)
+                    _log.debug("ref-ballot fetch failed for %s: %r",
+                               ballot.ref_ballot.hex()[:12], e)
                 ref = ballotstore.get(self.db, ballot.ref_ballot)
             epoch_data = ballotstore.resolve_epoch_data(
                 self.db, ballot, self.layers_per_epoch)

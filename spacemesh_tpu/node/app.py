@@ -332,7 +332,10 @@ class App:
         if not self.cfg.tortoise.trace:
             return None
         if getattr(self, "_tracer_fn", None) is None:
-            fh = open(self.data / "tortoise_trace.jsonl", "a")
+            # App-lifetime handle, closed in close() (spacecheck SC004:
+            # an open() that outlives its function must have an owner)
+            fh = self._tracer_fh = open(
+                self.data / "tortoise_trace.jsonl", "a")
 
             def write(line: str) -> None:
                 fh.write(line + "\n")
@@ -646,7 +649,7 @@ class App:
         rs_cache: dict[str, tuple[float, object]] = {}
 
         def set_for(name: str):
-            now = time.monotonic()
+            now = self.time_source()  # TTL follows the node clock
             hit = rs_cache.get(name)
             if hit is not None and hit[0] > now:
                 return hit[1]
@@ -845,7 +848,10 @@ class App:
             genesis_id=self.cfg.genesis.genesis_id,
             listen=cfg.listen or "127.0.0.1:0",
             bootstrap=cfg.bootnodes,
-            min_peers=cfg.min_peers, max_peers=cfg.max_peers)
+            min_peers=cfg.min_peers, max_peers=cfg.max_peers,
+            # ban windows / dial pacing / gossip heartbeats follow the
+            # node clock, so sim/chaos timeskew reaches the transport
+            time_source=self.time_source)
         addr = await self.host.start()
         self.host.join_pubsub(self.pubsub)
         self.connect_network(self.host)
@@ -1071,7 +1077,8 @@ class App:
         from ..consensus.certifier import CertifierClient
 
         host, _, port = addr_spec.rpartition(":")
-        certifier = CertifierClient((host or "127.0.0.1", int(port)))
+        certifier = CertifierClient((host or "127.0.0.1", int(port)),
+                                    time_source=self.time_source)
         for b in self.atx_builders:
             node_id = b.signer.node_id
             challenge = sum256(b"poet-cert-challenge", node_id)
@@ -1279,3 +1286,7 @@ class App:
             self.post_supervisor.stop()
         self.state.close()
         self.local.close()
+        if getattr(self, "_tracer_fh", None) is not None:
+            self._tracer_fh.close()
+            self._tracer_fh = None
+            self._tracer_fn = None

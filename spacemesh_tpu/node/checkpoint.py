@@ -53,6 +53,7 @@ def generate(db: Database, layer: int | None = None) -> dict:
                db.all("SELECT epoch, beacon FROM beacons")}
     return {
         "version": VERSION,
+        # spacecheck: ok=SC001 checkpoint files record REAL wall time for operators (reference parity)
         "timestamp": int(time.time()),
         "layer": layer,
         "state_hash": (layerstore.state_hash(db, layer) or b"").hex(),

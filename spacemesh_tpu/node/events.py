@@ -140,7 +140,9 @@ class EventBus:
         return sub
 
     def emit(self, ev: Any) -> None:
-        self.recent.append((time.time(), type(ev).__name__, ev))
+        # display timestamp for flight-bundle event dumps, never used
+        # in logic or digests
+        self.recent.append((time.time(), type(ev).__name__, ev))  # spacecheck: ok=SC001 wall display timestamp only
         for sub in list(self._subs.get(type(ev), ())):
             sub._offer(ev)
 

@@ -306,5 +306,6 @@ def register_runtime_collectors(
     if getattr(registry, "_runtime_collectors_attached", False):
         return
     registry._runtime_collectors_attached = True
+    # spacecheck: ok=SC004 idempotence-guarded just above (attribute marker on the registry, PR-7 review fix)
     registry.add_collector(_collect_rss)
-    registry.add_collector(_collect_fds)
+    registry.add_collector(_collect_fds)  # spacecheck: ok=SC004 same attribute-marker guard

@@ -64,7 +64,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from ..utils import tracing
+from ..utils import sanitize, tracing
 from .sha256 import byteswap32, hmac_midstates, sha256_compress
 
 LABEL_BYTES = 16  # reference: 16-byte labels, 2^32 per 64 GiB unit
@@ -467,6 +467,7 @@ def scrypt_labels_jit(commitment_words, idx_lo, idx_hi, *, n: int,
         commitment_words, idx_lo, idx_hi, valid = _bucket_lanes(
             commitment_words, idx_lo, idx_hi)
     batch = int(idx_lo.shape[0])
+    sanitize.on_jit_shape("labels_fused", batch)
     d, interpret = _plan(n, batch, commitment_words, idx_lo, idx_hi,
                          impl=impl, chunk=chunk)
     # the span covers the ENQUEUE (trace+compile on a cache miss, else
@@ -607,6 +608,7 @@ def scrypt_labels_with_min(commitment_words, idx_lo, idx_hi, carry, *,
         commitment_words, idx_lo, idx_hi, valid = _bucket_lanes(
             commitment_words, idx_lo, idx_hi)
     batch = int(idx_lo.shape[0])
+    sanitize.on_jit_shape("labels_min_fused", batch)
     d, interpret = _plan(n, batch, commitment_words, idx_lo, idx_hi, carry,
                          impl=impl, chunk=chunk)
     # a pallas attempt can fail AFTER compile (e.g. HBM exhaustion

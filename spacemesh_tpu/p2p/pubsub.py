@@ -147,7 +147,10 @@ class LoopbackHub:
                     topic, peer, data = await q.get()
                     try:
                         await node.deliver(topic, peer, data)
-                    except Exception:  # noqa: BLE001
+                    except Exception:  # noqa: BLE001 — deliver() already
+                        # counts + logs per-handler failures
+                        # (pubsub_handler_drops_total); this guard only
+                        # keeps the hub consumer task alive
                         pass
                     finally:
                         q.task_done()

@@ -165,7 +165,7 @@ class QuicConnection:
         # recv side
         self._recv_next = 0
         self._ooo: dict[int, bytes] = {}
-        self.last_heard = time.monotonic()
+        self.last_heard = self.endpoint._now()
         self._tasks: list[asyncio.Task] = []
 
     # --- lifecycle ---
@@ -212,7 +212,7 @@ class QuicConnection:
             self._next_seq += 1
             pkt = HEADER.pack(MAGIC, DATA, self.remote_id, seq,
                               self._recv_next) + chunk
-            self._inflight[seq] = (pkt, time.monotonic())
+            self._inflight[seq] = (pkt, self.endpoint._now())
             self.endpoint._send_pkt(pkt, self.remote_addr, data=True)
         if self._send_buf or len(self._inflight) >= WINDOW:
             self._drain_ev.clear()
@@ -240,7 +240,7 @@ class QuicConnection:
     async def _retransmit_loop(self) -> None:
         while not self.closed:
             await asyncio.sleep(self._rto / 2)
-            now = time.monotonic()
+            now = self.endpoint._now()
             if self.last_heard + IDLE_TIMEOUT < now:
                 self.close()
                 return
@@ -261,7 +261,7 @@ class QuicConnection:
 
     def on_packet(self, ptype: int, seq: int, ack: int, payload: bytes,
                   addr) -> None:
-        self.last_heard = time.monotonic()
+        self.last_heard = self.endpoint._now()
         # connection-id routing: the peer may have migrated address
         if addr != self.remote_addr:
             self.remote_addr = addr
@@ -303,7 +303,11 @@ class QuicEndpoint(asyncio.DatagramProtocol):
     """One UDP socket serving many QUIC-lite connections."""
 
     def __init__(self, on_accept=None, loss_rate: float = 0.0,
-                 rng: random.Random | None = None):
+                 rng: random.Random | None = None, time_source=None):
+        # injected (QuicHost forwards the node clock) so RTO aging,
+        # idle timeouts and keepalives follow virtual/skewed time in
+        # sim and chaos scenarios; deltas only (SC001 clock discipline)
+        self._now = time_source or time.monotonic
         self.on_accept = on_accept        # async callback(reader, writer)
         self.transport: asyncio.DatagramTransport | None = None
         self.address: tuple[str, int] | None = None
@@ -466,7 +470,8 @@ class QuicHost(_HostBase):
         super().__init__(*args, **kw)
         self._endpoint = QuicEndpoint(
             on_accept=self._accept, loss_rate=quic_loss_rate,
-            rng=random.Random(int.from_bytes(self.node_id[:4], "big")))
+            rng=random.Random(int.from_bytes(self.node_id[:4], "big")),
+            time_source=self._now)
 
     async def _listen(self, host: str, port: int) -> tuple[str, int]:
         return await self._endpoint.listen(host, port)

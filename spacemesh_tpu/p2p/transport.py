@@ -121,7 +121,9 @@ class _Conn:
         self.gossip_queue.put_nowait(None)
         try:
             self.writer.close()
-        except Exception:  # noqa: BLE001
+        except Exception:  # noqa: BLE001 — best-effort teardown of an
+            # already-dying socket; the connection is closed either way
+            # and the caller's drop path owns the accounting
             pass
 
 
@@ -144,10 +146,16 @@ class Host:
                  min_peers: int = 3, max_peers: int = 32,
                  reject_limit: int = 16, ban_seconds: float = 60.0,
                  request_timeout: float = 10.0,
-                 gossip_degree: int = 6, gossip_heartbeat: float = 1.0):
+                 gossip_degree: int = 6, gossip_heartbeat: float = 1.0,
+                 time_source=None):
         from ..core.signing import EdVerifier
         from .gossipmesh import GossipMesh
 
+        # injected by App so ban windows / dial pacing / heartbeats run
+        # on the node's clock (virtual under the sim engine, skewable by
+        # chaos timeskew); only deltas are taken, so wall vs monotonic
+        # vs virtual origins all work (SC001 clock discipline)
+        self._now = time_source or time.monotonic
         self.signer = signer
         self.node_id = signer.node_id
         self.verifier = EdVerifier(prefix=signer.prefix)
@@ -255,7 +263,7 @@ class Host:
         while not self._stopping:
             try:
                 if len(self._conns) < self.min_peers:
-                    now = time.monotonic()
+                    now = self._now()
                     for addr, last in list(self._known.items()):
                         if addr == self.address:
                             continue
@@ -266,7 +274,7 @@ class Host:
                             continue
                         self._known[addr] = now
                         asyncio.ensure_future(self._dial(addr))
-                now = time.monotonic()
+                now = self._now()
                 if now - last_heartbeat >= self.gossip_heartbeat:
                     last_heartbeat = now
                     await self._gossip_heartbeat()
@@ -396,7 +404,7 @@ class Host:
             raise HandshakeError("identity signature invalid")
         if peer_id == self.node_id:
             raise HandshakeError("self-dial")
-        if self._banned.get(peer_id, 0) > time.monotonic():
+        if self._banned.get(peer_id, 0) > self._now():
             raise HandshakeError("peer banned")
         if peer_id in self._blocked_ids:
             raise HandshakeError("peer blocked (chaos)")
@@ -446,7 +454,7 @@ class Host:
             del self._conns[conn.node_id]
             self.gossip.drop_peer(conn.node_id)
         if ban:
-            self._banned[conn.node_id] = time.monotonic() + self.ban_seconds
+            self._banned[conn.node_id] = self._now() + self.ban_seconds
         # let the conn's own loops finish, then reap them (peer churn must
         # not accumulate tasks/queues forever)
         for task in conn.tasks:

@@ -110,7 +110,9 @@ class ScenarioEngine:
     # --- recording ------------------------------------------------------
 
     def _now(self) -> float:
-        return self.loop.time()
+        # the engine OWNS this loop and it is always a VirtualClockLoop:
+        # its clock IS the scenario's virtual time source
+        return self.loop.time()  # spacecheck: ok=SC001 engine-owned VirtualClockLoop
 
     def record(self, line: str, digest: bool = True) -> None:
         self.events.append((round(self._now(), 6), line))
@@ -196,6 +198,7 @@ class ScenarioEngine:
         for fn in self.fulls:
             await fn.prepare()
 
+        # spacecheck: ok=SC001 genesis anchors to the engine's own virtual clock
         genesis = self.loop.time() + 1.0
         self.clock = clock_mod.LayerClock(genesis, self.layer_sec,
                                           time_source=self.loop.time)
@@ -331,6 +334,7 @@ class ScenarioEngine:
                 continue
             tx = sdk.spawn_wallet(fn.signer)
             await fn.pubsub.publish(TOPIC_TX, tx.raw)
+            # spacecheck: ok=SC001 virtual pacing: 0.1 VIRTUAL seconds between publishes, zero wall cost
             await asyncio.sleep(0.1)
 
     def _start_adversary(self, spec: dict) -> str:
@@ -414,6 +418,7 @@ class ScenarioEngine:
                     await fn.app.syncer.synchronize()
                 except Exception:  # noqa: BLE001 — next pass retries
                     pass
+            # spacecheck: ok=SC001 condition-wait poll cadence in VIRTUAL seconds (the predicate, not the sleep, terminates the wait)
             await asyncio.sleep(0.5)
 
     # --- assertions -----------------------------------------------------

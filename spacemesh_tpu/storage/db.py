@@ -63,10 +63,15 @@ class Database:
             self._conn.execute("PRAGMA journal_mode=WAL")
         self._conn.execute("PRAGMA foreign_keys=ON")
         from ..utils import metrics as _metrics
+        # per-role instrument names ("state"/"local"): a bounded set the
+        # registry get-or-creates with IDENTICAL buckets on every
+        # construction (bucket drift raises since PR 7)
+        # spacecheck: ok=SC005 bounded per-db-role names, identical buckets on re-create
         self._latency = _metrics.REGISTRY.histogram(
             f"sql_{name}_query_seconds",
             f"{name} db query latency",
             buckets=(0.0005, 0.005, 0.05, 0.5, 5.0, float("inf")))
+        # spacecheck: ok=SC005 bounded per-db-role names, get-or-create by design
         self._queries = _metrics.REGISTRY.counter(
             f"sql_{name}_queries", f"{name} db queries executed")
         self._readers: queue.SimpleQueue | None = None

@@ -1,0 +1,43 @@
+"""spacecheck: project-specific static analysis for recurring defects.
+
+Eight PRs of review fixes in CHANGES.md form a near-periodic catalog of
+the same defect families — event-loop-blocking calls in async code,
+donated-buffer reuse after a failed dispatch, wall-clock reads in
+virtual-time-aware modules, register/unregister pairing bugs, metrics
+misregistration, and swallowed errors in consensus-critical paths.
+Hand review re-finds them one at a time; this package encodes each as a
+machine-checked AST rule, run over the tree by CI as a blocking job
+(``python -m spacemesh_tpu.tools.spacecheck``).
+
+Rules (each docstring cites the shipped review fix it generalizes):
+
+==========  ===========================================================
+SC001       clock discipline: no wall-clock reads or literal sleeps in
+            virtual-time-aware modules (rules/sc001_clock.py)
+SC002       no blocking calls lexically inside ``async def``
+            (rules/sc002_async_blocking.py)
+SC003       no reads of a donated buffer after the donating jit call
+            (rules/sc003_donation.py)
+SC004       register/unregister, span enter/exit, collector and
+            executor/fd lifecycles pair on all paths
+            (rules/sc004_pairing.py)
+SC005       metrics hygiene: module-scope creation, unique names,
+            literal label names, bounded label values
+            (rules/sc005_metrics.py)
+SC006       no bare/swallowing excepts in consensus-critical packages
+            (rules/sc006_excepts.py)
+==========  ===========================================================
+
+Suppression is explicit and justified, never silent: a line pragma
+(``# spacecheck: ok=SC001 <why>``), a module pragma for SC001
+(``# spacecheck: wall-clock-ok <why>``), or a checked-in baseline entry
+carrying a per-finding justification (``spacecheck_baseline.json``;
+stale entries fail CI — see baseline.py and docs/STATIC_ANALYSIS.md).
+
+The runtime-sanitizer complement — what AST cannot see — lives in
+``spacemesh_tpu/utils/sanitize.py`` (``SPACEMESH_SANITIZE=1``).
+"""
+
+from .engine import Finding, run_paths  # noqa: F401
+
+__all__ = ["Finding", "run_paths"]

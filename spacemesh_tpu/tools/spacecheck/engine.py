@@ -1,0 +1,302 @@
+"""spacecheck engine: file walking, pragmas, fingerprints, rule driving.
+
+The engine parses every target file once, runs a project-wide pre-pass
+(cross-file facts some rules need, e.g. which module-level names are
+metrics instruments), then hands each file to every selected rule.
+Findings carry a **fingerprint** that is stable across unrelated edits —
+hash of (rule, path, normalized offending line, occurrence index), not
+the line number — so the checked-in baseline survives code motion above
+a grandfathered finding.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import hashlib
+import io
+import os
+import re
+import tokenize
+
+RULE_IDS = ("SC001", "SC002", "SC003", "SC004", "SC005", "SC006")
+
+# paths (relative, forward-slash) matched against these prefixes are
+# skipped entirely
+_SKIP_PARTS = {"__pycache__", ".git", ".claude"}
+
+_PRAGMA_RE = re.compile(r"#\s*spacecheck:\s*(?P<body>.+)")
+_OK_RE = re.compile(r"ok\s*=\s*(?P<rules>SC\d{3}(?:\s*,\s*SC\d{3})*)"
+                    r"(?P<why>.*)", re.IGNORECASE)
+_NOQA_RE = re.compile(r"#\s*noqa[:\s]", re.IGNORECASE)
+
+
+@dataclasses.dataclass
+class Finding:
+    rule: str
+    path: str          # repo-relative, forward slashes
+    line: int          # 1-based
+    col: int
+    message: str
+    snippet: str       # stripped source line
+    fingerprint: str = ""
+
+    def key(self) -> tuple:
+        return (self.path, self.line, self.rule, self.col)
+
+
+class FileContext:
+    """One parsed file plus its pragma map, shared by every rule."""
+
+    def __init__(self, path: str, rel: str, source: str):
+        self.path = path
+        self.rel = rel
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        # lineno -> set of rule ids suppressed on that line
+        self.line_pragmas: dict[int, set[str]] = {}
+        # comment text per line (SC006 accepts justified noqa comments)
+        self.comments: dict[int, str] = {}
+        # module-wide suppressions (e.g. "# spacecheck: wall-clock-ok"
+        # in the file header)
+        self.module_pragmas: set[str] = set()
+        self._scan_comments()
+
+    # --- pragmas --------------------------------------------------------
+
+    def _scan_comments(self) -> None:
+        try:
+            tokens = tokenize.generate_tokens(io.StringIO(self.source).readline)
+            comments = [(t.start[0], t.start[1], t.string)
+                        for t in tokens if t.type == tokenize.COMMENT]
+        except (tokenize.TokenError, SyntaxError,
+                ValueError):  # the ast parse succeeded; keep going
+            comments = []
+        for lineno, col, text in comments:
+            self.comments[lineno] = text
+            m = _PRAGMA_RE.search(text)
+            if not m:
+                continue
+            body = m.group("body").strip()
+            rules: set[str] = set()
+            low = body.lower()
+            if low.startswith("wall-clock-ok"):
+                rules = {"SC001"}
+                why = body[len("wall-clock-ok"):]
+            else:
+                ok = _OK_RE.match(body)
+                if ok:
+                    rules = {r.strip().upper()
+                             for r in ok.group("rules").split(",")}
+                    why = ok.group("why")
+            if not rules:
+                continue
+            # a pragma without a reason is no pragma: suppression must
+            # be justified (same contract the baseline enforces) — the
+            # finding stays visible until the why is written
+            if len(why.strip(" -—:\t")) < 8:
+                continue
+            own_line = self.lines[lineno - 1] if lineno <= len(self.lines) else ""
+            standalone = own_line.lstrip().startswith("#")
+            if standalone and col == 0 and lineno <= 25 \
+                    and low.startswith("wall-clock-ok"):
+                # header pragma: the whole module declares its time source
+                self.module_pragmas |= rules
+                continue
+            self.line_pragmas.setdefault(lineno, set()).update(rules)
+            if standalone:
+                # a pragma on its own line covers the next line too
+                self.line_pragmas.setdefault(lineno + 1, set()).update(rules)
+
+    def suppressed(self, rule: str, lineno: int) -> bool:
+        if rule in self.module_pragmas:
+            return True
+        return rule in self.line_pragmas.get(lineno, set())
+
+    def noqa_comment(self, lineno: int) -> str | None:
+        """The line's comment when it is a justified noqa suppression
+        (``# noqa: XXX — why``): flake8-style suppressions that already
+        carry a human reason double as SC006 pragmas, so the sweep does
+        not demand a second comment saying the same thing."""
+        text = self.comments.get(lineno)
+        if not text or not _NOQA_RE.search(text):
+            return None
+        # justified = prose beyond the code list ("# noqa: BLE001" alone
+        # is not a justification)
+        tail = re.sub(r"#\s*noqa[:\s]*[A-Z0-9, ]*", "", text).strip(" -—:\t")
+        return text if len(tail) >= 8 else None
+
+    # --- findings -------------------------------------------------------
+
+    def finding(self, rule: str, node, message: str) -> Finding:
+        lineno = getattr(node, "lineno", 0) or 0
+        col = getattr(node, "col_offset", 0) or 0
+        snippet = (self.lines[lineno - 1].strip()
+                   if 0 < lineno <= len(self.lines) else "")
+        return Finding(rule=rule, path=self.rel, line=lineno, col=col,
+                       message=message, snippet=snippet)
+
+
+# --- shared AST helpers (imported by the rules) -------------------------
+
+
+def dotted_name(node: ast.AST) -> str | None:
+    """``a.b.c`` for Name/Attribute chains, else None."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def time_module_aliases(tree: ast.Module) -> set[str]:
+    """Local names the stdlib ``time`` module is importable under
+    (``import time``, ``import time as _time``)."""
+    out: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name == "time":
+                    out.add(alias.asname or "time")
+    return out
+
+
+class ProjectInfo:
+    """Cross-file facts collected in one pre-pass over every context."""
+
+    def __init__(self, contexts: list[FileContext]):
+        self.contexts = contexts
+        # rule-private cross-file caches hang off this dict (SC003's
+        # donated-callable map, built lazily on first use)
+        self.cache: dict[str, object] = {}
+        # names (last dotted component) bound to a registry-created
+        # instrument anywhere in the tree: `x = REGISTRY.counter(...)`,
+        # `self._latency = _metrics.REGISTRY.histogram(...)`
+        self.instrument_vars: set[str] = set()
+        # metric name literal -> [(rel, lineno, module_scope)]
+        self.metric_creations: dict[str, list[tuple[str, int, bool]]] = {}
+        for ctx in contexts:
+            self._collect(ctx)
+
+    @staticmethod
+    def _is_registry_create(call: ast.Call) -> bool:
+        if not isinstance(call.func, ast.Attribute):
+            return False
+        if call.func.attr not in ("counter", "gauge", "histogram"):
+            return False
+        recv = dotted_name(call.func.value) or ""
+        last = recv.rsplit(".", 1)[-1].lower()
+        return last in ("registry", "_registry") or last.endswith("registry")
+
+    def _collect(self, ctx: FileContext) -> None:
+        func_depth = 0
+
+        def visit(node: ast.AST) -> None:
+            nonlocal func_depth
+            is_func = isinstance(node, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef, ast.Lambda))
+            if is_func:
+                func_depth += 1
+            if isinstance(node, ast.Call) and self._is_registry_create(node):
+                if node.args and isinstance(node.args[0], ast.Constant) \
+                        and isinstance(node.args[0].value, str):
+                    name = node.args[0].value
+                    self.metric_creations.setdefault(name, []).append(
+                        (ctx.rel, node.lineno, func_depth == 0))
+            if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call) \
+                    and self._is_registry_create(node.value):
+                for tgt in node.targets:
+                    name = dotted_name(tgt)
+                    if name:
+                        self.instrument_vars.add(name.rsplit(".", 1)[-1])
+            for child in ast.iter_child_nodes(node):
+                visit(child)
+            if is_func:
+                func_depth -= 1
+
+        visit(ctx.tree)
+
+
+# --- walking + running --------------------------------------------------
+
+
+def iter_py_files(paths: list[str]) -> list[str]:
+    out: list[str] = []
+    for p in paths:
+        if os.path.isfile(p):
+            if p.endswith(".py"):
+                out.append(p)
+            continue
+        for root, dirs, files in os.walk(p):
+            dirs[:] = sorted(d for d in dirs if d not in _SKIP_PARTS)
+            for f in sorted(files):
+                if f.endswith(".py"):
+                    out.append(os.path.join(root, f))
+    return out
+
+
+def _relpath(path: str, root: str) -> str:
+    rel = os.path.relpath(os.path.abspath(path), root)
+    return rel.replace(os.sep, "/")
+
+
+def fingerprint(rule: str, rel: str, snippet: str) -> str:
+    norm = " ".join(snippet.split())
+    h = hashlib.sha1(f"{rule}|{rel}|{norm}".encode()).hexdigest()
+    return h[:16]
+
+
+def assign_fingerprints(findings: list[Finding]) -> None:
+    """Stable ids: hash of (rule, path, normalized offending line) —
+    deliberately NOT line numbers and NOT an occurrence index. Two
+    textually identical offenses in one file share a fingerprint and
+    the baseline matches them as a MULTISET (baseline.split): adding a
+    second identical violation above a grandfathered one therefore
+    surfaces one new finding, instead of an index shift silently
+    suppressing the new line and re-flagging the old one."""
+    for f in findings:
+        f.fingerprint = fingerprint(f.rule, f.path, f.snippet)
+
+
+def run_paths(paths: list[str], *, project_root: str | None = None,
+              select: set[str] | None = None
+              ) -> tuple[list[Finding], list[str]]:
+    """Analyze ``paths`` (files or directories). Returns (findings,
+    errors); errors are unparseable files — CI treats them as failures
+    too (an unparseable file is unanalyzed, not clean)."""
+    from . import rules as rules_pkg
+
+    root = os.path.abspath(project_root or os.getcwd())
+    contexts: list[FileContext] = []
+    errors: list[str] = []
+    for path in iter_py_files(paths):
+        rel = _relpath(path, root)
+        try:
+            with open(path, encoding="utf-8") as fh:
+                source = fh.read()
+            contexts.append(FileContext(path, rel, source))
+        except (OSError, SyntaxError, ValueError) as e:
+            errors.append(f"{rel}: {type(e).__name__}: {e}")
+    project = ProjectInfo(contexts)
+    findings: list[Finding] = []
+    active = [r for r in rules_pkg.ALL_RULES
+              if select is None or r.RULE in select]
+    for ctx in contexts:
+        for rule in active:
+            try:
+                raw = rule.check(ctx, project)
+            except Exception as e:  # noqa: BLE001 — one rule crashing on
+                # one file must surface as an analyzer error, not take
+                # down the whole run silently
+                errors.append(f"{ctx.rel}: rule {rule.RULE} crashed: "
+                              f"{type(e).__name__}: {e}")
+                continue
+            findings.extend(f for f in raw
+                            if not ctx.suppressed(f.rule, f.line))
+    findings.sort(key=Finding.key)
+    assign_fingerprints(findings)
+    return findings, errors

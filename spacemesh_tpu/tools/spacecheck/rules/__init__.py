@@ -1,0 +1,9 @@
+"""Rule registry: one module per rule, each exporting RULE + check()."""
+
+from . import (sc001_clock, sc002_async_blocking, sc003_donation,
+               sc004_pairing, sc005_metrics, sc006_excepts)
+
+ALL_RULES = (sc001_clock, sc002_async_blocking, sc003_donation,
+             sc004_pairing, sc005_metrics, sc006_excepts)
+
+__all__ = ["ALL_RULES"]

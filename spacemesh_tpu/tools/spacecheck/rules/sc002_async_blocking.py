@@ -1,0 +1,98 @@
+"""SC002 async-blocking: no blocking calls lexically inside ``async def``.
+
+Originating bug: PR 7's flight-dump fix — serializing a 64k-span trace
+ring directly from a ``/readyz`` handler blocked the event loop at
+exactly the moment the node was unhealthy; the fix moved it behind
+``asyncio.to_thread``. The same class (a blocking disk/subprocess/
+device call on the loop) stalls gossip delivery, farm dispatch, and
+every timeout on the node at once, and reviews keep re-finding it.
+
+Flags, in every scanned file: calls that block the calling thread when
+they appear in the *direct* body of an ``async def`` (nested ``def``s
+are excluded — they typically run via ``to_thread``/executors):
+
+* ``time.sleep(...)`` (any import alias of ``time``)
+* ``subprocess.run/call/check_call/check_output/Popen``
+* builtin ``open(...)`` / ``os.open`` / ``os.replace`` / ``os.unlink``
+  (sync file IO — unlinking a large file can take hundreds of ms in
+  the kernel)
+* ``jax.device_get(...)`` and ``<x>.block_until_ready()`` — device
+  syncs that stall the loop for a whole dispatch
+* ``<x>.result()`` with no args on concurrent futures is NOT flagged
+  (too ambiguous); wrap genuinely blocking waits in ``to_thread``
+
+Allowlist a deliberate site (tiny reads at startup, etc.) with
+``# spacecheck: ok=SC002 <why>``.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..engine import FileContext, Finding, ProjectInfo, dotted_name, \
+    time_module_aliases
+
+RULE = "SC002"
+
+_SUBPROCESS = {"run", "call", "check_call", "check_output", "Popen"}
+_OS_SYNC_IO = {"open", "replace", "rename", "fsync", "unlink", "remove"}
+
+
+def check(ctx: FileContext, project: ProjectInfo) -> list[Finding]:
+    time_aliases = time_module_aliases(ctx.tree)
+    findings: list[Finding] = []
+
+    def blocking(node: ast.Call) -> str | None:
+        func = node.func
+        if isinstance(func, ast.Name):
+            if func.id == "open":
+                return "sync file IO (open) on the event loop"
+            return None
+        if not isinstance(func, ast.Attribute):
+            return None
+        recv = dotted_name(func.value)
+        attr = func.attr
+        if attr == "sleep" and recv in time_aliases:
+            return f"{recv}.sleep() blocks the event loop"
+        if recv == "subprocess" and attr in _SUBPROCESS:
+            return (f"subprocess.{attr}() blocks the event loop; use "
+                    "asyncio.create_subprocess_* or to_thread")
+        if recv == "os" and attr in _OS_SYNC_IO:
+            return f"os.{attr}() is sync file IO on the event loop"
+        if recv == "jax" and attr == "device_get":
+            return ("jax.device_get() synchronously waits for the "
+                    "device; fetch via to_thread or async dispatch")
+        if attr == "block_until_ready":
+            return (".block_until_ready() stalls the loop for a whole "
+                    "device dispatch; wrap in to_thread")
+        return None
+
+    def scan_async_body(fn: ast.AsyncFunctionDef) -> None:
+        def visit(node: ast.AST) -> None:
+            if isinstance(node, (ast.FunctionDef, ast.Lambda)):
+                return  # nested sync defs run elsewhere (to_thread etc.)
+            if isinstance(node, ast.AsyncFunctionDef):
+                scan_async_body(node)
+                return
+            if isinstance(node, ast.Call):
+                why = blocking(node)
+                if why is not None:
+                    findings.append(ctx.finding(
+                        RULE, node,
+                        f"blocking call inside async def "
+                        f"{fn.name}(): {why}"))
+            for child in ast.iter_child_nodes(node):
+                visit(child)
+
+        for stmt in fn.body:
+            visit(stmt)
+
+    def walk(node: ast.AST) -> None:
+        if isinstance(node, ast.AsyncFunctionDef):
+            scan_async_body(node)
+            return
+        for child in ast.iter_child_nodes(node):
+            walk(child)
+
+    walk(ctx.tree)
+    return findings

@@ -1,0 +1,252 @@
+"""SC004 pairing: acquire/release lifecycles must pair on all paths.
+
+Originating bugs: PR 7's name-only watchdog eviction (``App.close``
+unregistered health probes by name and evicted a successor node's
+probes — the fix unregisters by equality, and registration/cleanup now
+pair explicitly), and the PR 3 review fix closing the prover's cached
+read fds per session. The shared shape: an acquire with a release that
+is missing, or present but skipped on the exception path.
+
+Checked pairings (package code only — ``tests/`` is exempt, test
+teardown runs through fixtures):
+
+* **health probes** — a function calling ``HEALTH.register(...)``
+  (any receiver whose dotted name ends in ``HEALTH``/``health``) must
+  either unregister in a ``finally`` in the same function, or belong
+  to a class that unregisters in another method (the long-lived
+  component split lifecycle). An unregister that exists in the same
+  function but NOT under ``finally`` flags: the exception path leaks
+  the probe.
+* **manual span brackets** — ``x.__enter__()`` requires
+  ``x.__exit__(...)`` under a ``finally`` in the same function (the
+  autotune race uses exactly this shape; an unguarded exit loses the
+  span AND the contextvar reset on error).
+* **collectors** — ``<registry>.add_collector(...)`` has no remove;
+  calling it anywhere a second construction can reach (i.e. inside a
+  function) re-adds the hook forever. PR 7 keyed idempotence on a
+  registry attribute; such guarded sites carry a pragma.
+* **executors/fds** — a ``ThreadPoolExecutor(...)``/``open(...)``/
+  ``os.open(...)`` result bound to a *local* name must be closed in a
+  ``finally`` or managed by ``with``; escaping the function (returned,
+  stored on an attribute, passed to another call) hands the lifecycle
+  elsewhere and is accepted.
+
+Suppress a deliberate unpaired site with ``# spacecheck: ok=SC004 <why>``.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..engine import FileContext, Finding, ProjectInfo, dotted_name
+
+RULE = "SC004"
+
+_FUNCS = (ast.FunctionDef, ast.AsyncFunctionDef)
+_ACQUIRE_FACTORIES = {"ThreadPoolExecutor", "ProcessPoolExecutor"}
+
+
+def _is_health_recv(recv: str | None) -> bool:
+    if not recv:
+        return False
+    last = recv.rsplit(".", 1)[-1]
+    return last in ("HEALTH", "health") or last.endswith("HEALTH")
+
+
+def _finally_linenos(fn: ast.AST) -> list[tuple[int, int, int]]:
+    """(try lineno, finally-body first lineno, finally-body last lineno)
+    for every try/finally lexically inside ``fn`` (nested defs skipped)."""
+    spans: list[tuple[int, int, int]] = []
+
+    def visit(node: ast.AST) -> None:
+        if isinstance(node, _FUNCS + (ast.Lambda,)) and node is not fn:
+            return
+        if isinstance(node, ast.Try) and node.finalbody:
+            first = node.finalbody[0].lineno
+            last = max(getattr(n, "end_lineno", first) or first
+                       for n in node.finalbody)
+            spans.append((node.lineno, first, last))
+        for child in ast.iter_child_nodes(node):
+            visit(child)
+
+    visit(fn)
+    return spans
+
+
+def _in_finally(spans, lineno: int) -> bool:
+    return any(first <= lineno <= last for _, first, last in spans)
+
+
+def _scoped(fn: ast.AST) -> list[ast.AST]:
+    """Every node lexically in ``fn``'s own scope (nested defs and
+    lambdas excluded — they are analyzed as their own scopes)."""
+    out: list[ast.AST] = []
+
+    def visit(node: ast.AST) -> None:
+        if isinstance(node, _FUNCS + (ast.Lambda,)) and node is not fn:
+            return
+        out.append(node)
+        for child in ast.iter_child_nodes(node):
+            visit(child)
+
+    visit(fn)
+    return out
+
+
+def _calls_in(fn: ast.AST) -> list[ast.Call]:
+    return [n for n in _scoped(fn) if isinstance(n, ast.Call)]
+
+
+def _class_methods(tree: ast.Module) -> dict[int, list[ast.AST]]:
+    """id(method node) -> sibling method list (same class)."""
+    out: dict[int, list[ast.AST]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef):
+            methods = [n for n in node.body if isinstance(n, _FUNCS)]
+            for m in methods:
+                out[id(m)] = methods
+    return out
+
+
+def check(ctx: FileContext, project: ProjectInfo) -> list[Finding]:
+    if not ctx.rel.startswith("spacemesh_tpu/"):
+        return []
+    findings: list[Finding] = []
+    siblings = _class_methods(ctx.tree)
+
+    _CM_DUNDERS = ("__enter__", "__aenter__", "__exit__", "__aexit__")
+
+    def check_function(fn) -> None:
+        spans = _finally_linenos(fn)
+        calls = _calls_in(fn)
+        # a context manager's own dunders acquire/release across the
+        # enter/exit METHOD pair (and __aenter__ delegates to
+        # self.__enter__()): pairing there is the class's protocol
+        # contract, not a per-function leak
+        cm_method = fn.name in _CM_DUNDERS
+        registers: list[ast.Call] = []
+        unregisters: list[ast.Call] = []
+        enters: dict[str, ast.Call] = {}
+        exits: dict[str, list[int]] = {}
+        for call in calls:
+            func = call.func
+            if not isinstance(func, ast.Attribute):
+                continue
+            recv = dotted_name(func.value)
+            if func.attr == "register" and _is_health_recv(recv):
+                registers.append(call)
+            elif func.attr == "unregister" and _is_health_recv(recv):
+                unregisters.append(call)
+            elif func.attr == "__enter__" and recv and not cm_method:
+                enters[recv] = call
+            elif func.attr == "__exit__" and recv:
+                exits.setdefault(recv, []).append(call.lineno)
+            elif func.attr == "add_collector":
+                findings.append(ctx.finding(
+                    RULE, call,
+                    "add_collector() inside a function: collectors have "
+                    "no remove, so any re-reachable construction re-adds "
+                    "the hook forever; attach at module scope or guard "
+                    "idempotently and pragma"))
+        for call in registers:
+            if any(_in_finally(spans, u.lineno) for u in unregisters):
+                continue
+            if unregisters:
+                findings.append(ctx.finding(
+                    RULE, call,
+                    "HEALTH.register here but the unregister in this "
+                    "function is not under finally: the exception path "
+                    "leaks the probe"))
+                continue
+            sib = siblings.get(id(fn), [])
+            paired = any(
+                isinstance(c.func, ast.Attribute)
+                and c.func.attr == "unregister"
+                and _is_health_recv(dotted_name(c.func.value))
+                for m in sib for c in _calls_in(m) if m is not fn)
+            if not paired:
+                findings.append(ctx.finding(
+                    RULE, call,
+                    "HEALTH.register without any unregister in this "
+                    "function or its class: a finished component pins "
+                    "its probe (and its component_healthy series) "
+                    "forever"))
+        for recv, call in enters.items():
+            ok = any(_in_finally(spans, ln) and ln > call.lineno
+                     for ln in exits.get(recv, []))
+            if not ok:
+                findings.append(ctx.finding(
+                    RULE, call,
+                    f"{recv}.__enter__() without a matching "
+                    f"{recv}.__exit__() under finally: the error path "
+                    "leaks the span/context"))
+        _check_local_resources(fn, spans)
+
+    def _check_local_resources(fn, spans) -> None:
+        assigned: dict[str, ast.Assign] = {}  # local name -> acquire stmt
+
+        def acquire_kind(call: ast.Call) -> str | None:
+            func = call.func
+            if isinstance(func, ast.Name) and func.id == "open":
+                return "open()"
+            name = dotted_name(func)
+            if name is None:
+                return None
+            last = name.rsplit(".", 1)[-1]
+            if last in _ACQUIRE_FACTORIES:
+                return f"{last}()"
+            if name == "os.open":
+                return "os.open()"
+            return None
+
+        nodes = _scoped(fn)
+        for node in nodes:
+            if isinstance(node, ast.Assign) and isinstance(node.value,
+                                                           ast.Call):
+                kind = acquire_kind(node.value)
+                if kind and len(node.targets) == 1 \
+                        and isinstance(node.targets[0], ast.Name):
+                    assigned[node.targets[0].id] = (node, kind)
+        if not assigned:
+            return
+        closed_in_finally: set[str] = set()
+        escapes: set[str] = set()
+        for node in nodes:
+            if isinstance(node, ast.Call):
+                f = node.func
+                if isinstance(f, ast.Attribute) \
+                        and f.attr in ("close", "shutdown") \
+                        and isinstance(f.value, ast.Name) \
+                        and f.value.id in assigned \
+                        and _in_finally(spans, node.lineno):
+                    closed_in_finally.add(f.value.id)
+                else:
+                    for arg in list(node.args) + [k.value
+                                                  for k in node.keywords]:
+                        if isinstance(arg, ast.Name) and arg.id in assigned:
+                            escapes.add(arg.id)
+            elif isinstance(node, ast.Return) and isinstance(node.value,
+                                                             ast.Name):
+                if node.value.id in assigned:
+                    escapes.add(node.value.id)
+            elif isinstance(node, ast.Assign) \
+                    and isinstance(node.value, ast.Name) \
+                    and node.value.id in assigned:
+                escapes.add(node.value.id)  # handed to another binding
+            elif isinstance(node, ast.withitem):
+                name = dotted_name(node.context_expr)
+                if name in assigned:
+                    escapes.add(name)  # managed by with
+        for name, (stmt, kind) in assigned.items():
+            if name in closed_in_finally or name in escapes:
+                continue
+            findings.append(ctx.finding(
+                RULE, stmt,
+                f"{kind} bound to local {name!r} is never closed under "
+                "finally and never escapes this function: the error "
+                "path leaks the handle; use `with` or try/finally"))
+
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, _FUNCS):
+            check_function(node)
+    return findings

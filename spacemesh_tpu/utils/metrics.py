@@ -144,6 +144,11 @@ class Registry:
         self._instruments: dict[str, _Instrument] = {}
         self._collectors: list = []
         self._lock = threading.Lock()
+        # the owning thread: instrument CREATION belongs at module
+        # import on this thread; recording is thread-safe from anywhere.
+        # The runtime sanitizer (utils/sanitize.py, SPACEMESH_SANITIZE)
+        # asserts this affinity on the create branch of _get.
+        self._created_thread = threading.get_ident()
 
     def counter(self, name: str, help_: str = "") -> Counter:
         return self._get(name, lambda: Counter(name, help_), Counter)
@@ -171,6 +176,9 @@ class Registry:
         with self._lock:
             inst = self._instruments.get(name)
             if inst is None:
+                from . import sanitize
+
+                sanitize.on_instrument_create(name, self)
                 inst = self._instruments[name] = factory()
             elif not isinstance(inst, cls):
                 raise TypeError(f"{name} already registered as "
@@ -427,3 +435,11 @@ component_stalls = REGISTRY.counter(
 # flight recorder (obs/flight.py)
 flight_bundles = REGISTRY.counter(
     "flight_bundles_total", "diagnostic bundles written (label: trigger)")
+
+# runtime sanitizers (utils/sanitize.py, SPACEMESH_SANITIZE=1): each
+# recorded violation — a slow event-loop callback, an off-thread
+# instrument creation, an off-bucket jit dispatch — counts here so a
+# sanitized soak run surfaces its findings on /metrics too
+sanitize_violations = REGISTRY.counter(
+    "sanitize_violations_total",
+    "runtime sanitizer violations (label: kind)")

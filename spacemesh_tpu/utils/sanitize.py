@@ -1,0 +1,233 @@
+"""Runtime sanitizers: what the static pass (tools/spacecheck) can't see.
+
+``SPACEMESH_SANITIZE=1`` arms three cheap, always-compiled-in checks
+that catch the *dynamic* halves of the recurring defect classes:
+
+1. **Slow-callback detection** (the SC002 complement): every asyncio
+   callback/task step is timed; one that holds the loop longer than
+   the threshold (``SPACEMESH_SANITIZE_SLOW_MS``, default 250) records
+   a violation attributed to the tracing span that was current *inside*
+   the callback's context — so the report says "farm.batch blocked the
+   loop for 800ms", not just "something was slow". PR 7's flight-dump
+   fix (trace-ring serialization on the loop at the exact moment the
+   node was unhealthy) is the originating bug. Violations are recorded
+   and counted (``sanitize_violations_total``), never raised — raising
+   inside ``Handle._run`` would take down an unrelated task.
+
+2. **Registry thread-affinity** (the SC005 complement): metrics
+   instruments must be created on the thread that built their Registry
+   (module import, in practice). A worker thread minting an instrument
+   mid-run is exactly how PR 7's silent wrong-bucket histogram
+   happened — two creation sites racing get-or-create with different
+   layouts. Creation off-thread raises :class:`SanitizeError`.
+
+3. **Compile-explosion guard** (the PR 6 compile-cost contract,
+   enforced instead of hoped): the fused label pipelines may only be
+   dispatched at power-of-two lane buckets — the grid the autotuner
+   races and ``tools/warmcache.py`` pre-compiles. An off-bucket shape
+   means some caller bypassed the pad-and-trim wrappers and is about
+   to pay a 17–26s XLA compile per ragged size; the guard raises
+   :class:`SanitizeError` at the dispatch boundary with the offending
+   lane count.
+
+The hooks live at three choke points (``asyncio.events.Handle._run``,
+``metrics.Registry._get``'s create branch, ``ops/scrypt.py`` dispatch)
+and cost one flag check each when the sanitizer is off.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+import time
+
+from . import logging as slog
+from . import tracing
+
+_log = slog.get("sanitize")
+
+ENV = "SPACEMESH_SANITIZE"
+ENV_SLOW_MS = "SPACEMESH_SANITIZE_SLOW_MS"
+
+_OFF = ("", "0", "off", "false", "none")
+
+DEFAULT_SLOW_S = 0.25
+MAX_VIOLATIONS = 256
+
+
+class SanitizeError(RuntimeError):
+    """A sanitizer contract was violated (raising kinds only)."""
+
+
+@dataclasses.dataclass
+class Violation:
+    kind: str              # "slow-callback" | "registry-thread" | "jit-shape"
+    detail: str
+    span: int | None       # tracing span id current at the violation
+    seconds: float | None = None
+
+
+_enabled = False
+_slow_threshold_s = DEFAULT_SLOW_S
+_violations: list[Violation] = []
+_lock = threading.Lock()
+_handle_patched = False
+_orig_handle_run = None
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def violations() -> list[Violation]:
+    with _lock:
+        return list(_violations)
+
+
+def clear_violations() -> None:
+    with _lock:
+        _violations.clear()
+
+
+def _record(kind: str, detail: str, *, span: int | None = None,
+            seconds: float | None = None) -> Violation:
+    v = Violation(kind, detail, span, seconds)
+    with _lock:
+        if len(_violations) < MAX_VIOLATIONS:
+            _violations.append(v)
+    try:
+        from . import metrics
+
+        metrics.sanitize_violations.inc(kind=kind)
+    except Exception:  # noqa: BLE001 — the sanitizer must never take
+        pass           # down the code it watches
+    _log.warning("sanitize[%s]: %s%s%s", kind, detail,
+                 f" ({seconds * 1000:.0f}ms)" if seconds is not None else "",
+                 f" [span {span}]" if span is not None else "")
+    return v
+
+
+# --- 1. slow asyncio callbacks ------------------------------------------
+
+
+def _patch_handle() -> None:
+    """Wrap ``asyncio.events.Handle._run`` once per process; the wrapper
+    is a single flag check when the sanitizer is disabled."""
+    global _handle_patched, _orig_handle_run
+    if _handle_patched:
+        return
+    import asyncio.events as aev
+
+    _orig_handle_run = aev.Handle._run
+
+    def _run(self):  # noqa: ANN001 — signature fixed by asyncio
+        if not _enabled:
+            return _orig_handle_run(self)
+        t0 = time.perf_counter()
+        try:
+            return _orig_handle_run(self)
+        finally:
+            dt = time.perf_counter() - t0
+            if dt >= _slow_threshold_s:
+                # the span current INSIDE the callback's context — the
+                # contextvars Context the loop ran it under — names the
+                # work that held the loop
+                span = None
+                ctx = getattr(self, "_context", None)
+                if ctx is not None:
+                    try:
+                        span = ctx.get(tracing._current)
+                    except Exception:  # noqa: BLE001
+                        span = None
+                try:
+                    what = repr(getattr(self, "_callback", self))
+                except Exception:  # noqa: BLE001
+                    what = "<unprintable callback>"
+                _record("slow-callback",
+                        f"event-loop callback held the loop for "
+                        f"{dt * 1000:.0f}ms (threshold "
+                        f"{_slow_threshold_s * 1000:.0f}ms): {what:.200}",
+                        span=span, seconds=dt)
+
+    aev.Handle._run = _run
+    _handle_patched = True
+
+
+# --- 2. registry thread-affinity ----------------------------------------
+
+
+def on_instrument_create(name: str, registry) -> None:
+    """Called from ``metrics.Registry._get`` when a NEW instrument is
+    about to be created. Raises off the registry's owning thread."""
+    if not _enabled:
+        return
+    owner = getattr(registry, "_created_thread", None)
+    if owner is None or owner == threading.get_ident():
+        return
+    _record("registry-thread",
+            f"instrument {name!r} created on thread "
+            f"{threading.current_thread().name!r}, but its registry "
+            "belongs to another thread: create instruments at module "
+            "import, record from anywhere",
+            span=tracing.current_id())
+    raise SanitizeError(
+        f"metrics instrument {name!r} created off the registry's owning "
+        "thread (SPACEMESH_SANITIZE)")
+
+
+# --- 3. compile-explosion guard -----------------------------------------
+
+
+def on_jit_shape(fn_name: str, lanes: int) -> None:
+    """Called at the fused-label dispatch boundary with the lane count
+    entering the jit. Off-bucket (non-power-of-two) shapes raise: they
+    bypass the warmed executable population and mint a fresh compile."""
+    if not _enabled:
+        return
+    try:
+        lanes = int(lanes)
+    except (TypeError, ValueError):
+        return  # symbolic/traced dim: not a host dispatch
+    if lanes >= 1 and lanes & (lanes - 1) == 0:
+        return
+    _record("jit-shape",
+            f"{fn_name} dispatched {lanes} lanes — outside the "
+            "power-of-two bucket grid the autotuner warms; some caller "
+            "bypassed the pad-and-trim wrappers (shape_bucket)",
+            span=tracing.current_id())
+    raise SanitizeError(
+        f"{fn_name}: off-bucket jit shape {lanes} (SPACEMESH_SANITIZE; "
+        "see docs/STATIC_ANALYSIS.md)")
+
+
+# --- lifecycle ----------------------------------------------------------
+
+
+def enable(slow_threshold_s: float | None = None) -> None:
+    global _enabled, _slow_threshold_s
+    if slow_threshold_s is not None:
+        _slow_threshold_s = float(slow_threshold_s)
+    _patch_handle()
+    _enabled = True
+
+
+def disable() -> None:
+    """Disarm (the Handle patch stays installed but inert)."""
+    global _enabled
+    _enabled = False
+
+
+def _boot() -> None:
+    raw = (os.environ.get(ENV) or "").strip().lower()
+    if raw in _OFF:
+        return
+    ms = os.environ.get(ENV_SLOW_MS)
+    try:
+        threshold = float(ms) / 1000.0 if ms else None
+    except ValueError:
+        threshold = None
+    enable(threshold)
+
+
+_boot()

@@ -33,6 +33,12 @@ os.environ.pop("SPACEMESH_TRACE", None)
 # logging.configure(json_lines=True) themselves — tests/test_health_engine.py)
 os.environ.pop("SPACEMESH_LOG_JSON", None)
 
+# the runtime sanitizers (utils/sanitize.py) must not arm for the whole
+# suite from a developer/CI shell: several tests dispatch deliberately
+# odd shapes with bucketing disabled (tests that want the sanitizer call
+# sanitize.enable() themselves — tests/test_spacecheck.py)
+os.environ.pop("SPACEMESH_SANITIZE", None)
+
 # the ROMix autotuner (ops/autotune.py) must stay deterministic and cheap
 # under test: no implicit candidate races, and never persist winners into
 # the developer's real cache root. The autotune tests opt back in with

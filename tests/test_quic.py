@@ -75,12 +75,22 @@ def test_syn_flood_is_admission_controlled():
         await server.listen("127.0.0.1", 0)
         flood = QuicEndpoint()
         await flood.listen("127.0.0.1", 0)
-        # raw SYNs with random client ids, never followed by DATA
-        for _ in range(3 * q.MAX_HALF_OPEN):
-            pkt = q.HEADER.pack(q.MAGIC, q.SYN, bytes(8), 0, 0) \
-                + os.urandom(8)
-            flood.transport.sendto(pkt, server.address)
-        await asyncio.sleep(0.2)
+        # raw SYNs with random client ids, never followed by DATA.
+        # Paced waves with yields (not one burst): a tight sendto loop
+        # can overflow the receiver's UDP socket buffer under suite
+        # load, and kernel-dropped SYNs never reach admission control —
+        # the refusal this test asserts then simply doesn't happen
+        # (flaked once in the PR-9 tier-1 run with rx=49/96). Condition
+        # wait, bounded waves: stop as soon as a refusal is observed.
+        for _ in range(6):
+            for _ in range(q.MAX_HALF_OPEN):
+                pkt = q.HEADER.pack(q.MAGIC, q.SYN, bytes(8), 0, 0) \
+                    + os.urandom(8)
+                flood.transport.sendto(pkt, server.address)
+                await asyncio.sleep(0)  # let the receiver drain
+            await asyncio.sleep(0.05)
+            if server.stats.get("syn_refused", 0) > 0:
+                break
         assert len(server._by_id) <= q.MAX_HALF_OPEN
         assert server.stats.get("syn_refused", 0) > 0
         # free admission slots arrive as half-open conns idle out; a

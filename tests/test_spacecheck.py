@@ -1,0 +1,834 @@
+"""spacecheck static analyzer + runtime sanitizers (ISSUE 9).
+
+Every rule gets a minimal offending fixture and a fixed/pragma'd twin;
+the CLI/baseline workflow is exercised end to end (seeded violation ->
+nonzero exit; stale or unjustified baseline -> nonzero exit); the
+sanitizers catch an injected event-loop block and an off-bucket
+compile, and stay silent on the clean paths.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from spacemesh_tpu.tools.spacecheck import baseline as baseline_mod
+from spacemesh_tpu.tools.spacecheck import engine
+from spacemesh_tpu.tools.spacecheck.__main__ import main as cli_main
+from spacemesh_tpu.utils import sanitize
+
+
+def run_fixture(tmp_path, rel, source, select=None):
+    """Write ``source`` at ``rel`` under a scratch project root and
+    analyze it. Returns the findings list."""
+    path = tmp_path / rel
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(textwrap.dedent(source))
+    findings, errors = engine.run_paths(
+        [str(path)], project_root=str(tmp_path),
+        select={select} if select else None)
+    assert not errors, errors
+    return findings
+
+
+def rules_of(findings):
+    return sorted({f.rule for f in findings})
+
+
+# --- SC001 clock discipline ---------------------------------------------
+
+
+SC001_BAD = """
+    import time
+    import asyncio
+
+    def deadline():
+        return time.time() + 5.0
+
+    def backoff(loop):
+        return loop.time()
+
+    async def wait():
+        await asyncio.sleep(1.5)
+"""
+
+
+def test_sc001_flags_wall_clock_in_scope(tmp_path):
+    fs = run_fixture(tmp_path, "spacemesh_tpu/sim/bad_clock.py", SC001_BAD)
+    msgs = [f.message for f in fs if f.rule == "SC001"]
+    assert len(msgs) == 3
+    assert any("time.time()" in m for m in msgs)
+    assert any("loop" in m for m in msgs)
+    assert any("asyncio.sleep(1.5)" in m for m in msgs)
+
+
+def test_sc001_out_of_scope_module_is_clean(tmp_path):
+    fs = run_fixture(tmp_path, "spacemesh_tpu/ops/bad_clock.py", SC001_BAD)
+    assert not [f for f in fs if f.rule == "SC001"]
+
+
+def test_sc001_injected_time_source_is_clean(tmp_path):
+    fs = run_fixture(tmp_path, "spacemesh_tpu/sim/good_clock.py", """
+        import time
+
+        def deadline(now=None):
+            return (time.time() if now is None else now) + 5.0
+
+        class Thing:
+            def __init__(self, time_source=time.monotonic):
+                self._now = time_source
+
+            def until(self):
+                return self._now() + 1.0
+    """)
+    assert not [f for f in fs if f.rule == "SC001"]
+
+
+def test_sc001_line_and_module_pragmas(tmp_path):
+    fs = run_fixture(tmp_path, "spacemesh_tpu/obs/line_pragma.py", """
+        import time
+
+        def stamp():
+            return time.time()  # spacecheck: ok=SC001 display only
+    """)
+    assert not [f for f in fs if f.rule == "SC001"]
+    fs = run_fixture(tmp_path, "spacemesh_tpu/obs/module_pragma.py", """
+        # spacecheck: wall-clock-ok — operator tool, real wall time wanted
+        import time
+
+        def stamp():
+            return time.time()
+
+        def stamp2():
+            return time.monotonic()
+    """)
+    assert not [f for f in fs if f.rule == "SC001"]
+
+
+def test_sc001_sleep_zero_yield_is_clean(tmp_path):
+    fs = run_fixture(tmp_path, "spacemesh_tpu/sim/yield_ok.py", """
+        import asyncio
+
+        async def cooperate():
+            await asyncio.sleep(0)
+    """)
+    assert not [f for f in fs if f.rule == "SC001"]
+
+
+# --- SC002 async-blocking -----------------------------------------------
+
+
+def test_sc002_flags_blocking_in_async(tmp_path):
+    fs = run_fixture(tmp_path, "spacemesh_tpu/api/busy.py", """
+        import subprocess
+        import time
+
+        async def handler():
+            time.sleep(0.1)
+            with open("/tmp/x") as f:
+                f.read()
+            subprocess.run(["true"])
+            out.block_until_ready()
+    """, select="SC002")
+    assert len(fs) == 4
+    assert all(f.rule == "SC002" for f in fs)
+
+
+def test_sc002_clean_patterns(tmp_path):
+    fs = run_fixture(tmp_path, "spacemesh_tpu/api/tidy.py", """
+        import asyncio
+        import time
+
+        def sync_helper():
+            time.sleep(0.1)       # not in async def
+            with open("/x") as f:
+                return f.read()
+
+        async def handler():
+            # blocking work routed off the loop; bare references to
+            # blocking callables are fine
+            data = await asyncio.to_thread(sync_helper)
+            await asyncio.to_thread(time.sleep, 0.1)
+
+            def nested():
+                time.sleep(0.5)   # nested sync def runs via executor
+
+            return data
+    """, select="SC002")
+    assert not fs
+
+
+def test_sc002_pragma(tmp_path):
+    fs = run_fixture(tmp_path, "spacemesh_tpu/api/startup.py", """
+        async def boot():
+            # spacecheck: ok=SC002 one tiny config read at startup, before serving
+            with open("/etc/cfg") as f:
+                return f.read()
+    """, select="SC002")
+    assert not fs
+
+
+# --- SC003 donation safety ----------------------------------------------
+
+
+SC003_BAD = """
+    import functools
+    import jax
+
+    step = jax.jit(_step_impl, donate_argnums=(0,))
+
+    def run(carry, x):
+        out = step(carry, x)
+        return out, carry.sum()   # read after donation
+"""
+
+
+def test_sc003_flags_read_after_donation(tmp_path):
+    fs = run_fixture(tmp_path, "spacemesh_tpu/ops/bad_donate.py",
+                     SC003_BAD, select="SC003")
+    assert len(fs) == 1
+    assert "donated to step()" in fs[0].message
+
+
+def test_sc003_rebind_and_copy_are_clean(tmp_path):
+    fs = run_fixture(tmp_path, "spacemesh_tpu/ops/good_donate.py", """
+        import jax
+        import jax.numpy as jnp
+
+        step = jax.jit(_step_impl, donate_argnums=(0,))
+
+        def rotate(carry, xs):
+            for x in xs:
+                carry = step(carry, x)   # rebind clears the mark
+            return carry
+
+        def retry(carry, x):
+            backup = jnp.asarray(carry) + 0   # copy BEFORE donating
+            out = step(carry, x)
+            return out, backup.sum()
+    """, select="SC003")
+    assert not fs
+
+
+def test_sc003_decorated_and_cross_module(tmp_path):
+    (tmp_path / "spacemesh_tpu/ops").mkdir(parents=True)
+    (tmp_path / "spacemesh_tpu/ops/kern.py").write_text(textwrap.dedent("""
+        import functools
+        import jax
+
+        @functools.partial(jax.jit, donate_argnums=(1,))
+        def fold(x, carry):
+            return x + carry
+    """))
+    (tmp_path / "spacemesh_tpu/ops/user.py").write_text(textwrap.dedent("""
+        from . import kern
+
+        def use(x, carry):
+            out = kern.fold(x, carry)
+            return out, carry[0]    # cross-module read-after-donate
+    """))
+    findings, errors = engine.run_paths(
+        [str(tmp_path / "spacemesh_tpu")], project_root=str(tmp_path),
+        select={"SC003"})
+    assert not errors
+    assert len(findings) == 1 and "fold()" in findings[0].message
+
+
+def test_sc003_augassign_reads(tmp_path):
+    fs = run_fixture(tmp_path, "spacemesh_tpu/ops/aug_donate.py", """
+        import jax
+
+        step = jax.jit(_impl, donate_argnums=(0,))
+
+        def bad(carry, x):
+            step(carry, x)
+            carry += 1            # read half of += touches the buffer
+            return carry
+    """, select="SC003")
+    assert len(fs) == 1 and "aug-assigned" in fs[0].message
+
+
+# --- SC004 pairing ------------------------------------------------------
+
+
+def test_sc004_register_without_unregister(tmp_path):
+    fs = run_fixture(tmp_path, "spacemesh_tpu/post/bad_probe.py", """
+        from ..obs.health import HEALTH
+
+        def run(wd):
+            HEALTH.register("post.init", wd.check)
+            do_work()
+    """, select="SC004")
+    assert len(fs) == 1 and "register" in fs[0].message
+
+
+def test_sc004_unregister_in_finally_and_class_split(tmp_path):
+    fs = run_fixture(tmp_path, "spacemesh_tpu/post/good_probe.py", """
+        from ..obs.health import HEALTH
+
+        def run(wd):
+            HEALTH.register("post.init", wd.check)
+            try:
+                do_work()
+            finally:
+                HEALTH.unregister("post.init", wd.check)
+
+        class Component:
+            def start(self):
+                HEALTH.register("comp", self._probe)
+
+            def close(self):
+                HEALTH.unregister("comp", self._probe)
+    """, select="SC004")
+    assert not fs
+
+
+def test_sc004_unregister_off_finally_flags(tmp_path):
+    fs = run_fixture(tmp_path, "spacemesh_tpu/post/leaky_probe.py", """
+        from ..obs.health import HEALTH
+
+        def run(wd):
+            HEALTH.register("post.init", wd.check)
+            do_work()   # raises -> unregister skipped
+            HEALTH.unregister("post.init", wd.check)
+    """, select="SC004")
+    assert len(fs) == 1 and "not under finally" in fs[0].message
+
+
+def test_sc004_manual_span_brackets(tmp_path):
+    fs = run_fixture(tmp_path, "spacemesh_tpu/ops/spans.py", """
+        def bad(tracing):
+            sp = tracing.span("x")
+            sp.__enter__()
+            work()
+            sp.__exit__(None, None, None)   # skipped if work() raises
+
+        def good(tracing):
+            sp = tracing.span("x")
+            sp.__enter__()
+            try:
+                work()
+            finally:
+                sp.__exit__(None, None, None)
+    """, select="SC004")
+    assert len(fs) == 1 and fs[0].snippet == 'sp.__enter__()'
+
+
+def test_sc004_local_fd_and_executor(tmp_path):
+    fs = run_fixture(tmp_path, "spacemesh_tpu/tools/handles.py", """
+        from concurrent.futures import ThreadPoolExecutor
+
+        def bad():
+            f = open("/tmp/x")
+            data = f.read()
+            f.close()            # skipped on a raising read
+            return data
+
+        def bad2():
+            pool = ThreadPoolExecutor(2)
+            pool.submit(print)
+
+        def good():
+            with open("/tmp/x") as f:
+                return f.read()
+
+        def good_finally():
+            f = open("/tmp/x")
+            try:
+                return f.read()
+            finally:
+                f.close()
+
+        def good_escape():
+            f = open("/tmp/x")
+            return f             # caller owns the lifecycle
+    """, select="SC004")
+    assert len(fs) == 2
+    assert {f.snippet.split(" =")[0] for f in fs} == {"f", "pool"}
+
+
+# --- SC005 metrics hygiene ----------------------------------------------
+
+
+def test_sc005_creation_in_function_and_fstring_labels(tmp_path):
+    fs = run_fixture(tmp_path, "spacemesh_tpu/obs/bad_metrics.py", """
+        from ..utils.metrics import REGISTRY
+
+        hits = REGISTRY.counter("hits_total", "ok at module scope")
+
+        def lazy(name):
+            c = REGISTRY.counter("late_total", "created mid-run")
+            return c
+
+        def record(peer):
+            hits.inc(peer=f"{peer}")         # cardinality bomb
+            hits.inc(**{"peer": "x"})        # non-literal label schema
+    """, select="SC005")
+    msgs = [f.message for f in fs]
+    assert len(fs) == 3
+    assert any("inside a function" in m for m in msgs)
+    assert any("f-string label value" in m for m in msgs)
+    assert any("splat label names" in m for m in msgs)
+
+
+def test_sc005_duplicate_names_across_files(tmp_path):
+    (tmp_path / "spacemesh_tpu/a").mkdir(parents=True)
+    (tmp_path / "spacemesh_tpu/a/m1.py").write_text(
+        'from ..utils.metrics import REGISTRY\n'
+        'x = REGISTRY.counter("dup_total", "first")\n')
+    (tmp_path / "spacemesh_tpu/a/m2.py").write_text(
+        'from ..utils.metrics import REGISTRY\n'
+        'y = REGISTRY.counter("dup_total", "second")\n')
+    findings, errors = engine.run_paths(
+        [str(tmp_path / "spacemesh_tpu")], project_root=str(tmp_path),
+        select={"SC005"})
+    assert not errors
+    assert len(findings) == 1
+    assert "already registered" in findings[0].message
+    assert findings[0].path.endswith("m2.py")
+
+
+def test_sc005_bounded_literal_labels_clean(tmp_path):
+    fs = run_fixture(tmp_path, "spacemesh_tpu/obs/good_metrics.py", """
+        from ..utils.metrics import REGISTRY
+
+        drops = REGISTRY.counter("drops_total", "by reason")
+
+        def record(e):
+            drops.inc(reason=type(e).__name__)   # bounded enum: fine
+    """, select="SC005")
+    assert not fs
+
+
+# --- SC006 bare/swallowing excepts --------------------------------------
+
+
+def test_sc006_flags_and_accepts_justified(tmp_path):
+    fs = run_fixture(tmp_path, "spacemesh_tpu/consensus/swallow.py", """
+        def bad():
+            try:
+                risky()
+            except:
+                pass
+
+        def bad2():
+            try:
+                risky()
+            except Exception:
+                pass
+
+        def good_logged(log):
+            try:
+                risky()
+            except Exception as e:
+                log.warning("risky failed: %r", e)
+
+        def good_justified():
+            try:
+                risky()
+            except Exception:  # noqa: BLE001 — best-effort cache warm, next tick retries
+                pass
+
+        def good_pragma():
+            try:
+                risky()
+            except Exception:  # spacecheck: ok=SC006 teardown path, error already surfaced upstream
+                pass
+    """, select="SC006")
+    assert len(fs) == 2
+    assert {"bare except" in f.message or "broad except" in f.message
+            for f in fs} == {True}
+
+
+def test_sc006_out_of_scope_package_clean(tmp_path):
+    fs = run_fixture(tmp_path, "spacemesh_tpu/tools/swallow.py", """
+        def tool():
+            try:
+                risky()
+            except Exception:
+                pass
+    """, select="SC006")
+    assert not fs
+
+
+# --- engine: pragmas, fingerprints, errors ------------------------------
+
+
+def test_unparseable_file_is_an_error(tmp_path):
+    p = tmp_path / "spacemesh_tpu" / "broken.py"
+    p.parent.mkdir(parents=True)
+    p.write_text("def broken(:\n")
+    findings, errors = engine.run_paths([str(p)],
+                                        project_root=str(tmp_path))
+    assert errors and "broken.py" in errors[0]
+
+
+def test_fingerprints_survive_code_motion(tmp_path):
+    src = """
+        import time
+
+        def deadline():
+            return time.time() + 5.0
+    """
+    fs1 = run_fixture(tmp_path, "spacemesh_tpu/sim/move1.py", src)
+    # same offending line, 40 lines further down the file
+    fs2 = run_fixture(tmp_path, "spacemesh_tpu/sim/move1.py",
+                      "\n" * 40 + textwrap.dedent(src))
+    assert fs1[0].fingerprint == fs2[0].fingerprint
+    assert fs1[0].line != fs2[0].line
+
+
+def test_identical_lines_match_baseline_as_multiset(tmp_path):
+    # identical offending lines share a fingerprint; the baseline
+    # matches them as a multiset, so a SECOND identical violation added
+    # above a grandfathered one surfaces as exactly one new finding —
+    # it can never steal the existing entry's suppression
+    fs1 = run_fixture(tmp_path, "spacemesh_tpu/sim/twice.py", """
+        import time
+
+        def a():
+            return time.time()
+    """)
+    assert len(fs1) == 1
+    bl = {fs1[0].fingerprint: [{"fingerprint": fs1[0].fingerprint,
+                                "rule": "SC001",
+                                "justification": "grandfathered"}]}
+    fs2 = run_fixture(tmp_path, "spacemesh_tpu/sim/twice.py", """
+        import time
+
+        def zero():
+            return time.time()
+
+        def a():
+            return time.time()
+    """)
+    assert len(fs2) == 2
+    assert fs2[0].fingerprint == fs2[1].fingerprint == fs1[0].fingerprint
+    new, suppressed, stale = baseline_mod.split(fs2, bl)
+    assert len(new) == 1 and len(suppressed) == 1 and not stale
+    # and with only the original line, nothing is new or stale
+    new, suppressed, stale = baseline_mod.split(fs1, bl)
+    assert not new and len(suppressed) == 1 and not stale
+
+
+def test_write_baseline_preserves_justifications(tmp_path):
+    _seed_violation(tmp_path)
+    args = [str(tmp_path / "spacemesh_tpu"), "--root", str(tmp_path)]
+    bl = tmp_path / "bl.json"
+    assert cli_main(args + ["--write-baseline", str(bl)]) == 0
+    doc = json.loads(bl.read_text())
+    doc["findings"][0]["justification"] = "carefully reviewed, accepted"
+    bl.write_text(json.dumps(doc))
+    # add a second (different) violation, regenerate: the existing
+    # justification survives, only the new entry is TODO
+    (tmp_path / "spacemesh_tpu/sim/seeded2.py").write_text(
+        "import time\n\ndef worse():\n    return time.monotonic()\n")
+    assert cli_main(args + ["--write-baseline", str(bl)]) == 0
+    doc = json.loads(bl.read_text())
+    justs = {e["path"]: e["justification"] for e in doc["findings"]}
+    assert justs["spacemesh_tpu/sim/seeded.py"] == \
+        "carefully reviewed, accepted"
+    assert justs["spacemesh_tpu/sim/seeded2.py"] == "TODO"
+
+
+# --- CLI + baseline workflow --------------------------------------------
+
+
+def _seed_violation(root, rule="SC001"):
+    p = root / "spacemesh_tpu" / "sim"
+    p.mkdir(parents=True, exist_ok=True)
+    (p / "seeded.py").write_text(
+        "import time\n\ndef bad():\n    return time.time()\n")
+
+
+SEEDS = {
+    "SC001": "import time\ndef f():\n    return time.time()\n",
+    "SC002": "import time\nasync def f():\n    time.sleep(1)\n",
+    "SC003": ("import jax\ns = jax.jit(i, donate_argnums=(0,))\n"
+              "def f(c):\n    s(c)\n    return c\n"),
+    "SC004": ("def f(HEALTH, wd):\n"
+              "    HEALTH.register('x', wd)\n    work()\n"),
+    "SC005": ("from ..utils.metrics import REGISTRY\n"
+              "c = REGISTRY.counter('x_total', 'h')\n"
+              "def f(v):\n    c.inc(reason=f'{v}')\n"),
+    "SC006": "def f():\n    try:\n        g()\n    except:\n        pass\n",
+}
+
+
+@pytest.mark.parametrize("rule", sorted(SEEDS))
+def test_seeded_violation_fails_cli(tmp_path, rule, capsys):
+    # acceptance criterion: seeding any one of the six rule violations
+    # into a scratch file makes the runner exit non-zero. The scratch
+    # file lands in a package the rule's scope covers (SC006 only scans
+    # consensus/verify/p2p; SC001 only the virtual-time packages).
+    pkg = "consensus" if rule == "SC006" else "sim"
+    p = tmp_path / "spacemesh_tpu" / pkg
+    p.mkdir(parents=True)
+    (p / "seeded.py").write_text(SEEDS[rule])
+    rc = cli_main([str(p / "seeded.py"), "--root", str(tmp_path),
+                   "--no-baseline", "--select", rule])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert rule in out
+
+
+def test_clean_file_passes_cli(tmp_path, capsys):
+    p = tmp_path / "spacemesh_tpu" / "sim"
+    p.mkdir(parents=True)
+    (p / "clean.py").write_text("def ok(now):\n    return now + 1\n")
+    rc = cli_main([str(p / "clean.py"), "--root", str(tmp_path)])
+    assert rc == 0
+
+
+def test_github_format(tmp_path, capsys):
+    _seed_violation(tmp_path)
+    rc = cli_main([str(tmp_path / "spacemesh_tpu"), "--root",
+                   str(tmp_path), "--format", "github"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert out.startswith("::error file=spacemesh_tpu/sim/seeded.py,")
+    assert "title=spacecheck SC001" in out
+
+
+def test_baseline_workflow(tmp_path, capsys):
+    _seed_violation(tmp_path)
+    args = [str(tmp_path / "spacemesh_tpu"), "--root", str(tmp_path)]
+    bl = tmp_path / "spacecheck_baseline.json"
+
+    # 1. --write-baseline emits TODO justifications ...
+    rc = cli_main(args + ["--write-baseline", str(bl)])
+    assert rc == 0
+    # 2. ... which the checker REJECTS until replaced
+    rc = cli_main(args + ["--baseline", str(bl)])
+    assert rc == 2
+    # 3. justified baseline passes
+    doc = json.loads(bl.read_text())
+    for ent in doc["findings"]:
+        ent["justification"] = "grandfathered: legacy tool, tracked in #9"
+    bl.write_text(json.dumps(doc))
+    rc = cli_main(args + ["--baseline", str(bl)])
+    assert rc == 0
+    # 4. a NEW finding still fails against the baseline
+    (tmp_path / "spacemesh_tpu/sim/seeded2.py").write_text(
+        "import time\n\ndef worse():\n    return time.monotonic()\n")
+    rc = cli_main(args + ["--baseline", str(bl)])
+    assert rc == 1
+    os.unlink(tmp_path / "spacemesh_tpu/sim/seeded2.py")
+    # 5. fixing the original finding makes its entry STALE -> failure
+    (tmp_path / "spacemesh_tpu/sim/seeded.py").write_text(
+        "def fixed(now):\n    return now\n")
+    rc = cli_main(args + ["--baseline", str(bl)])
+    err = capsys.readouterr().err
+    assert rc == 2
+    assert "STALE" in err
+
+
+def test_unjustified_pragma_does_not_suppress(tmp_path):
+    # the pragma is the one suppression channel that could bypass the
+    # justification contract — a bare `ok=SC001` must not count
+    fs = run_fixture(tmp_path, "spacemesh_tpu/sim/bare_pragma.py", """
+        import time
+
+        def stamp():
+            return time.time()  # spacecheck: ok=SC001
+    """)
+    assert [f for f in fs if f.rule == "SC001"]
+
+
+def test_select_does_not_stale_other_rules_baseline(tmp_path, capsys):
+    # --select computes no findings for deselected rules; their
+    # baseline entries must not be reported as rot (exit 2)
+    p = tmp_path / "spacemesh_tpu" / "consensus"
+    p.mkdir(parents=True)
+    (p / "seeded.py").write_text(SEEDS["SC006"])
+    args = [str(tmp_path / "spacemesh_tpu"), "--root", str(tmp_path)]
+    bl = tmp_path / "bl.json"
+    assert cli_main(args + ["--write-baseline", str(bl)]) == 0
+    doc = json.loads(bl.read_text())
+    for ent in doc["findings"]:
+        ent["justification"] = "grandfathered teardown swallow, tracked"
+    bl.write_text(json.dumps(doc))
+    rc = cli_main(args + ["--baseline", str(bl), "--select", "SC001"])
+    assert rc == 0, capsys.readouterr().err
+
+
+def test_baseline_rejects_missing_justification(tmp_path):
+    bl = tmp_path / "bl.json"
+    bl.write_text(json.dumps({
+        "version": 1,
+        "findings": [{"fingerprint": "abc", "rule": "SC001",
+                      "path": "x.py", "snippet": "s",
+                      "justification": ""}]}))
+    with pytest.raises(baseline_mod.BaselineError):
+        baseline_mod.load(str(bl))
+
+
+def test_real_tree_is_clean():
+    # the shipped tree + checked-in baseline must pass: this is the CI
+    # contract, asserted from inside tier-1 too so a regression fails
+    # fast locally, not just in the spacecheck job
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    res = subprocess.run(
+        [sys.executable, "-m", "spacemesh_tpu.tools.spacecheck",
+         "--root", root],
+        cwd=root, capture_output=True, text=True, timeout=300)
+    assert res.returncode == 0, res.stdout + res.stderr
+
+
+# --- runtime sanitizers -------------------------------------------------
+
+
+@pytest.fixture
+def armed_sanitizer():
+    sanitize.clear_violations()
+    sanitize.enable(slow_threshold_s=0.05)
+    yield sanitize
+    sanitize.disable()
+    sanitize.clear_violations()
+
+
+def test_sanitizer_catches_injected_loop_block(armed_sanitizer):
+    async def main():
+        loop = asyncio.get_running_loop()
+        loop.call_soon(lambda: time.sleep(0.12))
+        await asyncio.sleep(0.01)
+
+    asyncio.run(main())
+    hits = [v for v in sanitize.violations() if v.kind == "slow-callback"]
+    assert hits and hits[0].seconds >= 0.05
+
+
+def test_sanitizer_slow_callback_attributes_span(armed_sanitizer):
+    from spacemesh_tpu.utils import tracing
+
+    tracing.start(capacity=64)
+    try:
+        seen: dict = {}
+
+        async def main():
+            with tracing.span("blocky") as sp:
+                seen["id"] = sp.id
+                loop = asyncio.get_running_loop()
+                # call_soon copies the CURRENT context -> the span id
+                # travels into the callback's contextvars
+                loop.call_soon(lambda: time.sleep(0.1))
+            await asyncio.sleep(0.01)
+
+        asyncio.run(main())
+    finally:
+        tracing.stop()
+    hits = [v for v in sanitize.violations() if v.kind == "slow-callback"]
+    assert hits and hits[0].span == seen["id"]
+
+
+def test_sanitizer_quiet_on_fast_callbacks(armed_sanitizer):
+    async def main():
+        loop = asyncio.get_running_loop()
+        for _ in range(50):
+            loop.call_soon(lambda: None)
+        await asyncio.sleep(0.01)
+
+    asyncio.run(main())
+    assert not sanitize.violations()
+
+
+def test_sanitizer_off_bucket_compile_raises(armed_sanitizer,
+                                             monkeypatch):
+    from spacemesh_tpu.ops import scrypt
+
+    monkeypatch.setenv("SPACEMESH_SHAPE_BUCKETS", "off")
+    cw = scrypt.commitment_to_words(b"\x01" * 32)
+    lo, hi = scrypt.split_indices(np.arange(7, dtype=np.uint64))
+    with pytest.raises(sanitize.SanitizeError, match="off-bucket"):
+        scrypt.scrypt_labels_jit(cw, lo, hi, n=2)
+    assert any(v.kind == "jit-shape" for v in sanitize.violations())
+
+
+def test_sanitizer_bucketed_dispatch_clean(armed_sanitizer):
+    from spacemesh_tpu.ops import scrypt
+
+    cw = scrypt.commitment_to_words(b"\x01" * 32)
+    lo, hi = scrypt.split_indices(np.arange(7, dtype=np.uint64))
+    out = scrypt.scrypt_labels_jit(cw, lo, hi, n=2)  # pads 7 -> 8
+    assert out.shape == (4, 7)
+    assert not [v for v in sanitize.violations() if v.kind == "jit-shape"]
+
+
+def test_sanitizer_registry_thread_affinity(armed_sanitizer):
+    from spacemesh_tpu.utils import metrics
+
+    reg = metrics.Registry()
+    reg.counter("spacecheck_test_main_ok_total")  # owner thread: fine
+
+    caught: list = []
+
+    def off_thread():
+        try:
+            reg.counter("spacecheck_test_off_thread_total")
+        except sanitize.SanitizeError as e:
+            caught.append(e)
+
+    t = threading.Thread(target=off_thread)
+    t.start()
+    t.join()
+    assert caught, "off-thread instrument creation did not raise"
+    # recording (not creating) from a worker thread stays legal
+    c = reg.counter("spacecheck_test_record_total")
+
+    t = threading.Thread(target=lambda: c.inc(kind="worker"))
+    t.start()
+    t.join()
+    assert c.sample()[(("kind", "worker"),)] == 1.0
+
+
+def test_sanitizer_disabled_is_free():
+    sanitize.disable()
+    sanitize.clear_violations()
+    from spacemesh_tpu.ops import scrypt
+
+    # off: no raise on odd shapes, no recording
+    sanitize.on_jit_shape("labels_fused", 7)
+    assert not sanitize.violations()
+
+    async def main():
+        loop = asyncio.get_running_loop()
+        loop.call_soon(lambda: time.sleep(0.06))
+        await asyncio.sleep(0.01)
+
+    asyncio.run(main())
+    assert not sanitize.violations()
+
+
+def test_sanitizer_env_boot(tmp_path):
+    # SPACEMESH_SANITIZE=1 arms the sanitizer at import (the CI
+    # sanitize-smoke path) and a tiny init runs CLEAN under it
+    code = textwrap.dedent("""
+        import hashlib, tempfile
+        from spacemesh_tpu.utils import sanitize
+        assert sanitize.enabled(), "env did not arm the sanitizer"
+        from spacemesh_tpu.post import initializer
+        with tempfile.TemporaryDirectory() as d:
+            info = initializer.initialize(
+                d, node_id=hashlib.sha256(b"n").digest(),
+                commitment=hashlib.sha256(b"c").digest(), num_units=1,
+                labels_per_unit=256, scrypt_n=2, max_file_size=4096,
+                batch_size=128)
+        bad = [v for v in sanitize.violations()
+               if v.kind in ("jit-shape", "registry-thread")]
+        assert not bad, bad
+        print("sanitized init clean")
+    """)
+    env = os.environ | {"SPACEMESH_SANITIZE": "1", "JAX_PLATFORMS": "cpu"}
+    res = subprocess.run([sys.executable, "-c", code], env=env,
+                         capture_output=True, text=True, timeout=300)
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "sanitized init clean" in res.stdout
