@@ -62,10 +62,20 @@ goes red — on any mismatch:
 On a real multi-device accelerator the same measurement runs in-process
 (the devices are physical; nothing to force). BENCH_MESH=0 disables.
 
+After the prove bench, the MULTI-TENANT headline (ISSUE 11): 16 tenants'
+small init jobs through the runtime scheduler's packed fair-share
+admission (spacemesh_tpu/runtime/) vs the same jobs run one tenant at a
+time, per-tenant sha256 label digests + VRF nonces asserted identical
+before any rate is reported (a mismatch exits non-zero):
+  {"metric": "post_multi_tenant_labels_per_sec", ..., "tenants": 16,
+   "sequential": N, "vs_sequential": N, "bit_identical": true}
+
 Env knobs: BENCH_BATCH (label lanes per program), BENCH_N (scrypt N),
 BENCH_REPS, BENCH_CPU_LABELS, BENCH_VERIFY_ITEMS (0 disables the verify
 bench), BENCH_PROVE_LABELS (store size; 0 disables the prove bench),
-BENCH_PROVE_BATCH, BENCH_MESH (0 disables the mesh line),
+BENCH_PROVE_BATCH, BENCH_TENANTS / BENCH_TENANT_LABELS / BENCH_TENANT_N
+/ BENCH_TENANT_REPS / BENCH_PACK_LANES (the multi-tenant line; tenants=0
+disables), BENCH_MESH (0 disables the mesh line),
 BENCH_MESH_TIMEOUT (probe subprocess seconds, default 1800),
 SPACEMESH_JAX_CACHE (cache dir, `off` to disable), plus the kernel
 overrides SPACEMESH_ROMIX / SPACEMESH_ROMIX_CHUNK /
@@ -223,6 +233,133 @@ def prove_bench(labels: int, batch: int, reps: int = 3) -> None:
         "proof_nonce": doc["proof"].nonce,
         "early_exited": bool(stats.get("early_exited")),
         "verified": True,
+    }))
+
+
+def multi_tenant_bench() -> None:
+    """16-tenant aggregate init throughput vs one-tenant-at-a-time.
+
+    The workload is the multi-tenant service shape (ROADMAP #1): many
+    smeshers each submitting a SMALL init job — per-job ownership pays
+    one session (writer pool, watchdogs, metadata, drain) and one
+    under-filled device program per tenant, while the runtime scheduler
+    (spacemesh_tpu/runtime/) packs all tenants' lanes into full-bucket
+    fused programs through one always-fed engine window.  Reduced N
+    (like the prove bench's reduced-parameter store) keeps the measured
+    quantity the orchestration gap, not the scrypt math — the same
+    choice ROADMAP #5 motivates ("the gap is orchestration").
+
+    Before ANY rate is reported, every tenant's label bytes (sha256)
+    and VRF nonce from the scheduled path are asserted identical to the
+    sequential Initializer's; a mismatch exits non-zero so CI goes red.
+    Emits:
+      {"metric": "post_multi_tenant_labels_per_sec", "value": N,
+       "unit": "labels/s", "tenants": T, "sequential": N,
+       "vs_sequential": N, "bit_identical": true}
+    """
+    import shutil
+    import tempfile
+    from pathlib import Path
+
+    tenants = int(os.environ.get("BENCH_TENANTS", 16))
+    labels = int(os.environ.get("BENCH_TENANT_LABELS", 128))
+    n = int(os.environ.get("BENCH_TENANT_N", 8))
+    reps = int(os.environ.get("BENCH_TENANT_REPS", 3))
+    pack = int(os.environ.get("BENCH_PACK_LANES", 2048))
+
+    from spacemesh_tpu.post import initializer
+    from spacemesh_tpu.post.data import LabelStore
+    from spacemesh_tpu.runtime import TenantScheduler
+
+    ids = [(f"smesher-{i:02d}",
+            hashlib.sha256(b"bench-mt-node-%d" % i).digest(),
+            hashlib.sha256(b"bench-mt-commit-%d" % i).digest())
+           for i in range(tenants)]
+    total = tenants * labels
+
+    def fingerprint(dir_, meta) -> tuple:
+        store = LabelStore(dir_, meta)
+        digest = hashlib.sha256(store.read_labels(0, labels)).hexdigest()
+        store.close()
+        return digest, meta.vrf_nonce, meta.vrf_nonce_value
+
+    log(f"multi-tenant: {tenants} tenants x {labels} labels (N={n}, "
+        f"pack={pack}) ...")
+    with tempfile.TemporaryDirectory() as d:
+        root = Path(d)
+
+        def seq_round(tag: str) -> dict:
+            prints = {}
+            for tid, node, commit in ids:
+                dir_ = root / f"{tag}-{tid}"
+                meta, _res = initializer.initialize(
+                    dir_, node_id=node, commitment=commit, num_units=1,
+                    labels_per_unit=labels, scrypt_n=n,
+                    max_file_size=1 << 24, batch_size=labels, mesh=None)
+                prints[tid] = fingerprint(dir_, meta)
+                shutil.rmtree(dir_)
+            return prints
+
+        sched = TenantScheduler(workers=2, pack_lanes=pack)
+        for tid, _, _ in ids:
+            sched.register_tenant(tid)
+
+        def mt_round(tag: str) -> dict:
+            handles = [
+                (tid, sched.submit_init(
+                    tid, root / f"{tag}-{tid}", node_id=node,
+                    commitment=commit, num_units=1,
+                    labels_per_unit=labels, scrypt_n=n,
+                    max_file_size=1 << 24))
+                for tid, node, commit in ids]
+            prints = {}
+            for tid, h in handles:
+                meta = h.result(timeout=600)
+                prints[tid] = fingerprint(root / f"{tag}-{tid}", meta)
+                shutil.rmtree(root / f"{tag}-{tid}")
+            return prints
+
+        try:
+            # warm both paths' executables (compile cost is its own
+            # bench line; this line measures steady-state admission —
+            # the scheduler's pack linger keeps measured packs full)
+            seq_prints = seq_round("warm-seq")
+            mt_prints = mt_round("warm-mt")
+            best_seq = best_mt = float("inf")
+            for r in range(reps):
+                t0 = time.perf_counter()
+                seq_round(f"s{r}")
+                best_seq = min(best_seq, time.perf_counter() - t0)
+                t0 = time.perf_counter()
+                mt_round(f"m{r}")
+                best_mt = min(best_mt, time.perf_counter() - t0)
+        finally:
+            sched.close()
+
+    for tid, _, _ in ids:
+        if seq_prints[tid] != mt_prints[tid]:
+            # divergence must be a red build, not a quietly odd rate
+            log(f"multi-tenant: FAILED — tenant {tid} diverged from the "
+                f"sequential path: {seq_prints[tid]} != {mt_prints[tid]}")
+            sys.exit(1)
+
+    seq_rate = total / best_seq
+    mt_rate = total / best_mt
+    log(f"multi-tenant: sequential {best_seq * 1e3:.0f}ms "
+        f"({seq_rate:,.0f} labels/s), scheduled {best_mt * 1e3:.0f}ms "
+        f"({mt_rate:,.0f} labels/s, {mt_rate / seq_rate:.2f}x)")
+    print(json.dumps({
+        "metric": "post_multi_tenant_labels_per_sec",
+        "value": round(mt_rate, 1),
+        "unit": "labels/s",
+        "tenants": tenants,
+        "labels_per_tenant": labels,
+        "n": n,
+        "pack_lanes": pack,
+        "sequential": round(seq_rate, 1),
+        "vs_sequential": round(mt_rate / seq_rate, 2),
+        "bit_identical": True,  # per-tenant sha256 + VRF nonce checked
+        #                         above; a mismatch exits non-zero
     }))
 
 
@@ -451,6 +588,9 @@ def main() -> None:
     if prove_labels > 0:
         prove_bench(prove_labels,
                     int(os.environ.get("BENCH_PROVE_BATCH", 2048)))
+
+    if int(os.environ.get("BENCH_TENANTS", 16)) > 0:
+        multi_tenant_bench()
 
     verify_items = int(os.environ.get("BENCH_VERIFY_ITEMS", 512))
     if verify_items > 0:

@@ -95,28 +95,68 @@ def pow_hash(challenge: bytes, node_id: bytes, nonce: int) -> bytes:
         challenge + node_id + int(nonce).to_bytes(8, "little")).digest()
 
 
+def _host_scan(challenge: bytes, node_id: bytes, difficulty: bytes,
+               base: int, batch: int) -> int | None:
+    """Pure-host fallback batch (hashlib): the k2pow gate must survive a
+    wedged or failing accelerator — a device dispatch error degrades to
+    this, it does not kill the prove."""
+    prefix = challenge + node_id
+    import hashlib
+
+    for nonce in range(base, base + batch):
+        if hashlib.sha256(
+                prefix + nonce.to_bytes(8, "little")).digest() < difficulty:
+            return nonce
+    return None
+
+
 def search(challenge: bytes, node_id: bytes, difficulty: bytes,
            *, batch: int = 1 << 16, start: int = 0,
-           max_batches: int = 1 << 16) -> int | None:
+           max_batches: int = 1 << 16, inflight: int = 2,
+           tenant: str = "-") -> int | None:
     """Find a nonce whose pow_hash is below ``difficulty`` (32B BE target).
 
-    Scans ``batch`` nonces per device program; returns the smallest hit in
-    the first batch containing one, or None if exhausted.
+    Scans ``batch`` nonces per device program through the shared runtime
+    engine (runtime/engine.py): ``inflight`` batches stay enqueued so
+    the host-side hit check of one batch overlaps the next batch's
+    device compute.  Batches retire in nonce order, so the result — the
+    smallest hit in the first batch containing one — is identical to
+    the historical serial loop's.  A device dispatch failure falls back
+    to a host hashlib scan of that batch (counted in
+    ``runtime_fallbacks_total{kind="k2pow"}``); None when exhausted.
     """
+    from ..runtime import engine
+
     if len(difficulty) != 32:
         raise ValueError("difficulty must be 32 bytes")
     st = jnp.asarray(prefix_state(challenge, node_id))
     tgt = jnp.asarray(_words_be(difficulty))
-    for i in range(max_batches):
-        base = start + i * batch
+
+    def dispatch(base):
         nonces = np.arange(base, base + batch, dtype=np.uint64)
         lo = jnp.asarray((nonces & 0xFFFFFFFF).astype(np.uint32))
         hi = jnp.asarray((nonces >> 32).astype(np.uint32))
-        ok = np.asarray(below_target_jit(pow_hash_batch_jit(st, lo, hi), tgt))
-        hits = np.nonzero(ok)[0]
-        if hits.size:
-            return int(nonces[hits[0]])
-    return None
+        # enqueue only: the (B,) hit mask crosses to host at retire
+        return base, below_target_jit(pow_hash_batch_jit(st, lo, hi), tgt)
+
+    def fallback(base, exc):
+        del exc  # counted by runtime_fallbacks_total{kind="k2pow"}
+        return base, None  # marker: retire re-scans this batch on host
+
+    def retire(ticket):
+        # a 0 return is a valid winning nonce: the engine's early-exit
+        # test is `is not None`, not truthiness
+        base, ok = ticket
+        if ok is None:
+            return _host_scan(challenge, node_id, difficulty, base, batch)
+        hits = np.nonzero(np.asarray(ok))[0]
+        return int(base + int(hits[0])) if hits.size else None
+
+    pipe = engine.Pipeline(kind="k2pow", tenant=tenant,
+                           inflight=inflight, fallback=fallback,
+                           span="pow")
+    return pipe.run((start + i * batch for i in range(max_batches)),
+                    dispatch, retire)
 
 
 def verify(challenge: bytes, node_id: bytes, difficulty: bytes, nonce: int) -> bool:

@@ -11,9 +11,11 @@ bidirectional stream answering
   GenProofRequest  -> GenProofResponse (OK w/o proof while brewing — the
                       node re-asks every queryInterval, post_client.go:107)
 
-Proving runs in a thread (scrypt recompute + nonce search); the stream
-stays responsive while a proof is in flight.  Sessions reconnect with
-backoff when the node restarts.
+Proving runs off the stream — through the multi-tenant runtime
+scheduler when one is attached (per-identity job IDs, fair-share across
+identities, gang-scheduled windows; runtime/scheduler.py), else in a
+plain thread — so the stream stays responsive while a proof is in
+flight.  Sessions reconnect with backoff when the node restarts.
 """
 
 from __future__ import annotations
@@ -33,34 +35,63 @@ class _ProofJob:
     """One in-flight proving task per identity (the reference service
     rejects a second concurrent challenge per identity the same way).
 
-    Tracks the session in ``post_prove_inflight`` so an operator can see
-    how many identities are mid-prove on this worker (the node re-asks
-    every queryInterval while a proof brews; post_client.go:107)."""
-
-    def __init__(self, challenge: bytes, task: asyncio.Task):
-        self.challenge = challenge
-        self.task = task
-        metrics.post_prove_inflight.set(_ProofJob.live + 1)
-        _ProofJob.live += 1
-        task.add_done_callback(self._done)
+    Tracks the session in ``post_prove_inflight`` — the label-free
+    total every dashboard already reads, plus a per-``tenant`` series
+    so an operator can see WHICH identities are mid-prove on this
+    worker (the node re-asks every queryInterval while a proof brews;
+    post_client.go:107).  ``job_id`` is the runtime scheduler's job id
+    when the prove was routed through it ("" on the plain-thread
+    path)."""
 
     live = 0
+    live_by_tenant: dict[str, int] = {}
+
+    def __init__(self, challenge: bytes, task: asyncio.Task,
+                 tenant: str = "-", job_id: str = ""):
+        self.challenge = challenge
+        self.task = task
+        self.tenant = tenant
+        self.job_id = job_id
+        _ProofJob.live += 1
+        by = _ProofJob.live_by_tenant
+        by[tenant] = by.get(tenant, 0) + 1
+        metrics.post_prove_inflight.set(_ProofJob.live)
+        metrics.post_prove_inflight.set(by[tenant], tenant=tenant)
+        task.add_done_callback(self._done)
+
+    def _done(self, _task) -> None:
+        _ProofJob.live = max(_ProofJob.live - 1, 0)
+        by = _ProofJob.live_by_tenant
+        by[self.tenant] = max(by.get(self.tenant, 1) - 1, 0)
+        metrics.post_prove_inflight.set(_ProofJob.live)
+        metrics.post_prove_inflight.set(by[self.tenant],
+                                        tenant=self.tenant)
 
     @staticmethod
-    def _done(_task) -> None:
-        _ProofJob.live = max(_ProofJob.live - 1, 0)
-        metrics.post_prove_inflight.set(_ProofJob.live)
+    def forget_tenant(tenant: str) -> None:
+        """Drop a gone identity's series + tracking entry — a worker
+        cycling identities must not grow one dead 0-valued
+        post_prove_inflight{tenant=...} series per identity forever
+        (the PR 7 stale-series lesson)."""
+        _ProofJob.live_by_tenant.pop(tenant, None)
+        metrics.post_prove_inflight.remove(tenant=tenant)
 
 
 class RegisterSession:
-    """One identity's Register stream to the node."""
+    """One identity's Register stream to the node.
+
+    With a runtime ``scheduler`` attached, proofs submit as per-identity
+    jobs (``tenant`` = the identity's hex prefix) instead of owning a
+    raw thread: many identities' proves then fair-share one device."""
 
     def __init__(self, node_address: str, node_id: bytes, client: PostClient,
-                 reconnect_backoff: float = 1.0):
+                 reconnect_backoff: float = 1.0, scheduler=None):
         self.node_address = node_address
         self.node_id = node_id
         self.client = client
         self.backoff = reconnect_backoff
+        self.scheduler = scheduler
+        self.tenant = node_id.hex()[:16]
         self._job: _ProofJob | None = None
         self._stop = asyncio.Event()
         self.connected = asyncio.Event()  # true while a stream is live
@@ -113,6 +144,14 @@ class RegisterSession:
         return ppb.ServiceResponse(gen_proof=ppb.GenProofResponse(
             status=ppb.GEN_PROOF_STATUS_ERROR))
 
+    @staticmethod
+    async def _scheduled(handle) -> tuple:
+        """Await a runtime-scheduler prove job from the event loop; the
+        result shape matches PostClient.proof's (the metadata half is
+        unused by the stream answer)."""
+        proof = await asyncio.wrap_future(handle.future)
+        return proof, None
+
     def _meta(self) -> ppb.Metadata:
         info = self.client.info()
         meta = ppb.Metadata(
@@ -132,9 +171,17 @@ class RegisterSession:
                     status=ppb.GEN_PROOF_STATUS_ERROR))
             self._job = job = None
         if job is None:
-            task = asyncio.ensure_future(
-                asyncio.to_thread(self.client.proof, challenge))
-            self._job = job = _ProofJob(challenge, task)
+            job_id = ""
+            if self.scheduler is not None:
+                handle = self.client.submit_proof(self.scheduler,
+                                                  self.tenant, challenge)
+                job_id = handle.id
+                task = asyncio.ensure_future(self._scheduled(handle))
+            else:
+                task = asyncio.ensure_future(
+                    asyncio.to_thread(self.client.proof, challenge))
+            self._job = job = _ProofJob(challenge, task,
+                                        tenant=self.tenant, job_id=job_id)
         if not job.task.done():
             # still brewing: OK without proof, node will re-ask
             return ppb.ServiceResponse(gen_proof=ppb.GenProofResponse(
@@ -155,21 +202,34 @@ class RegisterSession:
 
 
 class GrpcWorker:
-    """All discovered identities, each with its own Register session."""
+    """All discovered identities, each with its own Register session.
+
+    With ``scheduler`` (a runtime TenantScheduler) the worker is the
+    multi-tenant service shape: every identity registers as a tenant
+    and its proofs run as fair-share-scheduled jobs on the shared
+    device instead of per-identity thread ownership.  The scheduler is
+    borrowed, not owned — the embedder closes it; this worker only
+    registers/unregisters its identities."""
 
     def __init__(self, service: PostService, node_address: str,
-                 reconnect_backoff: float = 1.0):
+                 reconnect_backoff: float = 1.0, scheduler=None):
         self.service = service
         self.node_address = node_address
         self.backoff = reconnect_backoff
+        self.scheduler = scheduler
         self.sessions: list[RegisterSession] = []
         self._tasks: list[asyncio.Task] = []
+        self._tenants: list[str] = []
 
     async def start(self) -> None:
         for node_id in self.service.registered():
             client = self.service.client(node_id)
             s = RegisterSession(self.node_address, node_id, client,
-                                reconnect_backoff=self.backoff)
+                                reconnect_backoff=self.backoff,
+                                scheduler=self.scheduler)
+            if self.scheduler is not None:
+                self.scheduler.register_tenant(s.tenant)
+                self._tenants.append(s.tenant)
             self.sessions.append(s)
             self._tasks.append(asyncio.ensure_future(s.run()))
 
@@ -179,8 +239,16 @@ class GrpcWorker:
             timeout)
 
     async def stop(self) -> None:
-        for s in self.sessions:
-            s.stop()
-        for t in self._tasks:
-            t.cancel()
-        await asyncio.gather(*self._tasks, return_exceptions=True)
+        try:
+            for s in self.sessions:
+                s.stop()
+            for t in self._tasks:
+                t.cancel()
+            await asyncio.gather(*self._tasks, return_exceptions=True)
+        finally:
+            for s in self.sessions:
+                _ProofJob.forget_tenant(s.tenant)
+            if self.scheduler is not None:
+                for tenant in self._tenants:
+                    self.scheduler.unregister_tenant(tenant)
+                self._tenants.clear()

@@ -15,6 +15,13 @@ stages (docs/POST_PIPELINE.md):
   write     — hand the bytes to a bounded-queue background writer pool
               (post/data.py LabelWriter), so disk, PCIe and compute overlap.
 
+The bounded-window dispatch/retire machinery itself lives in the shared
+device-job runtime (spacemesh_tpu/runtime/engine.py Pipeline) — this
+module only supplies the init-specific dispatch and retire callbacks;
+the multi-tenant scheduler (runtime/scheduler.py) serves many
+identities' inits through the same engine with cross-tenant lane
+packing.
+
 Resume metadata is rewritten on a time/label interval rather than per
 batch, with one ordering rule: the persisted ``labels_written`` cursor is
 the writer pool's *durable* cursor (contiguous bytes on disk), never the
@@ -37,7 +44,6 @@ import enum
 import os
 import sys
 import time
-from collections import deque
 from pathlib import Path
 from typing import Callable
 
@@ -46,6 +52,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..ops import scrypt
+from ..runtime import engine
 from ..utils import metrics, tracing
 from .data import LabelStore, LabelWriter, PostMetadata
 
@@ -108,7 +115,9 @@ class Initializer:
                  meta_interval_s: float = DEFAULT_META_INTERVAL_S,
                  meta_interval_labels: int = DEFAULT_META_INTERVAL_LABELS,
                  mesh="auto",
-                 stall_deadline_s: float = 30.0):
+                 stall_deadline_s: float = 30.0,
+                 tenant: str = "-"):
+        self.tenant = tenant
         self.store = LabelStore(data_dir, meta)
         self.meta = meta
         self.batch = batch_size
@@ -215,7 +224,6 @@ class Initializer:
         self._snapshot = carry_host
 
         writer = self.store.start_writer(self.writers, self.writer_queue)
-        pending: deque = deque()  # (start, count, words, snapshot)
         self._last_save_t = time.monotonic()
         self._last_save_labels = written0
         # liveness (obs/health.py): the fetch frontier and the writer's
@@ -236,35 +244,46 @@ class Initializer:
                                {"total": total, "resume_at": written0,
                                 "batch": self.batch,
                                 "devices": mesh.size if mesh else 1,
-                                "impl": decision.impl}
+                                "impl": decision.impl,
+                                "tenant": self.tenant}
                                if tracing.is_enabled() else None)
         session.__enter__()
-        try:
+
+        # the bounded dispatch->retire window is the shared runtime's
+        # (runtime/engine.py); this module supplies only the callbacks.
+        # The donated VRF carry is loop-carried state: the dispatch
+        # callback rotates it through a one-slot cell.
+        carry_cell = [carry]
+
+        def batches():
             dispatched = written0
-            while dispatched < total and not self._stop:
+            while dispatched < total:
                 count = min(self.batch, total - dispatched)
-                td = time.perf_counter()
-                with tracing.span("init.dispatch",
-                                  {"start": dispatched, "count": count}
-                                  if tracing.is_enabled() else None):
-                    words, carry, snap = self._dispatch(
-                        mesh, cw, dispatched, count, carry)
-                stats.dispatch_s += time.perf_counter() - td
-                stats.batches += 1
-                metrics.post_pipeline_dispatched.inc()
-                pending.append((dispatched, count, words, snap))
+                yield dispatched, count
                 dispatched += count
-                metrics.post_pipeline_inflight.set(len(pending))
-                if len(pending) >= self.inflight:
-                    self._retire(pending.popleft(), writer, stats)
-                    self._maybe_save(writer, stats)
-            while pending and not self._stop:  # drain (stop still honored)
-                self._retire(pending.popleft(), writer, stats)
-                if pending:
-                    self._maybe_save(writer, stats)
-            if self._stop:
+
+        def dispatch(item):
+            start, count = item
+            words, new_carry, snap = self._dispatch(
+                mesh, cw, start, count, carry_cell[0])
+            carry_cell[0] = new_carry
+            metrics.post_pipeline_dispatched.inc()
+            return start, count, words, snap
+
+        def retire(ticket):
+            self._retire(ticket, writer, stats)
+            self._maybe_save(writer, stats)
+            return None
+
+        pipe = engine.Pipeline(
+            kind="init", tenant=self.tenant, inflight=self.inflight,
+            stop=lambda: self._stop, span="init",
+            attrs=lambda item: {"start": item[0], "count": item[1]},
+            on_inflight=metrics.post_pipeline_inflight.set)
+        try:
+            pipe.run(batches(), dispatch, retire)
+            if pipe.stats.stopped:
                 self.status = Status.STOPPED
-                pending.clear()  # discard in-flight device work
             tw = time.perf_counter()
             with tracing.span("init.drain_stall"):
                 writer.drain()
@@ -272,6 +291,8 @@ class Initializer:
             self._save_meta(writer, stats)
         finally:
             session.__exit__(None, None, None)
+            stats.batches = pipe.stats.batches
+            stats.dispatch_s = pipe.stats.dispatch_s
             stats.write_s = writer.write_seconds
             writer.close(drain=False)
             health_mod.HEALTH.unregister("post.init", init_wd.check)
@@ -423,6 +444,33 @@ class Initializer:
         self._last_save_labels = durable
 
 
+def open_or_create_meta(data_dir: Path, *, node_id: bytes,
+                        commitment: bytes, num_units: int,
+                        labels_per_unit: int, scrypt_n: int = 8192,
+                        max_file_size: int = 64 * 1024 * 1024
+                        ) -> PostMetadata:
+    """Load (and parameter-check) or create one identity's metadata —
+    the create-or-resume gate shared by :func:`initialize` and the
+    multi-tenant scheduler's packed init path (runtime/scheduler.py)."""
+    dir_ = Path(data_dir)
+    if (dir_ / "postdata_metadata.json").exists():
+        meta = PostMetadata.load(dir_)
+        if (meta.node_id != node_id.hex()
+                or meta.commitment != commitment.hex()
+                or meta.scrypt_n != scrypt_n
+                or meta.labels_per_unit != labels_per_unit
+                or meta.num_units != num_units
+                or meta.max_file_size != max_file_size):
+            raise ValueError(
+                "existing POST data directory was initialized with different "
+                "parameters; refusing to mix label sets")
+        return meta
+    return PostMetadata(
+        node_id=node_id.hex(), commitment=commitment.hex(),
+        scrypt_n=scrypt_n, num_units=num_units,
+        labels_per_unit=labels_per_unit, max_file_size=max_file_size)
+
+
 def initialize(data_dir: str | Path, *, node_id: bytes, commitment: bytes,
                num_units: int, labels_per_unit: int, scrypt_n: int = 8192,
                max_file_size: int = 64 * 1024 * 1024,
@@ -436,22 +484,10 @@ def initialize(data_dir: str | Path, *, node_id: bytes, commitment: bytes,
 
     accel.enable_persistent_cache()
     dir_ = Path(data_dir)
-    if (dir_ / "postdata_metadata.json").exists():
-        meta = PostMetadata.load(dir_)
-        if (meta.node_id != node_id.hex()
-                or meta.commitment != commitment.hex()
-                or meta.scrypt_n != scrypt_n
-                or meta.labels_per_unit != labels_per_unit
-                or meta.num_units != num_units
-                or meta.max_file_size != max_file_size):
-            raise ValueError(
-                "existing POST data directory was initialized with different "
-                "parameters; refusing to mix label sets")
-    else:
-        meta = PostMetadata(
-            node_id=node_id.hex(), commitment=commitment.hex(),
-            scrypt_n=scrypt_n, num_units=num_units,
-            labels_per_unit=labels_per_unit, max_file_size=max_file_size)
+    meta = open_or_create_meta(
+        dir_, node_id=node_id, commitment=commitment, num_units=num_units,
+        labels_per_unit=labels_per_unit, scrypt_n=scrypt_n,
+        max_file_size=max_file_size)
     init = Initializer(dir_, meta, batch_size=batch_size, progress=progress,
                        **pipeline_opts)
     res = init.run()

@@ -40,7 +40,6 @@ import dataclasses
 import functools
 import os
 import time
-from collections import deque
 from pathlib import Path
 
 import jax
@@ -49,6 +48,7 @@ import numpy as np
 
 from ..ops import pow as k2pow
 from ..ops import proving, proving_pallas, scrypt
+from ..runtime import engine
 from ..utils import metrics, tracing
 from .data import LabelStore, PostMetadata
 
@@ -198,16 +198,44 @@ class Prover:
     # -- entry points -------------------------------------------------------
 
     def prove(self, challenge: bytes) -> Proof:
-        pow_nonce = self._pow(challenge)
+        if self.pipelined:
+            session = self.session(challenge)
+            try:
+                while True:
+                    proof = session.step()
+                    if proof is not None:
+                        return proof
+            finally:
+                session.close()
         try:
-            if self.pipelined:
-                return self._prove_pipelined(challenge, pow_nonce)
-            return self._prove_serial(challenge, pow_nonce)
+            return self._prove_serial(challenge, self._pow(challenge))
         finally:
             # drop the store's cached read fds: PostClient builds a fresh
             # Prover per challenge, so a long-lived worker would otherwise
             # leak one fd per postdata file per proving session
             self.store.close()
+
+    def session(self, challenge: bytes, tenant: str = "-") -> "ProveSession":
+        """A resumable streaming prove: each ``step()`` is one quantum —
+        the k2pow gate first, then one nonce-window disk pass apiece —
+        so the multi-tenant scheduler can gang-schedule windows between
+        other tenants' work (runtime/scheduler.py). ``prove()`` is just
+        a session driven to completion."""
+        return ProveSession(self, challenge, tenant=tenant)
+
+    def _prove_pipelined(self, challenge: bytes, pow_nonce: int) -> Proof:
+        """Drive a session to completion with the pow gate pre-paid —
+        the bench/profiler comparator's entry (post/workload.py), which
+        measures the label scan without re-searching the pow per rep."""
+        session = self.session(challenge)
+        session.pow_nonce = pow_nonce
+        try:
+            while True:
+                proof = session.step()
+                if proof is not None:
+                    return proof
+        finally:
+            session.close()
 
     def prove_serial(self, challenge: bytes) -> Proof:
         """The legacy synchronous scan (read -> scan -> full-mask fetch ->
@@ -277,69 +305,6 @@ class Prover:
 
     # -- streaming pipeline -------------------------------------------------
 
-    def _prove_pipelined(self, challenge: bytes, pow_nonce: int) -> Proof:
-        meta, p = self.meta, self.params
-        t0 = time.monotonic()
-        thr = jnp.uint32(proving.threshold_u32(p.k1, meta.total_labels))
-        cw = jnp.asarray(proving.challenge_words(challenge))
-        mesh = self._resolve_mesh()
-        step = self._make_step(mesh)
-        stats = ProverStats()
-        self.last_stats = stats
-        window = self.nonce_group * self.window_groups
-        winner = None
-        max_nonce = MAX_GROUPS * self.nonce_group
-        psp = tracing.span("prove.run",
-                           {"challenge": challenge.hex()[:16],
-                            "labels": meta.total_labels}
-                           if tracing.is_enabled() else None)
-        psp.__enter__()
-        # liveness (obs/health.py): while a prove runs, the labels-swept
-        # counter must advance within the deadline or /readyz flips
-        from ..obs import health as health_mod
-
-        running = True
-        # progress must advance PER BATCH, not per window: labels_swept
-        # alone updates once per disk pass, and a healthy pass over a
-        # real store legitimately outlives the deadline (the window
-        # histogram buckets reach 600s) — a per-window counter would
-        # report every normal prove as stalled
-        prove_wd = health_mod.Watchdog(
-            "post.prove",
-            progress=lambda: (stats.batches, stats.labels_swept),
-            deadline_s=self.stall_deadline_s, active=lambda: running)
-        health_mod.HEALTH.register("post.prove", prove_wd.check)
-        try:
-            for base in range(0, max_nonce, window):
-                # clamp the last window to the serial prover's give-up
-                # bound so the two paths search the exact same nonce range
-                groups = min(self.window_groups,
-                             (max_nonce - base) // self.nonce_group)
-                tw = time.perf_counter()
-                winner, indices = self._scan_window(cw, thr, base, groups,
-                                                    step, mesh, stats)
-                metrics.post_prove_window_seconds.observe(
-                    time.perf_counter() - tw)
-                if winner is not None:
-                    break
-        finally:
-            running = False
-            health_mod.HEALTH.unregister("post.prove", prove_wd.check)
-            psp.__exit__(None, None, None)
-        stats.elapsed_s = time.monotonic() - t0
-        if stats.elapsed_s > 0:
-            metrics.post_prove_labels_per_sec.set(
-                stats.labels_swept / stats.elapsed_s)
-        for stage, secs in (("read", stats.read_wait_s),
-                            ("dispatch", stats.dispatch_s),
-                            ("retire", stats.retire_s)):
-            metrics.post_prove_stage_seconds.inc(secs, stage=stage)
-        if winner is None:
-            raise RuntimeError("no winning nonce found (k1/k2 mismatch?)")
-        metrics.proofs_generated.inc()
-        return Proof(nonce=winner, indices=indices, pow_nonce=pow_nonce,
-                     k2=p.k2)
-
     def _make_step(self, mesh):
         """Bind the scan-step backend ONCE per prove (no per-batch paths)."""
         ng, cap = self.nonce_group, max(self.params.k2, 1)
@@ -354,15 +319,18 @@ class Prover:
         return functools.partial(proving.prove_scan_step_jit,
                                  n_nonces=ng, max_hits=cap)
 
-    def _scan_window(self, cw, thr, nonce_base, groups, step, mesh, stats):
+    def _scan_window(self, cw, thr, nonce_base, groups, step, mesh, stats,
+                     tenant: str = "-"):
         """One disk pass over the store scanning ``groups`` nonce groups.
         Returns (winner_nonce, indices) or (None, None).
 
-        Under a trace capture the pass is one ``prove.window`` span and
-        every per-batch read/dispatch/retire span carries the SAME
-        ``window`` attribute (the pass's base nonce), so a timeline
-        groups a window's whole read→dispatch→retire ladder even when
-        batches from two windows interleave."""
+        The bounded read->dispatch->retire window is the shared runtime
+        engine's (runtime/engine.py); this method supplies the prove
+        callbacks. Under a trace capture the pass is one ``prove.window``
+        span and every per-batch read/dispatch/retire span carries the
+        SAME ``window`` attribute (the pass's base nonce), so a timeline
+        groups a window's whole ladder even when batches from two
+        windows interleave."""
         meta, p = self.meta, self.params
         total = meta.total_labels
         b = self.batch_labels
@@ -374,8 +342,6 @@ class Prover:
                             "labels": total} if traced else None)
         wsp.__enter__()
         reader = None
-        exited = False
-        retired_end = 0
         try:
             ranges = [(s, min(b, total - s)) for s in range(0, total, b)]
             states = []
@@ -387,61 +353,70 @@ class Prover:
                     carry = pmesh.replicate(mesh, carry)
                 states.append([counts, carry])
             host_counts = np.zeros(ng * groups, dtype=np.int64)
-            inflight: deque = deque()  # (scanned_end, [batch counts])
             reader = self.store.start_reader(ranges, self.readers,
                                              self.reader_queue)
             metrics.post_prove_windows.inc()
             stats.windows += 1
-            for start, count in ranges:
+            retired_end = [0]
+
+            def dispatch(item):
+                start, count = item
                 tr = time.perf_counter()
                 with tracing.span("prove.read_wait",
                                   {"window": nonce_base, "start": start}
                                   if traced else None):
                     raw = reader.get()
-                td = time.perf_counter()
-                stats.read_wait_s += td - tr
-                with tracing.span("prove.dispatch",
-                                  {"window": nonce_base, "start": start,
-                                   "count": count} if traced else None):
-                    labels = np.frombuffer(raw, dtype=np.uint8).reshape(
-                        count, scrypt.LABEL_BYTES)
-                    if count < b:  # pad-and-trim: one shape per pass
-                        labels = np.concatenate([
-                            labels,
-                            np.zeros((b - count, scrypt.LABEL_BYTES),
-                                     np.uint8)])
-                    idx = np.arange(start, start + b, dtype=np.uint64)
-                    lo, hi = scrypt.split_indices(idx)
-                    lw = scrypt.labels_to_words(labels)
-                    jlo, jhi, jlw = (jnp.asarray(lo), jnp.asarray(hi),
-                                     jnp.asarray(lw))
-                    bcs = []
-                    for g in range(groups):
-                        counts, carry = states[g]
-                        counts, bc, carry = step(
-                            cw, jnp.uint32(nonce_base + g * ng), jlo, jhi,
-                            jlw, thr, counts, carry, jnp.uint32(count),
-                            jnp.uint32(start & 0xFFFFFFFF),
-                            jnp.uint32(start >> 32))
-                        states[g] = [counts, carry]
-                        bcs.append(bc)
-                stats.dispatch_s += time.perf_counter() - td
+                stats.read_wait_s += time.perf_counter() - tr
+                labels = np.frombuffer(raw, dtype=np.uint8).reshape(
+                    count, scrypt.LABEL_BYTES)
+                if count < b:  # pad-and-trim: one shape per pass
+                    labels = np.concatenate([
+                        labels,
+                        np.zeros((b - count, scrypt.LABEL_BYTES),
+                                 np.uint8)])
+                idx = np.arange(start, start + b, dtype=np.uint64)
+                lo, hi = scrypt.split_indices(idx)
+                lw = scrypt.labels_to_words(labels)
+                jlo, jhi, jlw = (jnp.asarray(lo), jnp.asarray(hi),
+                                 jnp.asarray(lw))
+                bcs = []
+                for g in range(groups):
+                    counts, carry = states[g]
+                    counts, bc, carry = step(
+                        cw, jnp.uint32(nonce_base + g * ng), jlo, jhi,
+                        jlw, thr, counts, carry, jnp.uint32(count),
+                        jnp.uint32(start & 0xFFFFFFFF),
+                        jnp.uint32(start >> 32))
+                    states[g] = [counts, carry]
+                    bcs.append(bc)
+                # progress must advance PER BATCH, here in the callback
+                # — folding the engine's count in after the pass would
+                # freeze the liveness watchdog for the whole disk pass
+                # (ProveSession registers it on stats.batches)
                 stats.batches += 1
                 metrics.post_prove_batches.inc()
-                inflight.append((start + count, bcs))
-                if len(inflight) >= self.inflight:
-                    item = inflight.popleft()
-                    retired_end = item[0]
-                    exited = self._retire(item, host_counts, total, stats,
-                                          nonce_base)
-                    if exited:
-                        break
-            while not exited and inflight:
-                item = inflight.popleft()
-                retired_end = item[0]
-                exited = self._retire(item, host_counts, total, stats,
-                                      nonce_base)
-            scanned = retired_end if exited else total
+                return start + count, bcs
+
+            def retire(ticket):
+                retired_end[0] = ticket[0]
+                if self._retire(ticket, host_counts, total, stats,
+                                nonce_base):
+                    return ticket[0]  # sound early exit: scanned_end
+                return None
+
+            pipe = engine.Pipeline(
+                kind="prove", tenant=tenant, inflight=self.inflight,
+                span="prove",
+                attrs=lambda it: {"window": nonce_base, "start": it[0],
+                                  "count": it[1]})
+            rw0 = stats.read_wait_s
+            res = pipe.run(ranges, dispatch, retire)
+            exited = res is not None
+            # the engine's dispatch stage wraps the whole callback; keep
+            # the historical read-wait vs dispatch split in the stats
+            stats.dispatch_s += max(
+                pipe.stats.dispatch_s - (stats.read_wait_s - rw0), 0.0)
+            scanned = retired_end[0] if exited else total
         finally:
             if reader is not None:
                 reader.close()
@@ -495,3 +470,117 @@ class Prover:
                              "scanned": scanned_end}
                             if tracing.is_enabled() else None)
         return exit_now
+
+
+class ProveSession:
+    """One resumable streaming prove over an initialized store.
+
+    ``step()`` runs exactly one quantum — the k2pow gate on the first
+    call, then one nonce-window disk pass per call — and returns the
+    Proof once decided (None until then).  The multi-tenant scheduler
+    gang-schedules these quanta between tenants (runtime/scheduler.py);
+    ``Prover.prove`` drives a session to completion inline.  ``close()``
+    is idempotent and must run on every path: it unregisters the
+    liveness watchdog, finalizes the stats/metrics, and drops the
+    store's cached read fds (the PR 3 fd-leak class).
+    """
+
+    def __init__(self, prover: Prover, challenge: bytes, tenant: str = "-"):
+        self.prover = prover
+        self.challenge = challenge
+        self.tenant = tenant
+        self.stats = ProverStats()
+        prover.last_stats = self.stats
+        self.pow_nonce: int | None = None
+        self.proof: Proof | None = None
+        self._t0 = time.monotonic()
+        self._base = 0
+        self._max_nonce = MAX_GROUPS * prover.nonce_group
+        self._prep = None
+        self._closed = False
+        self._scanning = False
+        self._span = tracing.span(
+            "prove.run",
+            {"challenge": challenge.hex()[:16],
+             "labels": prover.meta.total_labels, "tenant": tenant}
+            if tracing.is_enabled() else None)
+        self._span.__enter__()  # spacecheck: ok=SC004 session-lifecycle span; ProveSession.close() exits it on every path (prove()'s finally, the scheduler's abort hook)
+        # liveness (obs/health.py): while the session runs, progress must
+        # advance PER BATCH, not per window — a healthy disk pass can
+        # legitimately outlive the deadline (the window histogram buckets
+        # reach 600s), so a per-window counter would false-stall every
+        # realistic prove
+        from ..obs import health as health_mod
+
+        # active only WHILE a window scan runs (the historical scope:
+        # the old code registered after the k2pow gate) — a session
+        # parked between scheduler quanta, or one searching pow, has no
+        # batch counter to advance and must not read as stalled
+        self._wd = health_mod.Watchdog(
+            "post.prove",
+            progress=lambda: (self.stats.batches, self.stats.labels_swept),
+            deadline_s=prover.stall_deadline_s,
+            active=lambda: self._scanning)
+        health_mod.HEALTH.register("post.prove", self._wd.check)
+
+    @property
+    def done(self) -> bool:
+        return self.proof is not None
+
+    def step(self) -> Proof | None:
+        if self._closed:
+            raise RuntimeError("prove session is closed")
+        if self.proof is not None:
+            return self.proof
+        p = self.prover
+        if self.pow_nonce is None:
+            self.pow_nonce = p._pow(self.challenge)
+            return None
+        if self._prep is None:
+            thr = jnp.uint32(proving.threshold_u32(
+                p.params.k1, p.meta.total_labels))
+            cw = jnp.asarray(proving.challenge_words(self.challenge))
+            mesh = p._resolve_mesh()
+            self._prep = (cw, thr, mesh, p._make_step(mesh))
+        cw, thr, mesh, stepfn = self._prep
+        if self._base >= self._max_nonce:
+            raise RuntimeError("no winning nonce found (k1/k2 mismatch?)")
+        # clamp the last window to the serial prover's give-up bound so
+        # the two paths search the exact same nonce range
+        groups = min(p.window_groups,
+                     (self._max_nonce - self._base) // p.nonce_group)
+        tw = time.perf_counter()
+        self._scanning = True
+        try:
+            winner, indices = p._scan_window(cw, thr, self._base, groups,
+                                             stepfn, mesh, self.stats,
+                                             tenant=self.tenant)
+        finally:
+            self._scanning = False
+        metrics.post_prove_window_seconds.observe(time.perf_counter() - tw)
+        self._base += groups * p.nonce_group
+        if winner is None:
+            return None
+        metrics.proofs_generated.inc()
+        self.proof = Proof(nonce=winner, indices=indices,
+                           pow_nonce=self.pow_nonce, k2=p.params.k2)
+        return self.proof
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        from ..obs import health as health_mod
+
+        health_mod.HEALTH.unregister("post.prove", self._wd.check)
+        self._span.__exit__(None, None, None)
+        stats = self.stats
+        stats.elapsed_s = time.monotonic() - self._t0
+        if stats.elapsed_s > 0:
+            metrics.post_prove_labels_per_sec.set(
+                stats.labels_swept / stats.elapsed_s)
+        for stage, secs in (("read", stats.read_wait_s),
+                            ("dispatch", stats.dispatch_s),
+                            ("retire", stats.retire_s)):
+            metrics.post_prove_stage_seconds.inc(secs, stage=stage)
+        self.prover.store.close()

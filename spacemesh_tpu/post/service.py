@@ -69,6 +69,17 @@ class PostClient:
                             batch_labels=self._batch, **self._prove_opts)
             return prover.prove(challenge), prover.meta
 
+    def submit_proof(self, scheduler, tenant: str, challenge: bytes):
+        """Route this identity's prove through the multi-tenant runtime
+        scheduler instead of owning a thread: returns the JobHandle
+        (per-identity job id; fair-share + gang-scheduled windows —
+        runtime/scheduler.py). The one-session-per-identity contract is
+        the scheduler's per-tenant FIFO here, not the thread lock."""
+        return scheduler.submit_prove(tenant, self.data_dir, challenge,
+                                      self.params,
+                                      batch_labels=self._batch,
+                                      **self._prove_opts)
+
 
 class PostService:
     """Worker-side registry of identities -> clients (the `Register`

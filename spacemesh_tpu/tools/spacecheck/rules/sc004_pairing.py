@@ -30,6 +30,19 @@ teardown runs through fixtures):
   ``finally`` or managed by ``with``; escaping the function (returned,
   stored on an attribute, passed to another call) hands the lifecycle
   elsewhere and is accepted.
+* **runtime job handles** — a ``<scheduler>.submit_init/submit_prove/
+  submit_verify/submit_pow/submit_call/submit_proof(...)`` JobHandle
+  bound to a local must be CONSUMED (``.result()``/``.wait()``
+  anywhere) or ``.cancel()``ed under ``finally``, or escape — the
+  defect class the runtime deleted from four pipelines must not
+  re-enter through its own submission API (an orphaned handle is a job
+  whose failure nobody observes and whose tenant quota slot pins until
+  resolution).
+* **tenant registration** — ``<scheduler>.register_tenant(...)``
+  pairs with ``unregister_tenant`` exactly like the HEALTH probes: in
+  a ``finally`` in the same function, or in a sibling method of the
+  same class (the long-lived component split); a gone identity must
+  not pin its per-tenant gauge series and fair-share state forever.
 
 Suppress a deliberate unpaired site with ``# spacecheck: ok=SC004 <why>``.
 """
@@ -44,6 +57,9 @@ RULE = "SC004"
 
 _FUNCS = (ast.FunctionDef, ast.AsyncFunctionDef)
 _ACQUIRE_FACTORIES = {"ThreadPoolExecutor", "ProcessPoolExecutor"}
+_SUBMITS = {"submit_init", "submit_prove", "submit_verify", "submit_pow",
+            "submit_call", "submit_proof"}
+_HANDLE_CONSUME = {"result", "wait"}
 
 
 def _is_health_recv(recv: str | None) -> bool:
@@ -126,6 +142,8 @@ def check(ctx: FileContext, project: ProjectInfo) -> list[Finding]:
         cm_method = fn.name in _CM_DUNDERS
         registers: list[ast.Call] = []
         unregisters: list[ast.Call] = []
+        t_registers: list[ast.Call] = []
+        t_unregisters: list[ast.Call] = []
         enters: dict[str, ast.Call] = {}
         exits: dict[str, list[int]] = {}
         for call in calls:
@@ -137,6 +155,10 @@ def check(ctx: FileContext, project: ProjectInfo) -> list[Finding]:
                 registers.append(call)
             elif func.attr == "unregister" and _is_health_recv(recv):
                 unregisters.append(call)
+            elif func.attr == "register_tenant":
+                t_registers.append(call)
+            elif func.attr == "unregister_tenant":
+                t_unregisters.append(call)
             elif func.attr == "__enter__" and recv and not cm_method:
                 enters[recv] = call
             elif func.attr == "__exit__" and recv:
@@ -171,6 +193,28 @@ def check(ctx: FileContext, project: ProjectInfo) -> list[Finding]:
                     "function or its class: a finished component pins "
                     "its probe (and its component_healthy series) "
                     "forever"))
+        for call in t_registers:
+            if any(_in_finally(spans, u.lineno) for u in t_unregisters):
+                continue
+            if t_unregisters:
+                findings.append(ctx.finding(
+                    RULE, call,
+                    "register_tenant here but the unregister_tenant in "
+                    "this function is not under finally: the exception "
+                    "path pins the tenant's fair-share state and gauge "
+                    "series"))
+                continue
+            sib = siblings.get(id(fn), [])
+            paired = any(
+                isinstance(c.func, ast.Attribute)
+                and c.func.attr == "unregister_tenant"
+                for m in sib for c in _calls_in(m) if m is not fn)
+            if not paired:
+                findings.append(ctx.finding(
+                    RULE, call,
+                    "register_tenant without any unregister_tenant in "
+                    "this function or its class: a gone identity pins "
+                    "its per-tenant series and scheduler state forever"))
         for recv, call in enters.items():
             ok = any(_in_finally(spans, ln) and ln > call.lineno
                      for ln in exits.get(recv, []))
@@ -180,7 +224,71 @@ def check(ctx: FileContext, project: ProjectInfo) -> list[Finding]:
                     f"{recv}.__enter__() without a matching "
                     f"{recv}.__exit__() under finally: the error path "
                     "leaks the span/context"))
+        _check_job_handles(fn, spans)
         _check_local_resources(fn, spans)
+
+    def _check_job_handles(fn, spans) -> None:
+        """Runtime scheduler submits: a JobHandle bound to a local must
+        be consumed (.result()/.wait() anywhere), cancelled under
+        finally, or escape the function."""
+        handles: dict[str, ast.Assign] = {}
+        nodes = _scoped(fn)
+        for node in nodes:
+            if isinstance(node, ast.Assign) \
+                    and isinstance(node.value, ast.Call) \
+                    and isinstance(node.value.func, ast.Attribute) \
+                    and node.value.func.attr in _SUBMITS \
+                    and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                handles[node.targets[0].id] = node
+        if not handles:
+            return
+        resolved: set[str] = set()
+        escapes: set[str] = set()
+        callfuncs = {id(n.func) for n in nodes if isinstance(n, ast.Call)}
+        for node in nodes:
+            if isinstance(node, ast.Call):
+                f = node.func
+                if isinstance(f, ast.Attribute) \
+                        and isinstance(f.value, ast.Name) \
+                        and f.value.id in handles:
+                    if f.attr in _HANDLE_CONSUME:
+                        resolved.add(f.value.id)
+                    elif f.attr == "cancel" \
+                            and _in_finally(spans, node.lineno):
+                        resolved.add(f.value.id)
+                    continue
+                for arg in list(node.args) + [k.value
+                                              for k in node.keywords]:
+                    if isinstance(arg, ast.Name) and arg.id in handles:
+                        escapes.add(arg.id)
+            elif isinstance(node, ast.Return) \
+                    and isinstance(node.value, ast.Name) \
+                    and node.value.id in handles:
+                escapes.add(node.value.id)
+            elif isinstance(node, ast.Assign) \
+                    and isinstance(node.value, ast.Name) \
+                    and node.value.id in handles:
+                escapes.add(node.value.id)
+            elif isinstance(node, ast.Attribute) \
+                    and id(node) not in callfuncs \
+                    and isinstance(node.value, ast.Name) \
+                    and node.value.id in handles \
+                    and isinstance(node.ctx, ast.Load) \
+                    and node.attr not in ("id", "tenant", "kind"):
+                # reading .future hands the lifecycle elsewhere
+                # (asyncio.wrap_future, job tables)
+                escapes.add(node.value.id)
+        for name, stmt in handles.items():
+            if name in resolved or name in escapes:
+                continue
+            findings.append(ctx.finding(
+                RULE, stmt,
+                f"runtime job handle {name!r} is never consumed "
+                "(.result()/.wait()), never cancelled under finally, "
+                "and never escapes: an orphaned job's failure is "
+                "unobserved and its tenant quota slot pins until it "
+                "resolves"))
 
     def _check_local_resources(fn, spans) -> None:
         assigned: dict[str, ast.Assign] = {}  # local name -> acquire stmt

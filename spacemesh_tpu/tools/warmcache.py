@@ -26,10 +26,18 @@ leaves BOTH caches — executables and winners — ready. Shapes already in
 the cache deserialize in well under a second; the per-shape ``compile_s``
 in the output tells you which were actually cold.
 
+Beyond the label shapes, every workload kind registered with the device
+runtime (runtime/workloads.py: fused init, packed multi-tenant init,
+prove scan step, verify batch, k2pow) warms its own executables at the
+primary shape — so a cold 16-tenant start pays ZERO serialized compiles
+across kinds (the runtime scheduler's first mixed admission hits a warm
+cache for every kind it can dispatch).  ``--no-runtime`` skips that.
+
 Usage:
   python -m spacemesh_tpu.tools.warmcache [--n 8192]
       [--batches 8192,4096,2048,1024,512] [--prove] [--no-mesh]
-      [--no-probe] [--cached-shapes]
+      [--no-probe] [--cached-shapes] [--no-runtime]
+      [--pack-lanes 4096]
   python -m spacemesh_tpu.tools.profiler --warm      # same, via profiler
 
 ``--cached-shapes`` additionally warms every shape that already has a
@@ -145,9 +153,35 @@ def _warm_prove(batch: int) -> dict:
     return {"batch": b, "nonce_group": ng, "compile_s": dt}
 
 
+def _warm_runtime_kinds(n: int, batch: int, pack_lanes: int) -> dict:
+    """Warm every registered runtime workload kind's executables.
+
+    The packed init / verify kinds warm at the PACK bucket (the shape
+    the multi-tenant scheduler composes), the rest at the session
+    ``batch``; each kind's recipe lives beside the kind itself
+    (runtime/workloads.py), so a new workload registered there is
+    automatically covered here and by the CI warm-cache job.
+    """
+    from ..ops import scrypt
+    from ..runtime import workloads
+
+    pack = scrypt.shape_bucket(pack_lanes)
+    out: dict = {}
+    for kind in workloads.registered():
+        b = pack if kind.name in ("init_pack", "verify") else batch
+        _log(f"warming runtime kind {kind.name} (n={n} b={b}) ...")
+        try:
+            out[kind.name] = dict(kind.warm(n, b), batch=b)
+        except Exception as e:  # noqa: BLE001 — e.g. OOM at big batches
+            _log(f"  {kind.name} failed ({type(e).__name__}: {e})")
+            out[kind.name] = {"failed": type(e).__name__}
+    return out
+
+
 def warm(n: int = 8192, batches: list[int] | None = None, *,
          mesh: bool = True, prove: bool = False,
-         cached_shapes: bool = False, probe: bool = True) -> dict:
+         cached_shapes: bool = False, probe: bool = True,
+         runtime_kinds: bool = True, pack_lanes: int = 4096) -> dict:
     """Warm the persistent caches; returns a JSON-able report."""
     import os
 
@@ -191,6 +225,11 @@ def warm(n: int = 8192, batches: list[int] | None = None, *,
     }
     if prove:
         doc["prove"] = _warm_prove(1 << 14)
+    if runtime_kinds:
+        primary = scrypt.shape_bucket(
+            (batches or [8192])[0]) if batches else 8192
+        doc["runtime_kinds"] = _warm_runtime_kinds(n, primary, pack_lanes)
+        doc["elapsed_s"] = round(time.perf_counter() - t0, 1)
     return doc
 
 
@@ -211,10 +250,17 @@ def main(argv=None) -> int:
                     "autotune winner on this host")
     ap.add_argument("--no-probe", action="store_true",
                     help="skip the accelerator liveness probe (tests)")
+    ap.add_argument("--no-runtime", action="store_true",
+                    help="skip the registered runtime workload kinds "
+                    "(fused/packed init, prove scan, verify, k2pow)")
+    ap.add_argument("--pack-lanes", type=int, default=4096,
+                    help="pack bucket for the multi-tenant init/verify "
+                    "kind warms (runtime/scheduler.py pack_lanes)")
     a = ap.parse_args(argv)
     doc = warm(a.n, [int(b) for b in a.batches.split(",") if b],
                mesh=not a.no_mesh, prove=a.prove,
-               cached_shapes=a.cached_shapes, probe=not a.no_probe)
+               cached_shapes=a.cached_shapes, probe=not a.no_probe,
+               runtime_kinds=not a.no_runtime, pack_lanes=a.pack_lanes)
     print(json.dumps(doc, indent=2))
     return 0
 

@@ -331,6 +331,52 @@ post_prove_labels_per_sec = REGISTRY.gauge(
 post_prove_inflight = REGISTRY.gauge(
     "post_prove_inflight", "proving sessions currently running (grpc worker)")
 
+# device-job runtime (spacemesh_tpu/runtime/): the shared
+# submit->batch->dispatch->retire engine all four device pipelines run
+# on, plus the multi-tenant scheduler on top. Every series carries the
+# workload `kind`; per-identity series carry `tenant` ("-" when the
+# embedder is single-tenant).
+runtime_dispatched = REGISTRY.counter(
+    "runtime_batches_dispatched_total",
+    "device batches dispatched through the runtime engine "
+    "(labels: kind, tenant)")
+runtime_retired = REGISTRY.counter(
+    "runtime_batches_retired_total",
+    "device batches retired (results consumed) (labels: kind, tenant)")
+runtime_inflight = REGISTRY.gauge(
+    "runtime_inflight_batches",
+    "device batches currently in flight (label: kind)")
+runtime_stage_seconds = REGISTRY.counter(
+    "runtime_stage_seconds_total",
+    "host seconds per engine stage (labels: kind, stage)")
+runtime_fallbacks = REGISTRY.counter(
+    "runtime_fallbacks_total",
+    "dispatch failures absorbed by a workload's device-failure "
+    "fallback (label: kind)")
+runtime_tenant_jobs = REGISTRY.counter(
+    "runtime_tenant_jobs_total",
+    "scheduler jobs by outcome (labels: tenant, kind, state)")
+runtime_tenant_queued = REGISTRY.gauge(
+    "runtime_tenant_queued_jobs",
+    "jobs queued per tenant in the scheduler (label: tenant)")
+runtime_tenant_labels = REGISTRY.counter(
+    "runtime_tenant_labels_total",
+    "init labels computed+written through the scheduler (label: tenant)")
+runtime_pack_occupancy = REGISTRY.histogram(
+    "runtime_pack_occupancy_lanes",
+    "lanes per packed multi-tenant init dispatch",
+    buckets=(64, 128, 256, 512, 1024, 2048, 4096, 8192, float("inf")))
+runtime_pack_tenants = REGISTRY.histogram(
+    "runtime_pack_tenants",
+    "distinct tenants per packed init dispatch",
+    buckets=(1, 2, 4, 8, 16, 32, float("inf")))
+runtime_quantum_seconds = REGISTRY.counter(
+    "runtime_quantum_seconds_total",
+    "worker seconds per scheduler quantum (labels: kind, tenant)")
+runtime_deadline_boosts = REGISTRY.counter(
+    "runtime_deadline_boosts_total",
+    "quanta admitted by deadline (EDF) ahead of fair-share order")
+
 # verification farm (verify/farm.py): the micro-batching admission
 # service for signatures / VRFs / POST proofs / poet membership.
 verify_farm_requests = REGISTRY.counter(

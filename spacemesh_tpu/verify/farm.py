@@ -43,16 +43,17 @@ import dataclasses
 import enum
 import os
 import time
-from collections import deque
+
 from typing import Optional
 
 from ..core.signing import EdVerifier, VrfVerifier
 from ..post import verifier as post_verifier
 from ..post.prover import ProofParams
+from ..runtime.queue import KindLanes, LaneGroup, QueueClosed
 from ..utils import metrics, tracing
 
 
-class FarmClosed(RuntimeError):
+class FarmClosed(QueueClosed):
     """The farm was shut down while (or before) the request was pending."""
 
 
@@ -146,29 +147,14 @@ class _Pending:
 
 
 class _KindState:
-    """Per-kind scheduler state: one deque per lane + arrival signal."""
+    """Per-kind scheduler state: the runtime's per-lane deques
+    (runtime/queue.py KindLanes) + arrival signal + in-flight tasks."""
 
-    def __init__(self) -> None:
-        self.lanes: dict[Lane, deque[_Pending]] = {
-            lane: deque() for lane in Lane}
+    def __init__(self, group: LaneGroup) -> None:
+        self.lanes = KindLanes(group)
         self.arrived = asyncio.Event()
         self.inflight: set[asyncio.Task] = set()
         self.worker: Optional[asyncio.Task] = None
-
-    def count(self) -> int:
-        return sum(len(q) for q in self.lanes.values())
-
-    def earliest_deadline(self) -> float:
-        return min(q[0].deadline for q in self.lanes.values() if q)
-
-    def take(self, limit: int) -> list[_Pending]:
-        """Drain up to ``limit`` requests, highest-priority lanes first."""
-        batch: list[_Pending] = []
-        for lane in Lane:
-            q = self.lanes[lane]
-            while q and len(batch) < limit:
-                batch.append(q.popleft())
-        return batch
 
 
 # default coalescing windows per lane (the ISSUE's 2-10 ms band): block
@@ -217,17 +203,19 @@ class VerificationFarm:
         self._sig_threads = sig_threads
         self._pool = None  # lazy ThreadPoolExecutor for sig/vrf fan-out
         self._loop: asyncio.AbstractEventLoop | None = None
-        self._kinds: dict[str, _KindState] = {}
-        self._lane_count: dict[Lane, int] = {lane: 0 for lane in Lane}
-        self._lane_waiters: dict[Lane, deque[asyncio.Future]] = {
-            lane: deque() for lane in Lane}
-        self._dedup: dict[tuple, _Pending] = {}
-        self._closed = False
         self.stats = {
             "requests": 0, "dedup_hits": 0, "batches": 0, "items": 0,
             "max_occupancy": 0, "dispatch_s": 0.0, "rejected": 0,
             "queue_peak": {lane.name.lower(): 0 for lane in Lane},
         }
+        # lane accounting (bounds, backpressure waiters with the slot
+        # handoff, dedup) is the shared runtime's (runtime/queue.py);
+        # this farm keeps only the coalescing policy and the backends
+        self._group = LaneGroup(Lane, self.lane_bounds,
+                                make_exc=lambda: FarmClosed("farm closed"),
+                                on_depth=self._on_depth)
+        self._kinds: dict[str, _KindState] = {}
+        self._closed = False
         # liveness contract (obs/health.py): while ANY lane holds queued
         # requests, the dispatched-item counter must advance within the
         # deadline — a wedged backend thread or a dead worker task shows
@@ -237,9 +225,15 @@ class VerificationFarm:
         self._watchdog = health_mod.Watchdog(
             "verify.farm",
             progress=lambda: self.stats["items"],
-            active=lambda: sum(self._lane_count.values()) > 0,
+            active=lambda: self._group.total() > 0,
             deadline_s=stall_deadline_s)
         health_mod.HEALTH.register("verify.farm", self._watchdog.check)
+
+    def _on_depth(self, lane: Lane, depth: int) -> None:
+        lname = lane.name.lower()
+        metrics.verify_farm_queue_depth.set(depth, lane=lname)
+        if depth > self.stats["queue_peak"][lname]:
+            self.stats["queue_peak"][lname] = depth
 
     # --- lifecycle ----------------------------------------------------
 
@@ -248,13 +242,10 @@ class VerificationFarm:
         outlives an asyncio.run() rebinds on the next submit (pending
         work from the dead loop is unrecoverable and dropped)."""
         loop = asyncio.get_running_loop()
-        if self._loop is loop:
+        if not self._group.bind(loop):
             return
         self._loop = loop
-        self._kinds = {kind: _KindState() for kind in KINDS}
-        self._lane_count = {lane: 0 for lane in Lane}
-        self._lane_waiters = {lane: deque() for lane in Lane}
-        self._dedup = {}
+        self._kinds = {kind: _KindState(self._group) for kind in KINDS}
 
     def _ensure_worker(self, kind: str) -> None:
         st = self._kinds[kind]
@@ -266,20 +257,15 @@ class VerificationFarm:
         FarmClosed (the bound loop must still be alive)."""
         for st in self._kinds.values():
             st.arrived.set()
-            for q in st.lanes.values():
-                while q:
-                    p = q.popleft()
-                    if not p.future.done():
-                        p.future.set_exception(FarmClosed("farm closed"))
-        for waiters in self._lane_waiters.values():
-            while waiters:
-                w = waiters.popleft()
-                if not w.done():
-                    w.set_exception(FarmClosed("farm closed"))
+            for p in st.lanes.drain_all():
+                if not p.future.done():
+                    p.future.set_exception(FarmClosed("farm closed"))
+        self._group.fail_waiters()
 
     async def aclose(self) -> None:
         """Stop workers and fail pending requests with FarmClosed."""
         self._closed = True
+        self._group.closed = True
         workers = [st.worker for st in self._kinds.values()
                    if st.worker is not None]
         for w in workers:
@@ -299,6 +285,7 @@ class VerificationFarm:
         awaiting submit() hang forever (only aclose() would otherwise
         resolve them)."""
         self._closed = True
+        self._group.closed = True
         for st in self._kinds.values():
             if st.worker is not None:
                 try:
@@ -326,7 +313,7 @@ class VerificationFarm:
         metrics.verify_farm_requests.inc(kind=req.kind,
                                          lane=lane.name.lower())
         key = req.key()
-        ent = self._dedup.get(key)
+        ent = self._group.dedup.get(key)
         if ent is not None and not ent.future.done():
             self.stats["dedup_hits"] += 1
             metrics.verify_farm_dedup_hits.inc()
@@ -348,47 +335,23 @@ class VerificationFarm:
                           if tracing.is_enabled() else None)
         with sp:
             # backpressure: a full lane blocks ITS OWN submitters only
-            if self._lane_count[lane] >= self.lane_bounds[lane]:
+            # (the waiter/slot-handoff semantics live in
+            # runtime/queue.py LaneGroup.acquire — the ONE copy)
+            if self._group.count(lane) >= self.lane_bounds[lane]:
                 async with tracing.span("farm.lane_wait",
                                         {"lane": lane.name.lower()}
                                         if tracing.is_enabled() else None):
-                    await self._wait_for_lane(lane)
+                    await self._group.acquire(lane)
             now = self._loop.time()
             pend = _Pending(req, lane, self._loop.create_future(), now,
                             now + self.max_wait_s[lane])
             pend.span = sp
             st = self._kinds[req.kind]
-            st.lanes[lane].append(pend)
-            self._lane_count[lane] += 1
-            depth = self._lane_count[lane]
-            lname = lane.name.lower()
-            if depth > self.stats["queue_peak"][lname]:
-                self.stats["queue_peak"][lname] = depth
-            metrics.verify_farm_queue_depth.set(depth, lane=lname)
-            self._dedup[key] = pend
+            st.lanes.append(pend)
+            self._group.dedup[key] = pend
             self._ensure_worker(req.kind)
             st.arrived.set()
             return await self._await(pend.future)
-
-    async def _wait_for_lane(self, lane: Lane) -> None:
-        while self._lane_count[lane] >= self.lane_bounds[lane]:
-            waiter = self._loop.create_future()
-            self._lane_waiters[lane].append(waiter)
-            try:
-                await waiter
-            except asyncio.CancelledError:
-                try:
-                    self._lane_waiters[lane].remove(waiter)
-                except ValueError:
-                    # already popped by _release_lane: it granted us a
-                    # slot we will never use — hand the wakeup to the
-                    # next waiter, or the freed slot is silently lost
-                    # and survivors can park forever on a drained lane
-                    if waiter.done() and not waiter.cancelled():
-                        self._wake_next(lane)
-                raise
-            if self._closed:
-                raise FarmClosed("farm closed")
 
     @staticmethod
     async def _await(fut: asyncio.Future) -> bool:
@@ -408,7 +371,7 @@ class VerificationFarm:
         try:
             while not self._closed:
                 st.arrived.clear()
-                if st.count() == 0:
+                if st.lanes.count() == 0:
                     await st.arrived.wait()
                     continue
                 # one loop turn so same-tick submitters (gather bursts)
@@ -417,7 +380,7 @@ class VerificationFarm:
                 await self._coalesce(st)
                 if self._closed:
                     break
-                batch = st.take(self.max_batch)
+                batch = st.lanes.take(self.max_batch)
                 if not batch:
                     continue
                 self._on_taken(batch)
@@ -437,7 +400,7 @@ class VerificationFarm:
         pending BLOCK request bypasses the cap, so a saturated sync lane
         can never delay block-critical dispatch beyond its deadline."""
         while not self._closed:
-            n = st.count()
+            n = st.lanes.count()
             if n == 0:
                 return
             # the in-flight cap gates EVERY dispatch (a full batch too:
@@ -446,17 +409,18 @@ class VerificationFarm:
             # included — would queue behind sleeping threads). Only a
             # pending BLOCK request bypasses the cap.
             can_go = (len(st.inflight) < self.max_inflight
-                      or bool(st.lanes[Lane.BLOCK]))
+                      or bool(st.lanes.lanes[Lane.BLOCK]))
             if can_go and (n >= self.max_batch
                            or not st.inflight
-                           or st.earliest_deadline() <= self._loop.time()):
+                           or st.lanes.earliest_deadline()
+                           <= self._loop.time()):
                 return
             st.arrived.clear()
             arr = self._loop.create_task(st.arrived.wait())
             waits = {arr} | set(st.inflight)
             # dispatch-eligible: sleep at most until the deadline;
             # capped: sleep until a slot frees or something arrives
-            timeout = max(st.earliest_deadline() - self._loop.time(),
+            timeout = max(st.lanes.earliest_deadline() - self._loop.time(),
                           0.0005) if can_go else None
             await asyncio.wait(waits, timeout=timeout,
                                return_when=asyncio.FIRST_COMPLETED)
@@ -466,40 +430,18 @@ class VerificationFarm:
         """Move a still-queued pending entry to a higher-priority lane
         (dedup hit from that lane); no-op once it is in a dispatch."""
         st = self._kinds[ent.req.kind]
-        try:
-            st.lanes[ent.lane].remove(ent)
-        except ValueError:
+        if not st.lanes.remove(ent):
             return  # already taken into a batch
-        self._release_lane(ent.lane)
         ent.lane = lane
         ent.deadline = min(ent.deadline,
                            self._loop.time() + self.max_wait_s[lane])
-        st.lanes[lane].append(ent)
-        self._lane_count[lane] += 1
-        metrics.verify_farm_queue_depth.set(self._lane_count[lane],
-                                            lane=lane.name.lower())
+        st.lanes.append(ent)
         st.arrived.set()
-
-    def _release_lane(self, lane: Lane) -> None:
-        self._lane_count[lane] -= 1
-        metrics.verify_farm_queue_depth.set(self._lane_count[lane],
-                                            lane=lane.name.lower())
-        self._wake_next(lane)
-
-    def _wake_next(self, lane: Lane) -> None:
-        """Grant a freed lane slot to the next live backpressure waiter
-        (woken submitters re-check the bound in submit's while loop)."""
-        waiters = self._lane_waiters[lane]
-        while waiters and self._lane_count[lane] < self.lane_bounds[lane]:
-            w = waiters.popleft()
-            if not w.done():
-                w.set_result(None)
-                return
 
     def _on_taken(self, batch: list[_Pending]) -> None:
         now = self._loop.time()
         for p in batch:
-            self._release_lane(p.lane)
+            self._group.release(p.lane)
             wait = max(now - p.enqueued, 0.0)
             metrics.verify_farm_queue_wait_seconds.observe(
                 wait, kind=p.req.kind)
@@ -534,8 +476,8 @@ class VerificationFarm:
         finally:
             dt = time.perf_counter() - t0
             for p in batch:
-                if self._dedup.get(p.req.key()) is p:
-                    del self._dedup[p.req.key()]
+                if self._group.dedup.get(p.req.key()) is p:
+                    del self._group.dedup[p.req.key()]
             self.stats["batches"] += 1
             self.stats["items"] += len(batch)
             if len(batch) > self.stats["max_occupancy"]:

@@ -356,6 +356,69 @@ def test_sc004_local_fd_and_executor(tmp_path):
     assert {f.snippet.split(" =")[0] for f in fs} == {"f", "pool"}
 
 
+def test_sc004_runtime_job_handles(tmp_path):
+    """ISSUE 11: the defect class the runtime deleted must not re-enter
+    through its own API — an orphaned JobHandle is flagged; consumed,
+    finally-cancelled, and escaping handles are not."""
+    fs = run_fixture(tmp_path, "spacemesh_tpu/post/jobs.py", """
+        def bad(sched, d):
+            h = sched.submit_init("t", d, node_id=b"", commitment=b"",
+                                  num_units=1, labels_per_unit=1)
+            do_other_work()      # h never consumed: failure unobserved
+
+        def good_result(sched, d):
+            h = sched.submit_prove("t", d, b"ch")
+            return h.result(timeout=60)
+
+        def good_cancel(sched, d):
+            h = sched.submit_verify("t", [])
+            try:
+                poll()
+            finally:
+                h.cancel()
+
+        def bad_cancel_off_finally(sched, d):
+            h = sched.submit_pow("t", b"c", b"n", b"d")
+            poll()               # raises -> cancel skipped, job orphaned
+            h.cancel()
+
+        def good_escape(sched, jobs):
+            h = sched.submit_call("t", work)
+            jobs.append(h)       # tracked elsewhere
+
+        def good_future_escape(sched, wrap):
+            h = sched.submit_call("t", work)
+            return wrap(h.future)
+    """, select="SC004")
+    assert len(fs) == 2
+    assert all("job handle" in f.message for f in fs)
+    assert {f.snippet.split(" =")[0] for f in fs} == {"h"}
+
+
+def test_sc004_register_tenant_pairing(tmp_path):
+    fs = run_fixture(tmp_path, "spacemesh_tpu/post/tenants.py", """
+        def bad(sched):
+            sched.register_tenant("alice")
+            serve()
+
+        def good_finally(sched):
+            sched.register_tenant("bob")
+            try:
+                serve()
+            finally:
+                sched.unregister_tenant("bob")
+
+        class Worker:
+            def start(self, sched):
+                sched.register_tenant("carol")
+
+            def stop(self, sched):
+                sched.unregister_tenant("carol")
+    """, select="SC004")
+    assert len(fs) == 1 and "register_tenant" in fs[0].message
+    assert fs[0].line == 3
+
+
 # --- SC005 metrics hygiene ----------------------------------------------
 
 

@@ -279,15 +279,18 @@ def test_cancelled_waiter_hands_freed_slot_to_next():
         farm = VerificationFarm()
         assert await farm.submit(_sig_reqs(1, salt=b"w0")[0]) is True
         lane = Lane.SYNC
-        farm._lane_count[lane] = farm.lane_bounds[lane]  # lane "full"
+        # the lane accounting lives in the shared runtime queue now
+        # (runtime/queue.py LaneGroup) — same semantics, one copy
+        group = farm._group
+        group._count[lane] = farm.lane_bounds[lane]  # lane "full"
         b = asyncio.ensure_future(
             farm.submit(_sig_reqs(1, salt=b"wb")[0], lane=lane))
         c = asyncio.ensure_future(
             farm.submit(_sig_reqs(1, salt=b"wc")[0], lane=lane))
         for _ in range(3):
             await asyncio.sleep(0)
-        assert len(farm._lane_waiters[lane]) == 2
-        farm._release_lane(lane)  # frees one slot: resolves b's waiter
+        assert len(group._waiters[lane]) == 2
+        group.release(lane)       # frees one slot: resolves b's waiter
         b.cancel()                # ...which b will never consume
         with pytest.raises(asyncio.CancelledError):
             await b
