@@ -16,7 +16,7 @@ import weakref
 from collections import defaultdict, deque
 from typing import Any, Type
 
-from ..utils import metrics
+from ..utils import metrics, sanitize
 
 
 @dataclasses.dataclass
@@ -131,15 +131,23 @@ class EventBus:
     def __init__(self) -> None:
         self._subs: dict[type, list[Subscription]] = defaultdict(list)
         self.recent: deque = deque(maxlen=self.RECENT)
+        # the PR 7 deepest_queue race class, runtime-checked: subscriber
+        # lists are loop-affine for MUTATION (owner-write = the runtime
+        # twin of `# spacecheck: loop-only`); other threads may only
+        # snapshot-read (deepest_queue, flight dumps)
+        self._shared = sanitize.SharedField("events.bus.subs",
+                                            mode="owner-write")
         _BUSES.add(self)
 
     def subscribe(self, *types: Type, size: int = 256) -> Subscription:
+        self._shared.touch()
         sub = Subscription(self, types, size)
         for t in types:
             self._subs[t].append(sub)
         return sub
 
     def emit(self, ev: Any) -> None:
+        self._shared.touch()
         # display timestamp for flight-bundle event dumps, never used
         # in logic or digests
         self.recent.append((time.time(), type(ev).__name__, ev))  # spacecheck: ok=SC001 wall display timestamp only
@@ -154,6 +162,7 @@ class EventBus:
         resize)."""
         deepest = 0
         seen: set[int] = set()
+        self._shared.touch(write=False)
         for subs in list(self._subs.values()):
             for sub in list(subs):
                 if id(sub) in seen:
@@ -163,6 +172,7 @@ class EventBus:
         return deepest
 
     def _drop(self, sub: Subscription) -> None:
+        self._shared.touch()
         for t in sub.types:
             if sub in self._subs.get(t, ()):
                 self._subs[t].remove(sub)

@@ -101,12 +101,19 @@ class FlightRecorder:
         tmp = self.spool / f".{name}.tmp"
         try:
             tmp.mkdir(parents=True, exist_ok=True)
+            from ..utils import sanitize
+
             manifest = {
                 "reason": reason,
                 "unix_ts": time.time(),
                 "pid": os.getpid(),
                 "trace_enabled": tracing.is_enabled(),
                 "health": health,
+                # sanitizer findings ride along so a bundle taken at the
+                # unhealthy moment carries the race/slow-callback reports
+                # (the counters themselves survive via metrics.prom)
+                "sanitize_violations": [dataclasses.asdict(v)
+                                        for v in sanitize.violations()],
             }
             (tmp / MANIFEST).write_text(
                 json.dumps(_jsonable(manifest), indent=1))

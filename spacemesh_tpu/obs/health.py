@@ -35,12 +35,11 @@ from __future__ import annotations
 
 import asyncio
 import dataclasses
-import threading
 import time
 from typing import Callable, Optional
 
 from ..utils import logging as slog
-from ..utils import metrics, tracing
+from ..utils import metrics, sanitize, tracing
 from . import sli as sli_mod
 
 _log = slog.get("health")
@@ -116,11 +115,16 @@ class HealthRegistry:
 
     def __init__(self) -> None:
         self._probes: dict[str, Probe] = {}
-        self._lock = threading.Lock()
+        # probe map declared shared to the lockset sanitizer: pipelines
+        # register/unregister from worker threads, the engine ticks
+        # from the loop — every access must hold this lock
+        self._lock = sanitize.lock("health.registry")
+        self._shared = sanitize.SharedField("health.registry.probes")
 
     def register(self, name: str, probe: Probe) -> None:
         """Register (or replace) a component probe."""
         with self._lock:
+            self._shared.touch()
             self._probes[name] = probe
 
     def unregister(self, name: str, probe: Probe | None = None) -> None:
@@ -128,11 +132,13 @@ class HealthRegistry:
         is given (a finished pipeline must not evict its successor).
         Equality, not identity: bound methods are rebuilt per access."""
         with self._lock:
+            self._shared.touch()
             if probe is None or self._probes.get(name) == probe:
                 self._probes.pop(name, None)
 
     def names(self) -> list[str]:
         with self._lock:
+            self._shared.touch(write=False)
             return sorted(self._probes)
 
     def report(self, now: float | None = None) -> dict[str, dict]:
@@ -141,6 +147,7 @@ class HealthRegistry:
         propagates."""
         t = time.monotonic() if now is None else float(now)
         with self._lock:
+            self._shared.touch(write=False)
             probes = list(self._probes.items())
         out: dict[str, dict] = {}
         for name, probe in probes:
@@ -255,7 +262,8 @@ class HealthEngine:
         self._pending_dump: tuple | None = None
         self._task: Optional[asyncio.Task] = None
         self._closed = False
-        self._lock = threading.Lock()
+        self._lock = sanitize.lock("health.engine")
+        self._shared_dump = sanitize.SharedField("health.engine.pending_dump")
 
     # --- one evaluation ------------------------------------------------
 
@@ -284,12 +292,14 @@ class HealthEngine:
         a tick queueing a new dump must never race a flusher into
         overwriting it with None unwritten."""
         with self._lock:
+            self._shared_dump.touch()
             pending, self._pending_dump = self._pending_dump, None
         if pending is None or self.recorder is None:
             return
         reason, t, report, events = pending
         self.recorder.dump(reason, now=t, health=report, events=events)
 
+    # guarded by: self._lock — tick() is the only caller and enters with the engine lock held
     def _tick_locked(self, t: float) -> dict:
         with tracing.span("health.tick"):
             self.sampler.sample(t)
@@ -376,6 +386,7 @@ class HealthEngine:
             if self.recorder is not None and (new_breaches or new_stalls):
                 reason = ";".join([f"slo:{n}" for n in new_breaches]
                                   + [f"stall:{n}" for n in new_stalls])
+                self._shared_dump.touch()
                 self._pending_dump = (reason, t, report,
                                       self._recent_events())
             return report

@@ -357,7 +357,7 @@ class QuicEndpoint(asyncio.DatagramProtocol):
                     continue
             if not fut.done():
                 raise asyncio.TimeoutError("quic connect timeout")
-            conn.remote_id = fut.result()
+            conn.remote_id = fut.result()  # spacecheck: ok=SC002 fut.done() is guaranteed just above — a done future's result() cannot block
         except BaseException:
             # failed/cancelled dial: the conn was registered in _by_id at
             # construction — without this, every redial to an unreachable

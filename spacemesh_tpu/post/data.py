@@ -19,7 +19,7 @@ import time
 from pathlib import Path
 
 from ..ops.scrypt import LABEL_BYTES
-from ..utils import metrics, tracing
+from ..utils import metrics, sanitize, tracing
 
 METADATA_FILE = "postdata_metadata.json"
 
@@ -64,7 +64,7 @@ class LabelStore:
         self.dir = Path(data_dir)
         self.meta = meta
         self.dir.mkdir(parents=True, exist_ok=True)
-        self._fd_lock = threading.Lock()
+        self._fd_lock = sanitize.lock("post.data.LabelStore.fds")
         self._read_fds: dict[int, int] = {}
 
     def _file(self, i: int) -> Path:
@@ -165,8 +165,13 @@ class LabelWriter:
                  queue_depth: int = 8):
         self.store = store
         self._q: queue.Queue = queue.Queue(maxsize=max(queue_depth, 1))
-        self._lock = threading.Lock()
-        self._idle = threading.Condition(self._lock)
+        self._lock = sanitize.lock("post.data.LabelWriter")
+        self._idle = sanitize.condition("post.data.LabelWriter.idle",
+                                        self._lock)
+        # the durable cursor and its completion map are DECLARED SHARED
+        # (SPACEMESH_SANITIZE=race): the dispatch thread, the pool
+        # threads and the watchdog all meet here, always under _lock
+        self._shared = sanitize.SharedField("post.data.LabelWriter.cursor")
         self._done: dict[int, int] = {}   # completed start -> end
         self._durable = store.meta.labels_written
         self._inflight = 0
@@ -190,6 +195,7 @@ class LabelWriter:
         if self._closed:
             raise RuntimeError("writer is closed")
         with self._lock:
+            self._shared.touch()
             self._inflight += 1
         self.labels_submitted += len(labels) // LABEL_BYTES
         # pool threads are long-lived and cannot inherit the submitter's
@@ -199,6 +205,7 @@ class LabelWriter:
     def durable(self) -> int:
         """Highest label index with every prior label contiguously on disk."""
         with self._lock:
+            self._shared.touch(write=False)
             return self._durable
 
     def pending(self) -> int:
@@ -206,6 +213,7 @@ class LabelWriter:
         activity gate (obs/health.py writer_watchdog): while this is
         non-zero the durable cursor must keep advancing."""
         with self._lock:
+            self._shared.touch(write=False)
             return self._inflight
 
     def queue_depth(self) -> int:
@@ -214,6 +222,7 @@ class LabelWriter:
     def drain(self) -> None:
         """Block until every submitted write has hit the filesystem."""
         with self._idle:
+            self._shared.touch(write=False)
             while self._inflight > 0 and self._error is None:
                 self._idle.wait(timeout=0.1)
         self._raise_if_failed()
@@ -222,7 +231,12 @@ class LabelWriter:
         if self._closed:
             return
         try:
-            if drain and self._error is None:
+            # the error flag is written by pool threads under the lock;
+            # an unlocked read here could miss a just-landed failure
+            # and drain() a pool that will never go idle (SC007)
+            with self._lock:
+                failed = self._error is not None
+            if drain and not failed:
                 self.drain()
         finally:
             # a drain() error must still stop the pool: workers keep
@@ -235,9 +249,11 @@ class LabelWriter:
                 t.join(timeout=10)
 
     def _raise_if_failed(self) -> None:
-        if self._error is not None:
+        with self._lock:
+            error = self._error
+        if error is not None:
             raise RuntimeError("background label writer failed") \
-                from self._error
+                from error
 
     # -- pool side ----------------------------------------------------------
 
@@ -257,6 +273,7 @@ class LabelWriter:
                     self.store.write_labels(start, labels)
             except BaseException as e:  # noqa: BLE001 — surfaced to caller
                 with self._idle:
+                    self._shared.touch()
                     if self._error is None:
                         self._error = e
                     self._inflight -= 1
@@ -264,6 +281,7 @@ class LabelWriter:
                 continue
             count = len(labels) // LABEL_BYTES
             with self._idle:
+                self._shared.touch()
                 self.write_seconds += time.perf_counter() - t0
                 self.bytes_written += len(labels)
                 self._done[start] = start + count
@@ -292,7 +310,8 @@ class LabelReader:
         # pool threads can't inherit contextvars; reads parent under the
         # span that planned the pass (the prover's window span)
         self._trace_parent = tracing.current_id()
-        self._cond = threading.Condition()
+        self._cond = sanitize.condition("post.data.LabelReader")
+        self._shared = sanitize.SharedField("post.data.LabelReader.state")
         self._results: dict[int, bytes] = {}
         self._claim = 0          # next plan slot a worker may take
         self._consume = 0        # next plan slot get() returns
@@ -316,6 +335,7 @@ class LabelReader:
         missing (so an error past an early-exit point cannot abort a prove
         that never needed those bytes)."""
         with self._cond:
+            self._shared.touch()
             while (self._consume not in self._results
                    and self._error is None):
                 if self._consume >= len(self.ranges):
@@ -333,6 +353,7 @@ class LabelReader:
     def close(self) -> None:
         """Stop the pool; safe mid-plan (early exit drops pending reads)."""
         with self._cond:
+            self._shared.touch()
             self._closed = True
             self._cond.notify_all()
         for t in self._threads:
@@ -341,6 +362,7 @@ class LabelReader:
     def _worker(self) -> None:
         while True:
             with self._cond:
+                self._shared.touch()
                 while (not self._closed and self._error is None
                        and (self._budget <= 0
                             or self._claim >= len(self.ranges))):
@@ -362,11 +384,13 @@ class LabelReader:
                     data = self.store.read_labels(start, count)
             except BaseException as e:  # noqa: BLE001 — surfaced via get()
                 with self._cond:
+                    self._shared.touch()
                     if self._error is None:
                         self._error = e
                     self._cond.notify_all()
                 return
             with self._cond:
+                self._shared.touch()
                 self.read_seconds += time.perf_counter() - t0
                 self.bytes_read += len(data)
                 self._results[slot] = data

@@ -39,18 +39,21 @@ import time
 from collections import deque
 from typing import Any, Callable, Iterable, Optional
 
-from ..utils import metrics, tracing
+from ..utils import metrics, sanitize, tracing
 
 # per-kind AGGREGATE in-flight depth: concurrent pipelines of one kind
 # (two gang prove windows, parallel k2pow searches) each contribute a
 # delta instead of clobbering the gauge — the finishing pipeline removes
-# only its own share, never zeroes a peer's
-_inflight_lock = threading.Lock()
+# only its own share, never zeroes a peer's. Declared shared to the
+# lockset sanitizer: every pipeline thread passes through here.
+_inflight_lock = sanitize.lock("runtime.engine.inflight")
+_inflight_shared = sanitize.SharedField("runtime.engine.inflight_by_kind")
 _inflight_by_kind: dict[str, int] = {}
 
 
 def _inflight_adjust(kind: str, delta: int) -> int:
     with _inflight_lock:
+        _inflight_shared.touch()
         n = _inflight_by_kind.get(kind, 0) + delta
         _inflight_by_kind[kind] = n
         return n

@@ -26,6 +26,8 @@ import asyncio
 from collections import deque
 from typing import Callable, Optional
 
+from ..utils import sanitize
+
 
 class QueueClosed(RuntimeError):
     """The admission queue was shut down while the request was pending."""
@@ -51,6 +53,11 @@ class LaneGroup:
         self._on_depth = on_depth
         self.closed = False
         self._loop: asyncio.AbstractEventLoop | None = None
+        # lane counts/waiters/dedup are loop-affine BY CONTRACT (no
+        # lock anywhere in this class); the owner-write declaration is
+        # the runtime check that no worker thread ever mutates them
+        self._shared = sanitize.SharedField("runtime.queue.lanegroup",
+                                            mode="owner-write")
         self._count: dict = {lane: 0 for lane in lanes}
         self._waiters: dict = {lane: deque() for lane in lanes}
         self.dedup: dict = {}
@@ -67,6 +74,10 @@ class LaneGroup:
         self._count = {lane: 0 for lane in self.lanes}
         self._waiters = {lane: deque() for lane in self.lanes}
         self.dedup = {}
+        # a rebind is a sanctioned ownership handoff: the new loop may
+        # run on a different thread, which must not trip the owner-write
+        # check against the dead loop's thread id
+        self._shared.reset()
         return True
 
     def fail_waiters(self) -> None:
@@ -89,6 +100,7 @@ class LaneGroup:
     def add(self, lane) -> int:
         """Unconditional occupancy increment (post-acquire, or a dedup
         promote that already holds a slot elsewhere)."""
+        self._shared.touch()
         self._count[lane] += 1
         depth = self._count[lane]
         if self._on_depth is not None:
@@ -97,6 +109,7 @@ class LaneGroup:
 
     def release(self, lane) -> None:
         """Free one slot and hand it to the next live waiter."""
+        self._shared.touch()
         self._count[lane] -= 1
         if self._on_depth is not None:
             self._on_depth(lane, self._count[lane])

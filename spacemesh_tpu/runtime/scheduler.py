@@ -53,7 +53,7 @@ import time
 from collections import deque
 from pathlib import Path
 
-from ..utils import metrics, tracing
+from ..utils import metrics, sanitize, tracing
 from . import engine, workloads
 
 _DEFAULT_PACK_LANES = 4096
@@ -221,10 +221,17 @@ class TenantScheduler:
         self._defaults = (default_weight, default_max_inflight,
                           default_max_queued)
         self._now = time_source
-        self._lock = threading.Lock()
-        self._work = threading.Condition(self._lock)  # workers wait here
-        self._pack_work = threading.Condition(self._lock)  # packer waits
-        self._idle = threading.Condition(self._lock)  # drain() waits
+        self._lock = sanitize.lock("runtime.scheduler")
+        self._work = sanitize.condition(  # workers wait here
+            "runtime.scheduler.work", self._lock)
+        self._pack_work = sanitize.condition(  # packer waits
+            "runtime.scheduler.pack_work", self._lock)
+        self._idle = sanitize.condition(  # drain() waits
+            "runtime.scheduler.idle", self._lock)
+        # the tenant tables are DECLARED SHARED to the lockset
+        # sanitizer: submitters, workers, the packer and close() all
+        # meet here, always under _lock
+        self._shared = sanitize.SharedField("runtime.scheduler.tenants")
         self._tenants: dict[str, _Tenant] = {}
         self._jobs: dict[str, object] = {}  # live job id -> job
         self._ids = itertools.count(1)
@@ -257,6 +264,7 @@ class TenantScheduler:
         to call twice.  Running quanta finish (they hold device state
         mid-flight) and their jobs then resolve as closed."""
         with self._lock:
+            self._shared.touch()
             if self._closed:
                 return
             self._closed = True
@@ -277,6 +285,7 @@ class TenantScheduler:
         # packer abandoned mid-flight (writers drained+closed, futures
         # failed) so close() never strands a handle unresolved
         with self._lock:
+            self._shared.touch(write=False)
             leftovers = list(self._jobs.values())
         closed_exc = SchedulerClosed("scheduler closed")
         for job in leftovers:
@@ -296,6 +305,7 @@ class TenantScheduler:
         """Block until every submitted job resolved; False on timeout."""
         deadline = None if timeout is None else self._now() + timeout
         with self._idle:
+            self._shared.touch(write=False)
             while self._jobs:
                 left = None if deadline is None else deadline - self._now()
                 if left is not None and left <= 0:
@@ -312,6 +322,7 @@ class TenantScheduler:
         :meth:`unregister_tenant` when the identity goes away."""
         dw, di, dq = self._defaults
         with self._lock:
+            self._shared.touch()
             t = self._tenants.get(tid)
             if t is None:
                 t = self._tenants[tid] = _Tenant(
@@ -338,6 +349,7 @@ class TenantScheduler:
         identity must not pin a stale series — the PR 7 lesson)."""
         exc = SchedulerClosed(f"tenant {tid} unregistered")
         with self._lock:
+            self._shared.touch()
             t = self._tenants.pop(tid, None)
             if t is None:
                 return
@@ -365,11 +377,14 @@ class TenantScheduler:
 
     def tenants(self) -> list[str]:
         with self._lock:
+            self._shared.touch(write=False)
             return sorted(self._tenants)
 
     # -- submission ----------------------------------------------------
 
+    # guarded by: self._lock — every submit_* caller enters with the scheduler lock held
     def _admit(self, tid: str, kind: str) -> tuple[_Tenant, JobHandle]:
+        self._shared.touch()
         if self._closed:
             raise SchedulerClosed("scheduler closed")
         t = self._tenants.get(tid)
@@ -512,6 +527,7 @@ class TenantScheduler:
 
     def _cancel(self, handle: JobHandle) -> bool:
         with self._lock:
+            self._shared.touch()
             job = self._jobs.get(handle.id)
             if job is None:
                 return False
@@ -540,6 +556,7 @@ class TenantScheduler:
                  cancelled: bool = False) -> None:
         handle = job.handle
         with self._lock:
+            self._shared.touch()
             if self._jobs.pop(handle.id, None) is None:
                 return  # already resolved
             t = self._tenants.get(handle.tenant)
@@ -567,8 +584,10 @@ class TenantScheduler:
 
     # -- worker pool (prove/verify/pow/call quanta) ---------------------
 
+    # guarded by: self._lock — _worker_loop picks with the scheduler lock held
     def _pick_job(self) -> _Job | None:
         """Under the lock: the next quantum by deadline-then-fair-share."""
+        self._shared.touch()
         now = self._now()
         best_t = None
         overdue_job = None
@@ -597,6 +616,7 @@ class TenantScheduler:
     def _worker_loop(self) -> None:
         while True:
             with self._lock:
+                self._shared.touch(write=False)
                 job = None
                 while not self._closed:
                     job = self._pick_job()
@@ -633,6 +653,7 @@ class TenantScheduler:
             metrics.runtime_quantum_seconds.inc(dt, kind=job.kind,
                                                 tenant=job.tenant.id)
             with self._lock:
+                self._shared.touch()
                 job.tenant.charge(dt)
                 job.tenant.running -= 1
                 self._live_quanta -= 1
@@ -673,6 +694,7 @@ class TenantScheduler:
         deferred outright — the engine retires results meanwhile and
         the lanes coalesce into the next full pack."""
         with self._lock:
+            self._shared.touch()
             while True:
                 if self._closed:
                     return None
@@ -770,9 +792,14 @@ class TenantScheduler:
         lanes = sum(s.count for s in segments)
         dt = time.perf_counter() - t_dispatch
         # EMA of the measured per-lane cost feeds the provisional
-        # fair-share charge in _compose_pack
-        self._lane_cost_ema += 0.25 * (dt / max(lanes, 1)
-                                       - self._lane_cost_ema)
+        # fair-share charge in _compose_pack — which reads it under the
+        # scheduler lock, so the read-modify-write must hold it too or
+        # a concurrent compose can consume (and charge tenants by) a
+        # half-updated cost (found by SC007, ISSUE 12)
+        with self._lock:
+            self._shared.touch()
+            self._lane_cost_ema += 0.25 * (dt / max(lanes, 1)
+                                           - self._lane_cost_ema)
         # ONE byte conversion for the whole pack, sliced per segment —
         # 16 tiny per-tenant byteswaps would hand back the per-call
         # overhead the pack just amortized
@@ -803,6 +830,7 @@ class TenantScheduler:
                 except Exception as exc:  # noqa: BLE001 — fail THIS job, not the pack
                     job.error = exc
             with self._lock:
+                self._shared.touch()
                 job.outstanding -= s.count
                 if job.error is not None or job.cancelled:
                     # packable is 0 now: drop the queued remainder so
@@ -824,6 +852,7 @@ class TenantScheduler:
         # idempotent: unregister/close/retire can race to finalize the
         # same job; only the first pass drains/closes and resolves
         with self._lock:
+            self._shared.touch()
             if job.finalized:
                 return
             job.finalized = True
@@ -865,7 +894,7 @@ class TenantScheduler:
                                    "lanes": sum(s.count for s in p[0]),
                                    "tenants": len({s.job.tenant.id
                                                    for s in p[0]})},
-                               stop=lambda: self._closed)
+                               stop=lambda: self._closed)  # spacecheck: ok=SC007 monotonic close flag; a stale read only delays stop by one batch
 
         def packs():
             while True:
@@ -888,6 +917,7 @@ class TenantScheduler:
             pipe.run(packs(), self._dispatch_pack, self._retire_pack)
         except Exception as exc:  # noqa: BLE001 — fail in-flight init jobs, not the thread
             with self._lock:
+                self._shared.touch(write=False)
                 jobs = [j for j in self._jobs.values()
                         if isinstance(j, _InitJob)]
             for j in jobs:

@@ -66,6 +66,14 @@ def main(argv: list[str] | None = None) -> int:
                     help="comma-separated rule ids (default: all)")
     ap.add_argument("--format", choices=("text", "github", "json"),
                     default="text")
+    ap.add_argument("--jobs", type=int, default=1, metavar="N",
+                    help="fork-parallel rule execution (default: 1)")
+    ap.add_argument("--cache", default=None, metavar="FILE",
+                    help="incremental findings cache (default: beside "
+                         "the autotune cache, $SPACEMESH_SPACECHECK_CACHE "
+                         "overrides; full-rule runs only)")
+    ap.add_argument("--no-cache", action="store_true",
+                    help="always recompute, never read/write the cache")
     ap.add_argument("--list-rules", action="store_true")
     args = ap.parse_args(argv)
 
@@ -90,7 +98,14 @@ def main(argv: list[str] | None = None) -> int:
     if not paths:
         ap.error("no paths given and none of spacemesh_tpu/, tests/ "
                  f"exist under {root}")
-    findings, errors = run_paths(paths, project_root=root, select=select)
+    # the default-path cache holds the FULL tree's findings; a run over
+    # an explicit path subset must not overwrite it with a subset doc
+    # (an explicit --cache FILE is the caller's own file and is honored)
+    cache: str | bool = False
+    if not args.no_cache:
+        cache = args.cache or (not args.paths)
+    findings, errors = run_paths(paths, project_root=root, select=select,
+                                 cache=cache, jobs=args.jobs)
 
     if args.write_baseline:
         baseline_mod.write(args.write_baseline, findings)

@@ -15,11 +15,13 @@ import ast
 import dataclasses
 import hashlib
 import io
+import json
 import os
 import re
 import tokenize
 
-RULE_IDS = ("SC001", "SC002", "SC003", "SC004", "SC005", "SC006")
+RULE_IDS = ("SC001", "SC002", "SC003", "SC004", "SC005", "SC006",
+            "SC007", "SC008")
 
 # paths (relative, forward-slash) matched against these prefixes are
 # skipped entirely
@@ -221,6 +223,101 @@ class ProjectInfo:
         visit(ctx.tree)
 
 
+# --- incremental findings cache -----------------------------------------
+#
+# A full run is (parse + pre-pass + N rules) x every file; rules keep
+# multiplying, and the CI spacecheck job runs BEFORE dependency install
+# on every push.  The cache persists per-file findings keyed by
+# ``(mtime, sha256)`` beside the autotune winners file, guarded by two
+# whole-run digests that keep it SOUND for cross-file rules:
+#
+# * ``rules_digest`` — hash of engine.py + every rules/*.py source: any
+#   analyzer change invalidates everything;
+# * ``tree_digest`` — hash of every analyzed file's content hash: rules
+#   consume project-wide facts (SC003's donation map, SC005's duplicate
+#   names, SC007/SC008's thread/lock graphs), so one changed file can
+#   change another file's findings.  A warm run over an identical tree
+#   is therefore a pure cache hit (no parse, no rules); any change at
+#   all recomputes the whole tree and refreshes the cache.
+#
+# ``--select`` runs bypass the cache (partial findings must never
+# poison a full run's entries).
+
+CACHE_ENV = "SPACEMESH_SPACECHECK_CACHE"
+CACHE_VERSION = 1
+
+
+def default_cache_path() -> str:
+    """Beside the autotune winners file (ops/autotune.py cache_path),
+    derived without importing any jax-touching module — the analyzer
+    must stay runnable before dependency install."""
+    explicit = os.environ.get(CACHE_ENV)
+    if explicit:
+        return os.path.expanduser(explicit)
+    jax_cache = os.environ.get("SPACEMESH_JAX_CACHE") \
+        or "~/.cache/spacemesh_tpu/jax_cache"
+    root = os.path.dirname(os.path.expanduser(jax_cache))
+    return os.path.join(root, "spacecheck_cache.json")
+
+
+def _rules_digest() -> str:
+    from . import rules as rules_pkg
+
+    h = hashlib.sha256()
+    rules_dir = os.path.dirname(rules_pkg.__file__)
+    files = [__file__] + [os.path.join(rules_dir, f)
+                          for f in sorted(os.listdir(rules_dir))
+                          if f.endswith(".py")]
+    for path in files:
+        try:
+            with open(path, "rb") as fh:
+                h.update(fh.read())
+        except OSError:
+            h.update(path.encode())
+    return h.hexdigest()
+
+
+def _load_cache_doc(path: str) -> dict | None:
+    try:
+        with open(path, encoding="utf-8") as fh:
+            doc = json.load(fh)
+    except (OSError, ValueError):
+        return None
+    if not isinstance(doc, dict) or doc.get("version") != CACHE_VERSION \
+            or not isinstance(doc.get("files"), dict):
+        return None
+    return doc
+
+
+def _file_sha(path: str, cached_entry: dict | None) -> tuple[str, dict]:
+    """(sha256 hex, stat info) — reuses the cached hash when the file's
+    (mtime, size) are unchanged, so a warm run hashes nothing."""
+    st = os.stat(path)
+    info = {"mtime": st.st_mtime, "size": st.st_size}
+    if cached_entry is not None \
+            and cached_entry.get("mtime") == info["mtime"] \
+            and cached_entry.get("size") == info["size"] \
+            and isinstance(cached_entry.get("sha"), str):
+        return cached_entry["sha"], info
+    with open(path, "rb") as fh:
+        sha = hashlib.sha256(fh.read()).hexdigest()
+    return sha, info
+
+
+def _write_cache(path: str, rules_digest: str, tree_digest: str,
+                 per_file: dict[str, dict]) -> None:
+    doc = {"version": CACHE_VERSION, "rules_digest": rules_digest,
+           "tree_digest": tree_digest, "files": per_file}
+    try:
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w", encoding="utf-8") as fh:
+            json.dump(doc, fh)
+        os.replace(tmp, path)
+    except OSError:
+        pass  # persistence is an optimization (read-only HOME, CI)
+
+
 # --- walking + running --------------------------------------------------
 
 
@@ -262,41 +359,156 @@ def assign_fingerprints(findings: list[Finding]) -> None:
         f.fingerprint = fingerprint(f.rule, f.path, f.snippet)
 
 
+def _check_context(ctx: FileContext, project: ProjectInfo,
+                   active: list) -> tuple[list[Finding], list[str]]:
+    findings: list[Finding] = []
+    errors: list[str] = []
+    for rule in active:
+        try:
+            raw = rule.check(ctx, project)
+        except Exception as e:  # noqa: BLE001 — one rule crashing on
+            # one file must surface as an analyzer error, not take
+            # down the whole run silently
+            errors.append(f"{ctx.rel}: rule {rule.RULE} crashed: "
+                          f"{type(e).__name__}: {e}")
+            continue
+        findings.extend(f for f in raw
+                        if not ctx.suppressed(f.rule, f.line))
+    return findings, errors
+
+
+# fork-inherited handoff for --jobs workers (contexts and the project
+# pre-pass are built once in the parent; AST trees cross into children
+# for free via fork, only the per-file findings lists come back pickled)
+_FORK_STATE: tuple | None = None
+
+
+def _fork_shard(indices: list[int]) -> list[tuple[list[Finding],
+                                                  list[str]]]:
+    contexts, project, active = _FORK_STATE
+    return [_check_context(contexts[i], project, active)
+            for i in indices]
+
+
+def _run_rules(contexts: list[FileContext], project: ProjectInfo,
+               active: list, jobs: int
+               ) -> list[tuple[FileContext, list[Finding], list[str]]]:
+    jobs = max(int(jobs), 1)
+    if jobs > 1 and len(contexts) > 1:
+        import multiprocessing
+
+        try:
+            mp = multiprocessing.get_context("fork")
+        except ValueError:
+            mp = None
+        if mp is not None:
+            import concurrent.futures
+
+            # prime the rules' lazy cross-file caches (SC003's donation
+            # map, SC007/SC008's thread/lock facts) in the PARENT by
+            # checking one file first — forked children then inherit
+            # the populated project.cache instead of each rebuilding it
+            out: list = [None] * len(contexts)
+            out[0] = (contexts[0],
+                      *_check_context(contexts[0], project, active))
+            global _FORK_STATE
+            _FORK_STATE = (contexts, project, active)
+            try:
+                shards = [list(range(1 + k, len(contexts), jobs))
+                          for k in range(jobs)]
+                shards = [s for s in shards if s]
+                with concurrent.futures.ProcessPoolExecutor(
+                        max_workers=max(len(shards), 1),
+                        mp_context=mp) as ex:
+                    results = list(ex.map(_fork_shard, shards))
+            finally:
+                _FORK_STATE = None
+            for shard, res in zip(shards, results):
+                for i, (fs, errs) in zip(shard, res):
+                    out[i] = (contexts[i], fs, errs)
+            return out
+    return [(ctx, *_check_context(ctx, project, active))
+            for ctx in contexts]
+
+
 def run_paths(paths: list[str], *, project_root: str | None = None,
-              select: set[str] | None = None
-              ) -> tuple[list[Finding], list[str]]:
+              select: set[str] | None = None,
+              cache: str | bool | None = None,
+              jobs: int = 1) -> tuple[list[Finding], list[str]]:
     """Analyze ``paths`` (files or directories). Returns (findings,
     errors); errors are unparseable files — CI treats them as failures
-    too (an unparseable file is unanalyzed, not clean)."""
+    too (an unparseable file is unanalyzed, not clean).
+
+    ``cache`` — True (default path) or a path: consult/refresh the
+    incremental findings cache (full-rule runs only; ``--select`` runs
+    always compute).  ``jobs`` — fork-parallel rule execution.
+    """
     from . import rules as rules_pkg
 
     root = os.path.abspath(project_root or os.getcwd())
+    files = [(path, _relpath(path, root)) for path in iter_py_files(paths)]
+
+    cache_file = None
+    if cache and select is None:
+        cache_file = default_cache_path() if cache is True else str(cache)
+    cached_doc = _load_cache_doc(cache_file) if cache_file else None
+    rules_digest = _rules_digest() if cache_file else ""
+    shas: dict[str, tuple[str, dict]] = {}
+    tree_digest = ""
+    if cache_file:
+        cached_files = (cached_doc or {}).get("files", {})
+        th = hashlib.sha256()
+        try:
+            for path, rel in files:
+                shas[rel] = _file_sha(path, cached_files.get(rel))
+                th.update(f"{rel}:{shas[rel][0]}\n".encode())
+            tree_digest = th.hexdigest()
+        except OSError:
+            cache_file = None  # unreadable file: fall through, the
+            # full run reports it as an analyzer error
+    if cached_doc is not None and cache_file \
+            and cached_doc.get("rules_digest") == rules_digest \
+            and cached_doc.get("tree_digest") == tree_digest \
+            and all(rel in cached_doc["files"] for _, rel in files):
+        findings: list[Finding] = []
+        errors: list[str] = []
+        for _, rel in files:
+            ent = cached_doc["files"][rel]
+            findings.extend(Finding(**f) for f in ent.get("findings", []))
+            errors.extend(ent.get("errors", []))
+        findings.sort(key=Finding.key)
+        return findings, errors
+
     contexts: list[FileContext] = []
-    errors: list[str] = []
-    for path in iter_py_files(paths):
-        rel = _relpath(path, root)
+    errors = []
+    per_file: dict[str, dict] = {}
+    for path, rel in files:
+        ent: dict = {"findings": [], "errors": []}
+        if rel in shas:
+            ent.update(sha=shas[rel][0], **shas[rel][1])
+        per_file[rel] = ent
         try:
             with open(path, encoding="utf-8") as fh:
                 source = fh.read()
             contexts.append(FileContext(path, rel, source))
         except (OSError, SyntaxError, ValueError) as e:
-            errors.append(f"{rel}: {type(e).__name__}: {e}")
+            msg = f"{rel}: {type(e).__name__}: {e}"
+            errors.append(msg)
+            ent["errors"].append(msg)
     project = ProjectInfo(contexts)
-    findings: list[Finding] = []
     active = [r for r in rules_pkg.ALL_RULES
               if select is None or r.RULE in select]
-    for ctx in contexts:
-        for rule in active:
-            try:
-                raw = rule.check(ctx, project)
-            except Exception as e:  # noqa: BLE001 — one rule crashing on
-                # one file must surface as an analyzer error, not take
-                # down the whole run silently
-                errors.append(f"{ctx.rel}: rule {rule.RULE} crashed: "
-                              f"{type(e).__name__}: {e}")
-                continue
-            findings.extend(f for f in raw
-                            if not ctx.suppressed(f.rule, f.line))
+    findings = []
+    for ctx, ctx_findings, ctx_errors in _run_rules(contexts, project,
+                                                    active, jobs):
+        findings.extend(ctx_findings)
+        errors.extend(ctx_errors)
+        per_file[ctx.rel]["errors"].extend(ctx_errors)
     findings.sort(key=Finding.key)
     assign_fingerprints(findings)
+    if cache_file:
+        for f in findings:
+            if f.path in per_file:
+                per_file[f.path]["findings"].append(dataclasses.asdict(f))
+        _write_cache(cache_file, rules_digest, tree_digest, per_file)
     return findings, errors

@@ -1,9 +1,11 @@
 """Rule registry: one module per rule, each exporting RULE + check()."""
 
 from . import (sc001_clock, sc002_async_blocking, sc003_donation,
-               sc004_pairing, sc005_metrics, sc006_excepts)
+               sc004_pairing, sc005_metrics, sc006_excepts,
+               sc007_lock_discipline, sc008_lock_order)
 
 ALL_RULES = (sc001_clock, sc002_async_blocking, sc003_donation,
-             sc004_pairing, sc005_metrics, sc006_excepts)
+             sc004_pairing, sc005_metrics, sc006_excepts,
+             sc007_lock_discipline, sc008_lock_order)
 
 __all__ = ["ALL_RULES"]

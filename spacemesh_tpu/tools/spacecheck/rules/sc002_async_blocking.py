@@ -18,8 +18,16 @@ are excluded — they typically run via ``to_thread``/executors):
   the kernel)
 * ``jax.device_get(...)`` and ``<x>.block_until_ready()`` — device
   syncs that stall the loop for a whole dispatch
-* ``<x>.result()`` with no args on concurrent futures is NOT flagged
-  (too ambiguous); wrap genuinely blocking waits in ``to_thread``
+* ``<x>.result()`` / ``<x>.future.result()`` — ``concurrent.futures``
+  waits, including the runtime scheduler's thread-based ``JobHandle``
+  (PR 10 made blocking on a device job from a handler an easy new way
+  to wedge the loop); ``asyncio``-side results arrive via ``await``,
+  never ``.result()``, so any lexical ``.result()`` in an ``async
+  def`` is a blocking wait
+* ``<q>.get(...)`` / ``<q>.put(...)`` on a ``queue.Queue`` — the
+  blocking thread-handoff primitive (names bound to a
+  ``queue.Queue(...)``-family constructor in the same file);
+  ``get_nowait``/``put_nowait`` stay legal
 
 Allowlist a deliberate site (tiny reads at startup, etc.) with
 ``# spacecheck: ok=SC002 <why>``.
@@ -36,10 +44,42 @@ RULE = "SC002"
 
 _SUBPROCESS = {"run", "call", "check_call", "check_output", "Popen"}
 _OS_SYNC_IO = {"open", "replace", "rename", "fsync", "unlink", "remove"}
+_QUEUE_FACTORIES = {"Queue", "SimpleQueue", "LifoQueue", "PriorityQueue"}
+
+
+def _queue_vars(tree: ast.Module) -> set[str]:
+    """Last-component names bound to a stdlib ``queue.*`` constructor in
+    THIS file (``self._q = queue.Queue(...)``). Per-file on purpose: a
+    project-wide name set would let one module's queue attribute flag a
+    same-named dict in another (``storage/db.py`` ``_readers`` vs
+    ``p2p/fetch.py`` ``_readers``)."""
+    out: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            value, targets = node.value, node.targets
+        elif isinstance(node, ast.AnnAssign):
+            # the codebase's own idiom: `self._q: queue.Queue = ...`
+            value, targets = node.value, [node.target]
+        else:
+            continue
+        if not isinstance(value, ast.Call):
+            continue
+        name = dotted_name(value.func)
+        if not name:
+            continue
+        head, _, last = name.rpartition(".")
+        if last in _QUEUE_FACTORIES \
+                and head.rsplit(".", 1)[-1] == "queue":
+            for tgt in targets:
+                tname = dotted_name(tgt)
+                if tname:
+                    out.add(tname.rsplit(".", 1)[-1])
+    return out
 
 
 def check(ctx: FileContext, project: ProjectInfo) -> list[Finding]:
     time_aliases = time_module_aliases(ctx.tree)
+    queue_vars = _queue_vars(ctx.tree)
     findings: list[Finding] = []
 
     def blocking(node: ast.Call) -> str | None:
@@ -65,6 +105,17 @@ def check(ctx: FileContext, project: ProjectInfo) -> list[Finding]:
         if attr == "block_until_ready":
             return (".block_until_ready() stalls the loop for a whole "
                     "device dispatch; wrap in to_thread")
+        if attr == "result" and not node.args:
+            # zero positional args: the Future/JobHandle shape (an
+            # argful .result(state, id) is a plain module function)
+            return (f"{recv}.result() is a blocking concurrent-futures "
+                    "wait (JobHandle/Future); await "
+                    "asyncio.wrap_future(...) or move it to to_thread")
+        if attr in ("get", "put") and recv \
+                and recv.rsplit(".", 1)[-1] in queue_vars:
+            return (f"{recv}.{attr}() blocks on a queue.Queue; use "
+                    f"{attr}_nowait with loop-side signalling, or "
+                    "to_thread")
         return None
 
     def scan_async_body(fn: ast.AsyncFunctionDef) -> None:

@@ -11,6 +11,8 @@ from __future__ import annotations
 import threading
 from collections import defaultdict
 
+from . import sanitize
+
 
 def _escape(value) -> str:
     """Escape a label VALUE per the Prometheus text exposition format:
@@ -29,7 +31,11 @@ class _Instrument:
     def __init__(self, name: str, help_: str):
         self.name = name
         self.help = help_
-        self._lock = threading.Lock()
+        # series maps are DECLARED SHARED to the lockset sanitizer
+        # (SPACEMESH_SANITIZE=race): every access must hold this lock,
+        # which the tracked twin feeds into the per-thread held-lockset
+        self._lock = sanitize.lock(f"metrics.{name}")
+        self._shared = sanitize.SharedField(f"metrics.{name}.series")
 
 
 class Counter(_Instrument):
@@ -39,11 +45,13 @@ class Counter(_Instrument):
 
     def inc(self, value: float = 1.0, **labels) -> None:
         with self._lock:
+            self._shared.touch()
             self._values[tuple(sorted(labels.items()))] += value
 
     def sample(self) -> dict[tuple, float]:
         """Point-in-time {labelset: value} snapshot (obs/sli.py sampler)."""
         with self._lock:
+            self._shared.touch(write=False)
             return dict(self._values)
 
     def expose(self) -> list[str]:
@@ -64,6 +72,7 @@ class Gauge(_Instrument):
 
     def set(self, value: float, **labels) -> None:
         with self._lock:
+            self._shared.touch()
             self._values[tuple(sorted(labels.items()))] = value
 
     def remove(self, **labels) -> None:
@@ -71,10 +80,12 @@ class Gauge(_Instrument):
         that no longer exists (an unregistered health component) must
         disappear from the scrape, not pin its last value forever."""
         with self._lock:
+            self._shared.touch()
             self._values.pop(tuple(sorted(labels.items())), None)
 
     def sample(self) -> dict[tuple, float]:
         with self._lock:
+            self._shared.touch(write=False)
             return dict(self._values)
 
     def expose(self) -> list[str]:
@@ -105,6 +116,7 @@ class Histogram(_Instrument):
     def observe(self, value: float, **labels) -> None:
         key = tuple(sorted(labels.items()))
         with self._lock:
+            self._shared.touch()
             s = self._series.get(key)
             if s is None:
                 s = self._series[key] = [[0] * len(self.buckets), 0.0, 0]
@@ -117,6 +129,7 @@ class Histogram(_Instrument):
     def sample(self) -> dict[tuple, tuple[list, float, int]]:
         """{labelset: (cumulative bucket counts, sum, count)} snapshot."""
         with self._lock:
+            self._shared.touch(write=False)
             return {k: (list(s[0]), s[1], s[2])
                     for k, s in self._series.items()}
 
@@ -143,7 +156,8 @@ class Registry:
     def __init__(self) -> None:
         self._instruments: dict[str, _Instrument] = {}
         self._collectors: list = []
-        self._lock = threading.Lock()
+        self._lock = sanitize.lock("metrics.registry")
+        self._shared = sanitize.SharedField("metrics.registry.instruments")
         # the owning thread: instrument CREATION belongs at module
         # import on this thread; recording is thread-safe from anywhere.
         # The runtime sanitizer (utils/sanitize.py, SPACEMESH_SANITIZE)
@@ -174,10 +188,9 @@ class Registry:
 
     def _get(self, name, factory, cls):
         with self._lock:
+            self._shared.touch()
             inst = self._instruments.get(name)
             if inst is None:
-                from . import sanitize
-
                 sanitize.on_instrument_create(name, self)
                 inst = self._instruments[name] = factory()
             elif not isinstance(inst, cls):
@@ -195,10 +208,12 @@ class Registry:
         of trusting the last write — a gauge set on emit and never
         decayed lies to every later scrape."""
         with self._lock:
+            self._shared.touch()
             self._collectors.append(fn)
 
     def run_collectors(self) -> None:
         with self._lock:
+            self._shared.touch(write=False)
             fns = list(self._collectors)
         for fn in fns:
             try:
@@ -213,6 +228,7 @@ class Registry:
         carry their bucket bounds). The SLI sampler diffs two of these."""
         self.run_collectors()
         with self._lock:
+            self._shared.touch(write=False)
             instruments = list(self._instruments.items())
         out: dict[str, tuple[str, object]] = {}
         for name, inst in instruments:
@@ -228,6 +244,7 @@ class Registry:
     def expose(self) -> str:
         self.run_collectors()
         with self._lock:
+            self._shared.touch(write=False)
             instruments = list(self._instruments.values())
         lines: list[str] = []
         for inst in instruments:

@@ -50,7 +50,7 @@ from ..core.signing import EdVerifier, VrfVerifier
 from ..post import verifier as post_verifier
 from ..post.prover import ProofParams
 from ..runtime.queue import KindLanes, LaneGroup, QueueClosed
-from ..utils import metrics, tracing
+from ..utils import metrics, sanitize, tracing
 
 
 class FarmClosed(QueueClosed):
@@ -208,6 +208,11 @@ class VerificationFarm:
             "max_occupancy": 0, "dispatch_s": 0.0, "rejected": 0,
             "queue_peak": {lane.name.lower(): 0 for lane in Lane},
         }
+        # stats are mutated on the LOOP only (backend threads return
+        # results; the loop-side finally block does the accounting) —
+        # owner-write is the runtime twin of that loop-only contract
+        self._shared_stats = sanitize.SharedField("verify.farm.stats",
+                                                  mode="owner-write")
         # lane accounting (bounds, backpressure waiters with the slot
         # handoff, dedup) is the shared runtime's (runtime/queue.py);
         # this farm keeps only the coalescing policy and the backends
@@ -309,6 +314,7 @@ class VerificationFarm:
             raise FarmClosed("farm closed")
         self._bind()
         lane = Lane(lane)
+        self._shared_stats.touch()
         self.stats["requests"] += 1
         metrics.verify_farm_requests.inc(kind=req.kind,
                                          lane=lane.name.lower())
@@ -478,6 +484,7 @@ class VerificationFarm:
             for p in batch:
                 if self._group.dedup.get(p.req.key()) is p:
                     del self._group.dedup[p.req.key()]
+            self._shared_stats.touch()
             self.stats["batches"] += 1
             self.stats["items"] += len(batch)
             if len(batch) > self.stats["max_occupancy"]:

@@ -49,6 +49,14 @@ os.environ.setdefault(
     os.path.join(tempfile.gettempdir(),
                  f"spacemesh-test-romix-{os.getpid()}.json"))
 
+# spacecheck's incremental findings cache (tools/spacecheck/engine.py)
+# must never mix test scratch trees into the developer's real cache
+# file (tests/test_racecheck.py point it at their own tmp paths)
+os.environ.setdefault(
+    "SPACEMESH_SPACECHECK_CACHE",
+    os.path.join(tempfile.gettempdir(),
+                 f"spacemesh-test-spacecheck-{os.getpid()}.json"))
+
 import jax  # noqa: E402  (import order is the point here)
 
 jax.config.update("jax_platforms", "cpu")

@@ -1,10 +1,16 @@
 """API surface over a live standalone node.
 
-De-flaked (ISSUE 8 satellite): the node's signer is a FIXED seed (a
-random key redraws the VRF proposal-slot lottery per run) and the tx
-lifecycle is awaited on CONDITIONS — poll the API until the result
-lands, bounded by virtual time — instead of sleeping a fixed number of
-layers and hoping the spawn got into one of them."""
+De-flaked (ISSUE 8 satellite, finished in ISSUE 12): the node's signer
+is a FIXED seed (a random key redraws the VRF proposal-slot lottery per
+run) and the tx lifecycle is awaited on CONDITIONS — poll the API until
+the result lands, bounded by virtual time — instead of sleeping a fixed
+number of layers and hoping the spawn got into one of them.  The ISSUE
+12 pass removed the last timing cliff: the node used to stop ticking at
+layer 12 while the reward wait alone could burn 15 virtual layers under
+slow real IO (POST init + hare share the wall clock even on a virtual
+loop), so a late-landing reward pushed the spawn past the final layer
+and its result never existed.  The run now carries double the layer
+headroom and every wait is a virtual-deadline condition poll."""
 
 import asyncio
 import hashlib
@@ -52,7 +58,7 @@ def api_env(tmp_path_factory):
         port = await app.start_api()
         app.clock = clock_mod.LayerClock(loop.time() + 1.0, LAYER_SEC,
                                          time_source=loop.time)
-        run = asyncio.create_task(app.run(until_layer=4 * LPE))
+        run = asyncio.create_task(app.run(until_layer=8 * LPE))
         base = f"http://127.0.0.1:{port}"
         async with ClientSession() as s:
             # let a couple of layers pass
@@ -61,12 +67,15 @@ def api_env(tmp_path_factory):
             results["genesis"] = await (await s.get(f"{base}/v1/mesh/genesis")).json()
             results["atxs_e1"] = await (await s.get(f"{base}/v1/mesh/epoch/1/atxs")).json()
             results["smesher"] = await (await s.get(f"{base}/v1/smesher/status")).json()
-            # wait for the first reward so the account can pay the tx fee
+            # wait for the first reward so the account can pay the tx
+            # fee — a virtual-deadline condition poll, leaving at least
+            # half the run's layers for the spawn itself to apply
             coinbase = sdk.wallet_address(app.signer.public_key)
-            for _ in range(60):
+            deadline = loop.time() + 4 * LPE * LAYER_SEC
+            while True:
                 acct = await (await s.get(
                     f"{base}/v1/account/{coinbase.encode()}")).json()
-                if acct["balance"] > 0:
+                if acct["balance"] > 0 or loop.time() >= deadline:
                     break
                 await asyncio.sleep(LAYER_SEC / 4)
             results["acct_pre"] = acct
@@ -83,7 +92,7 @@ def api_env(tmp_path_factory):
             # (bounded by VIRTUAL time, costs no wall clock) instead of
             # sleeping an exact layer count and hoping
             tx_id = results["submit"][1]["tx_id"]
-            deadline = loop.time() + 8 * LAYER_SEC
+            deadline = loop.time() + 10 * LAYER_SEC
             while loop.time() < deadline:
                 tx_doc = await (await s.get(f"{base}/v1/tx/{tx_id}")).json()
                 if tx_doc.get("result") is not None:

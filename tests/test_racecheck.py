@@ -1,0 +1,814 @@
+"""racecheck (ISSUE 12): SC007/SC008 static concurrency rules, the
+spacecheck incremental cache + --jobs, and the runtime lockset race
+sanitizer (SPACEMESH_SANITIZE=race).
+
+Every static rule gets an offending fixture and a fixed/annotated twin;
+the runtime side seeds an unguarded cross-thread write, a lock-order
+inversion and a held-lock-across-await (the last detected both
+statically and at runtime), and stays quiet on the clean multi-tenant
+scheduler path.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+
+import pytest
+
+from spacemesh_tpu.tools.spacecheck import engine
+from spacemesh_tpu.tools.spacecheck.__main__ import main as cli_main
+from spacemesh_tpu.utils import sanitize
+
+
+def run_fixture(tmp_path, rel, source, select=None):
+    path = tmp_path / rel
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(textwrap.dedent(source))
+    findings, errors = engine.run_paths(
+        [str(path)], project_root=str(tmp_path),
+        select={select} if select else None)
+    assert not errors, errors
+    return findings
+
+
+# --- SC007 lock discipline ----------------------------------------------
+
+
+SC007_BAD = """
+    import threading
+
+    class Pool:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._cursor = 0
+            self._t = threading.Thread(target=self._worker)
+
+        def _worker(self):
+            with self._lock:
+                self._cursor += 1
+
+        def snapshot(self):
+            return self._cursor      # bare read off-thread
+"""
+
+
+def test_sc007_flags_mixed_locked_bare_access(tmp_path):
+    fs = run_fixture(tmp_path, "spacemesh_tpu/post/pool.py", SC007_BAD,
+                     select="SC007")
+    assert len(fs) == 1
+    assert "_cursor" in fs[0].message and "snapshot()" in fs[0].message
+
+
+def test_sc007_consistently_locked_twin_is_clean(tmp_path):
+    fs = run_fixture(tmp_path, "spacemesh_tpu/post/pool_ok.py", """
+        import threading
+
+        class Pool:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._cursor = 0
+                self._t = threading.Thread(target=self._worker)
+
+            def _worker(self):
+                with self._lock:
+                    self._cursor += 1
+
+            def snapshot(self):
+                with self._lock:
+                    return self._cursor
+    """, select="SC007")
+    assert not fs
+
+
+def test_sc007_condition_aliases_to_root_lock(tmp_path):
+    # with self._idle (Condition(self._lock)) counts as holding _lock
+    fs = run_fixture(tmp_path, "spacemesh_tpu/post/cond.py", """
+        import threading
+
+        class W:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._idle = threading.Condition(self._lock)
+                self._durable = 0
+                self._t = threading.Thread(target=self._worker)
+
+            def _worker(self):
+                with self._idle:
+                    self._durable += 1
+
+            def durable(self):
+                with self._lock:
+                    return self._durable
+    """, select="SC007")
+    assert not fs
+
+
+def test_sc007_exemptions(tmp_path):
+    fs = run_fixture(tmp_path, "spacemesh_tpu/post/exempt.py", """
+        import threading
+
+        class Pool:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._cursor = 0
+                self._mode = "x"     # written only here: read-only
+                self._t = threading.Thread(target=self._worker)
+
+            def _worker(self):
+                with self._lock:
+                    self._cursor += 1
+
+            def kind(self):
+                return self._mode    # immutable after construction: ok
+
+            # guarded by: self._lock — callers hold it across the pick
+            def pick(self):
+                return self._cursor
+
+            def peek(self):
+                return self._cursor  # guarded by: self._lock (caller)
+
+            def loop_view(self):
+                # spacecheck: loop-only — read on the event loop thread only
+                return self._cursor
+    """, select="SC007")
+    assert not fs
+
+
+def test_sc007_non_threaded_class_is_skipped(tmp_path):
+    fs = run_fixture(tmp_path, "spacemesh_tpu/post/single.py", """
+        import threading
+
+        class Local:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0
+
+            def bump(self):
+                with self._lock:
+                    self._n += 1
+
+            def read(self):
+                return self._n
+    """, select="SC007")
+    assert not fs
+
+
+def test_sc007_container_mutation_counts_as_write(tmp_path):
+    fs = run_fixture(tmp_path, "spacemesh_tpu/post/table.py", """
+        import threading
+
+        class Table:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._jobs = {}
+                self._t = threading.Thread(target=self._worker)
+
+            def _worker(self):
+                with self._lock:
+                    self._jobs.pop("x", None)
+
+            def put(self, k, v):
+                self._jobs[k] = v    # bare container write
+    """, select="SC007")
+    assert len(fs) == 1 and "_jobs" in fs[0].message
+
+
+def test_sc007_nested_closure_is_bare_even_inside_with(tmp_path):
+    # a closure built under the lock RUNS later, without it
+    fs = run_fixture(tmp_path, "spacemesh_tpu/post/closure.py", """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._state = 0
+                self._t = threading.Thread(target=self._worker)
+
+            def _worker(self):
+                with self._lock:
+                    self._state += 1
+
+            def make(self):
+                with self._lock:
+                    return lambda: self._state
+    """, select="SC007")
+    assert len(fs) == 1 and "make()" in fs[0].message
+
+
+# --- SC008 lock order ----------------------------------------------------
+
+
+SC008_BAD = """
+    import threading
+
+    class AB:
+        def __init__(self):
+            self._a = threading.Lock()
+            self._b = threading.Lock()
+
+        def one(self):
+            with self._a:
+                with self._b:
+                    pass
+
+        def two(self):
+            with self._b:
+                with self._a:
+                    pass
+"""
+
+
+def test_sc008_flags_cycle_at_both_edges(tmp_path):
+    fs = run_fixture(tmp_path, "spacemesh_tpu/runtime/ab.py", SC008_BAD,
+                     select="SC008")
+    assert len(fs) == 2
+    assert all("lock-order cycle" in f.message for f in fs)
+
+
+def test_sc008_consistent_order_is_clean(tmp_path):
+    fs = run_fixture(tmp_path, "spacemesh_tpu/runtime/ab_ok.py", """
+        import threading
+
+        class AB:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def one(self):
+                with self._a:
+                    with self._b:
+                        pass
+
+            def two(self):
+                with self._a:
+                    with self._b:
+                        pass
+    """, select="SC008")
+    assert not fs
+
+
+def test_sc008_call_through_edge(tmp_path):
+    fs = run_fixture(tmp_path, "spacemesh_tpu/runtime/call.py", """
+        import threading
+
+        class AB:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def helper(self):
+                with self._b:
+                    pass
+
+            def one(self):
+                with self._a:
+                    self.helper()     # edge a -> b via the call
+
+            def two(self):
+                with self._b:
+                    with self._a:     # edge b -> a: cycle
+                        pass
+    """, select="SC008")
+    assert len(fs) == 2
+    assert any("via self.helper()" in f.message for f in fs)
+
+
+def test_sc008_await_under_threading_lock(tmp_path):
+    fs = run_fixture(tmp_path, "spacemesh_tpu/api/wedge.py", """
+        import asyncio
+        import threading
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            async def bad(self):
+                with self._lock:
+                    await asyncio.sleep(0.1)
+
+            async def good(self):
+                with self._lock:
+                    snapshot = 1
+                await asyncio.sleep(0.1)
+                return snapshot
+    """, select="SC008")
+    assert len(fs) == 1
+    assert "await inside" in fs[0].message and "bad()" in fs[0].message
+
+
+def test_sc008_cross_function_cycle_in_one_module(tmp_path):
+    fs = run_fixture(tmp_path, "spacemesh_tpu/runtime/mod.py", """
+        import threading
+
+        LOCK_A = threading.Lock()
+        LOCK_B = threading.Lock()
+
+        def fwd():
+            with LOCK_A:
+                with LOCK_B:
+                    pass
+
+        def rev():
+            with LOCK_B:
+                with LOCK_A:
+                    pass
+    """, select="SC008")
+    assert len(fs) == 2
+
+
+# --- SC002 extensions ----------------------------------------------------
+
+
+def test_sc002_annotated_queue_binding_is_tracked(tmp_path):
+    # the codebase's own idiom is an ANNOTATED assignment
+    # (`self._q: queue.Queue = queue.Queue(...)`, post/data.py) — the
+    # AnnAssign shape must register the queue var too (review fix)
+    fs = run_fixture(tmp_path, "spacemesh_tpu/api/annq.py", """
+        import queue
+
+        class H:
+            def __init__(self):
+                self._q: queue.Queue = queue.Queue()
+
+            async def bad(self):
+                return self._q.get()
+    """, select="SC002")
+    assert len(fs) == 1 and "get() blocks" in fs[0].message
+
+
+def test_sc002_future_result_and_queue_in_async(tmp_path):
+    fs = run_fixture(tmp_path, "spacemesh_tpu/api/block.py", """
+        import queue
+
+        class H:
+            def __init__(self):
+                self._q = queue.Queue()
+
+            async def bad(self, sched):
+                h = sched.submit_prove("t", "/d", b"c")
+                proof = h.result()            # blocking future wait
+                job = h.future.result()       # ditto through .future
+                item = self._q.get()          # blocking queue handoff
+                self._q.put(item)
+                return proof, job
+
+            async def good(self, txstore, state, tid):
+                res = txstore.result(state, tid)   # argful: a module fn
+                self._q.put_nowait(res)
+                return self._q.get_nowait()
+    """, select="SC002")
+    assert len(fs) == 4
+    msgs = " ".join(f.message for f in fs)
+    assert "h.result()" in msgs and "h.future.result()" in msgs
+    assert "get() blocks" in msgs and "put() blocks" in msgs
+
+
+# --- incremental cache + --jobs ------------------------------------------
+
+
+def _seed_tree(tmp_path):
+    pkg = tmp_path / "spacemesh_tpu" / "sim"
+    pkg.mkdir(parents=True, exist_ok=True)
+    (pkg / "a.py").write_text(
+        "import time\n\ndef bad():\n    return time.time()\n")
+    (pkg / "b.py").write_text("def ok(now):\n    return now + 1\n")
+    return pkg
+
+
+def test_cache_cold_and_warm_runs_are_identical(tmp_path):
+    _seed_tree(tmp_path)
+    cache = str(tmp_path / "cache.json")
+    paths = [str(tmp_path / "spacemesh_tpu")]
+    cold, errs = engine.run_paths(paths, project_root=str(tmp_path),
+                                  cache=cache)
+    assert not errs and cold
+    assert os.path.exists(cache)
+    warm, errs = engine.run_paths(paths, project_root=str(tmp_path),
+                                  cache=cache)
+    assert not errs
+    assert [vars(f) for f in warm] == [vars(f) for f in cold]
+
+
+def test_warm_run_is_a_pure_cache_hit(tmp_path, monkeypatch):
+    # rules never execute on a warm identical tree: crash every rule
+    # and the warm run still reproduces the cold findings
+    _seed_tree(tmp_path)
+    cache = str(tmp_path / "cache.json")
+    paths = [str(tmp_path / "spacemesh_tpu")]
+    cold, _ = engine.run_paths(paths, project_root=str(tmp_path),
+                               cache=cache)
+    monkeypatch.setattr(engine, "_check_context",
+                        lambda *a: (_ for _ in ()).throw(
+                            AssertionError("rules ran on a warm tree")))
+    warm, errs = engine.run_paths(paths, project_root=str(tmp_path),
+                                  cache=cache)
+    assert not errs
+    assert [vars(f) for f in warm] == [vars(f) for f in cold]
+
+
+def test_cache_invalidates_on_any_file_change(tmp_path):
+    pkg = _seed_tree(tmp_path)
+    cache = str(tmp_path / "cache.json")
+    paths = [str(tmp_path / "spacemesh_tpu")]
+    cold, _ = engine.run_paths(paths, project_root=str(tmp_path),
+                               cache=cache)
+    assert len(cold) == 1
+    # cross-file soundness: editing ONE file recomputes the whole tree
+    (pkg / "b.py").write_text(
+        "import time\n\ndef worse():\n    return time.monotonic()\n")
+    fresh, _ = engine.run_paths(paths, project_root=str(tmp_path),
+                                cache=cache)
+    assert len(fresh) == 2
+    warm, _ = engine.run_paths(paths, project_root=str(tmp_path),
+                               cache=cache)
+    assert [vars(f) for f in warm] == [vars(f) for f in fresh]
+
+
+def test_select_runs_bypass_the_cache(tmp_path):
+    _seed_tree(tmp_path)
+    cache = str(tmp_path / "cache.json")
+    findings, _ = engine.run_paths([str(tmp_path / "spacemesh_tpu")],
+                                   project_root=str(tmp_path),
+                                   cache=cache, select={"SC001"})
+    assert findings
+    assert not os.path.exists(cache)
+
+
+def test_jobs_parallel_findings_match_serial(tmp_path):
+    pkg = _seed_tree(tmp_path)
+    (pkg / "c.py").write_text(textwrap.dedent(SC007_BAD))
+    (pkg / "d.py").write_text(textwrap.dedent(SC008_BAD))
+    paths = [str(tmp_path / "spacemesh_tpu")]
+    serial, errs1 = engine.run_paths(paths, project_root=str(tmp_path))
+    par, errs2 = engine.run_paths(paths, project_root=str(tmp_path),
+                                  jobs=3)
+    assert [vars(f) for f in par] == [vars(f) for f in serial]
+    assert errs1 == errs2
+    assert {f.rule for f in serial} >= {"SC001", "SC007", "SC008"}
+
+
+def test_cli_jobs_and_cache_flags(tmp_path, capsys):
+    _seed_tree(tmp_path)
+    cache = str(tmp_path / "cli_cache.json")
+    args = [str(tmp_path / "spacemesh_tpu"), "--root", str(tmp_path),
+            "--cache", cache, "--jobs", "2"]
+    assert cli_main(args) == 1           # the seeded SC001 fails it
+    assert os.path.exists(cache)
+    assert cli_main(args) == 1           # warm: same verdict
+    assert cli_main(args[:3] + ["--no-cache"]) == 1
+
+
+# --- runtime sanitizer: modes + thresholds -------------------------------
+
+
+def test_mode_parsing():
+    assert sanitize.parse_modes("1") == frozenset(sanitize.KINDS)
+    assert sanitize.parse_modes("all") == frozenset(sanitize.KINDS)
+    assert sanitize.parse_modes("race") == {sanitize.KIND_RACE}
+    assert sanitize.parse_modes("lockset") == {sanitize.KIND_RACE}
+    assert sanitize.parse_modes("slow, shape") == \
+        {sanitize.KIND_SLOW, sanitize.KIND_SHAPE}
+    assert sanitize.parse_modes("registry-thread") == \
+        {sanitize.KIND_REGISTRY}
+    assert sanitize.parse_modes("") == frozenset()
+    assert sanitize.parse_modes("off") == frozenset()
+    assert sanitize.parse_modes(None) == frozenset()
+    # unknown tokens are ignored, never arm everything
+    assert sanitize.parse_modes("bogus") == frozenset()
+    assert sanitize.parse_modes("race,bogus") == {sanitize.KIND_RACE}
+
+
+def test_slow_threshold_parsing():
+    assert sanitize.parse_slow_threshold(None) is None
+    assert sanitize.parse_slow_threshold("") is None
+    assert sanitize.parse_slow_threshold("250") == 0.25
+    assert sanitize.parse_slow_threshold("1") == 0.001
+    # edge values fall back to the default, silently neither silencing
+    # nor spamming the check
+    assert sanitize.parse_slow_threshold("0") is None
+    assert sanitize.parse_slow_threshold("-10") is None
+    assert sanitize.parse_slow_threshold("garbage") is None
+
+
+@pytest.fixture
+def race_mode():
+    sanitize.clear_violations()
+    sanitize.enable(modes=["race"])
+    yield sanitize
+    sanitize.disable()
+    sanitize.clear_violations()
+
+
+def test_race_mode_arms_only_race(race_mode):
+    assert sanitize.race_enabled()
+    assert sanitize.enabled(sanitize.KIND_RACE)
+    assert not sanitize.enabled(sanitize.KIND_SLOW)
+    assert not sanitize.enabled(sanitize.KIND_SHAPE)
+    # the shape guard stays dormant under race-only
+    sanitize.on_jit_shape("labels_fused", 7)
+    assert not sanitize.violations()
+
+
+def test_env_boot_race_mode(tmp_path):
+    code = textwrap.dedent("""
+        from spacemesh_tpu.utils import sanitize
+        assert sanitize.enabled()
+        assert sanitize.race_enabled()
+        assert not sanitize.enabled(sanitize.KIND_SLOW)
+        assert isinstance(sanitize.lock("x"), sanitize.TrackedLock)
+        print("race boot ok")
+    """)
+    env = os.environ | {"SPACEMESH_SANITIZE": "race",
+                        "JAX_PLATFORMS": "cpu"}
+    res = subprocess.run([sys.executable, "-c", code], env=env,
+                         capture_output=True, text=True, timeout=120)
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "race boot ok" in res.stdout
+
+
+def test_env_boot_garbage_mode_stays_off():
+    code = ("from spacemesh_tpu.utils import sanitize; "
+            "assert not sanitize.enabled(); print('off ok')")
+    env = os.environ | {"SPACEMESH_SANITIZE": "bogus",
+                        "JAX_PLATFORMS": "cpu"}
+    res = subprocess.run([sys.executable, "-c", code], env=env,
+                         capture_output=True, text=True, timeout=120)
+    assert res.returncode == 0, res.stdout + res.stderr
+
+
+# --- runtime sanitizer: seeded races -------------------------------------
+
+
+def test_seeded_cross_thread_write_with_attribution(race_mode):
+    from spacemesh_tpu.utils import tracing
+
+    tracing.start(capacity=64)
+    try:
+        field = sanitize.SharedField("test.cursor")
+        lock = sanitize.lock("test.lock")
+        with lock:
+            field.touch()                      # thread A, locked
+        seen = {}
+
+        def racer():
+            with tracing.span("racer.write") as sp:
+                seen["span"] = sp.id
+                field.touch()                  # thread B, bare
+
+        t = threading.Thread(target=racer, name="racer")
+        t.start()
+        t.join()
+    finally:
+        tracing.stop()
+    hits = [v for v in sanitize.violations() if v.kind == "race"]
+    assert len(hits) == 1
+    v = hits[0]
+    assert "test.cursor" in v.detail
+    assert v.thread == "racer" and v.stack and "racer" in v.stack
+    assert v.other_stack, "the first thread's stack must be attached"
+    assert v.span == seen["span"]
+
+
+def test_consistent_locking_stays_quiet(race_mode):
+    field = sanitize.SharedField("test.quiet")
+    lock = sanitize.lock("test.quiet.lock")
+    with lock:
+        field.touch()
+
+    def worker():
+        for _ in range(50):
+            with lock:
+                field.touch()
+
+    ts = [threading.Thread(target=worker) for _ in range(3)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert not sanitize.violations()
+
+
+def test_seeded_lock_order_inversion(race_mode):
+    a = sanitize.lock("order.A")
+    b = sanitize.lock("order.B")
+    with a:
+        with b:
+            pass
+
+    def inverted():
+        with b:
+            with a:
+                pass
+
+    t = threading.Thread(target=inverted, name="inverter")
+    t.start()
+    t.join()
+    hits = [v for v in sanitize.violations() if v.kind == "lock-order"]
+    assert len(hits) == 1
+    v = hits[0]
+    assert "order.A" in v.detail and "order.B" in v.detail
+    assert v.stack and "inverted" in v.stack
+    assert v.other_stack, "the first ordering's stack must be attached"
+
+
+def test_condition_wait_releases_held_key(race_mode):
+    lock = sanitize.lock("cond.lock")
+    cond = sanitize.condition("cond.idle", lock)
+    woke = []
+
+    def waiter():
+        with cond:
+            cond.wait(timeout=5)
+            woke.append(True)
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    time.sleep(0.05)
+    with cond:          # acquirable because wait() dropped the lock
+        cond.notify_all()
+    t.join(timeout=5)
+    assert woke and not sanitize.violations()
+
+
+def test_lock_across_await_detected_at_runtime(race_mode):
+    lk = sanitize.lock("held.lock")
+
+    async def wedge():
+        with lk:
+            await asyncio.sleep(0.01)
+
+    asyncio.run(wedge())
+    hits = [v for v in sanitize.violations()
+            if v.kind == "lock-across-await"]
+    assert hits and "held.lock" in hits[0].detail
+
+
+def test_lock_across_await_detected_statically(tmp_path):
+    # the same defect's static twin: SC008 flags it without running
+    fs = run_fixture(tmp_path, "spacemesh_tpu/api/wedge2.py", """
+        import threading
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            async def wedge(self):
+                with self._lock:
+                    await do_io()
+    """, select="SC008")
+    assert len(fs) == 1 and "await inside" in fs[0].message
+
+
+def test_clean_scheduler_e2e_stays_quiet(race_mode, tmp_path):
+    """The fixed tree's multi-tenant path reports nothing — this is the
+    regression test for the ISSUE 12 sweep fixes (the scheduler's
+    _lane_cost_ema EMA is now read-modify-written under the scheduler
+    lock; pre-fix, this exact run reported an empty candidate lockset
+    on runtime.scheduler.tenants' EMA touch)."""
+    import hashlib
+
+    from spacemesh_tpu.runtime import TenantScheduler
+
+    ids = [(f"t{i}", hashlib.sha256(b"rc-n%d" % i).digest(),
+            hashlib.sha256(b"rc-c%d" % i).digest()) for i in range(2)]
+    with TenantScheduler(workers=2, pack_lanes=128,
+                         writer_threads=1) as sched:
+        handles = []
+        for tid, node, commit in ids:
+            sched.register_tenant(tid)
+            handles.append(sched.submit_init(
+                tid, tmp_path / tid, node_id=node, commitment=commit,
+                num_units=1, labels_per_unit=160, scrypt_n=2,
+                max_file_size=1 << 20))
+        for h in handles:
+            h.result(timeout=300)
+        for tid, _, _ in ids:
+            sched.unregister_tenant(tid)
+    bad = sanitize.violations()
+    assert not bad, "\n".join(f"{v.kind}: {v.detail}\n  {v.stack}"
+                              for v in bad)
+
+
+def test_violation_counter_survives_flight_bundle(race_mode, tmp_path):
+    from spacemesh_tpu.obs import flight as flight_mod
+    from spacemesh_tpu.utils import metrics
+
+    before = metrics.sanitize_violations.sample().get(
+        (("kind", "race"),), 0.0)
+    field = sanitize.SharedField("test.flight")
+    lock = sanitize.lock("test.flight.lock")
+    with lock:
+        field.touch()
+    t = threading.Thread(target=field.touch)
+    t.start()
+    t.join()
+    assert [v for v in sanitize.violations() if v.kind == "race"]
+    after = metrics.sanitize_violations.sample()[(("kind", "race"),)]
+    assert after == before + 1
+    rec = flight_mod.FlightRecorder(tmp_path / "spool",
+                                    time_source=lambda: 1000.0)
+    path = rec.dump("test:race", now=1000.0, force=True)
+    assert path is not None
+    bundle = flight_mod.read_bundle(path)
+    prom = (path / "metrics.prom").read_text()
+    assert f'sanitize_violations_total{{kind="race"}} {after}' in prom
+    kinds = {v["kind"] for v in bundle["manifest"]["sanitize_violations"]}
+    assert "race" in kinds
+
+
+def test_owner_write_reset_allows_ownership_handoff(race_mode):
+    # LaneGroup.bind() recreates its state on a new event loop, which
+    # may live on another thread: reset() must forget the dead owner
+    # instead of reporting the sanctioned handoff as a race (review fix)
+    f = sanitize.SharedField("test.handoff", mode="owner-write")
+    f.touch()                       # main thread claims
+
+    def rebound_owner():
+        f.reset()                   # the rebind path
+        f.touch()                   # new owner, legitimately
+
+    t = threading.Thread(target=rebound_owner)
+    t.start()
+    t.join()
+    assert not sanitize.violations()
+
+
+def test_lanegroup_rebind_resets_owner(race_mode):
+    import enum
+
+    from spacemesh_tpu.runtime.queue import LaneGroup
+
+    class L(enum.IntEnum):
+        ONLY = 0
+
+    group = LaneGroup(L, {L.ONLY: 4})
+
+    async def drive():
+        group.bind(asyncio.get_running_loop())
+        group.add(L.ONLY)
+        group.release(L.ONLY)
+
+    asyncio.run(drive())            # first loop: this thread owns
+
+    def second_loop():
+        asyncio.run(drive())        # rebind from ANOTHER thread
+
+    t = threading.Thread(target=second_loop)
+    t.start()
+    t.join()
+    assert not sanitize.violations(), sanitize.violations()
+
+
+def test_enable_unknown_mode_token_is_ignored_not_fatal():
+    sanitize.clear_violations()
+    try:
+        sanitize.enable(modes=["bogus", "race"])
+        assert sanitize.race_enabled()
+        assert not sanitize.enabled(sanitize.KIND_SLOW)
+        sanitize.enable(modes=["slowcallback"])   # typo: nothing arms
+        assert not sanitize.enabled()
+    finally:
+        sanitize.disable()
+
+
+def test_cli_path_subset_does_not_clobber_full_cache(tmp_path):
+    pkg = _seed_tree(tmp_path)
+    cache = str(tmp_path / "cache.json")
+    env_key = "SPACEMESH_SPACECHECK_CACHE"
+    old = os.environ.get(env_key)
+    os.environ[env_key] = cache
+    try:
+        root_args = ["--root", str(tmp_path)]
+        assert cli_main(root_args) == 1          # full default-path run
+        doc = json.loads(open(cache).read())
+        # a targeted run over one file must not overwrite the full-tree
+        # doc with a subset (review fix) ...
+        assert cli_main([str(pkg / "b.py")] + root_args) == 0
+        assert json.loads(open(cache).read()) == doc
+        # ... while an explicit --cache FILE is the caller's own
+        mine = str(tmp_path / "mine.json")
+        assert cli_main([str(pkg / "b.py")] + root_args +
+                        ["--cache", mine]) == 0
+        assert os.path.exists(mine)
+    finally:
+        if old is None:
+            os.environ.pop(env_key, None)
+        else:
+            os.environ[env_key] = old
+
+
+def test_tracked_primitives_off_by_default():
+    sanitize.disable()
+    assert isinstance(sanitize.lock("x"), type(threading.Lock()))
+    assert isinstance(sanitize.condition("x"), threading.Condition)
+    f = sanitize.SharedField("off.field")
+    f.touch()   # no state, no report
+    assert not sanitize.violations()

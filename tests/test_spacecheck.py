@@ -624,6 +624,28 @@ SEEDS = {
               "c = REGISTRY.counter('x_total', 'h')\n"
               "def f(v):\n    c.inc(reason=f'{v}')\n"),
     "SC006": "def f():\n    try:\n        g()\n    except:\n        pass\n",
+    "SC007": ("import threading\n"
+              "class P:\n"
+              "    def __init__(self):\n"
+              "        self._lock = threading.Lock()\n"
+              "        self._n = 0\n"
+              "        self._t = threading.Thread(target=self._w)\n"
+              "    def _w(self):\n"
+              "        with self._lock:\n"
+              "            self._n += 1\n"
+              "    def read(self):\n"
+              "        return self._n\n"),
+    "SC008": ("import threading\n"
+              "A = threading.Lock()\n"
+              "B = threading.Lock()\n"
+              "def f():\n"
+              "    with A:\n"
+              "        with B:\n"
+              "            pass\n"
+              "def g():\n"
+              "    with B:\n"
+              "        with A:\n"
+              "            pass\n"),
 }
 
 
