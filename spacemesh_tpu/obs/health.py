@@ -208,6 +208,20 @@ def default_slos() -> list[Slo]:
     ]
 
 
+def verifyd_slos() -> list[Slo]:
+    """The verification service's SLO set (docs/VERIFYD.md): under
+    overload the service SHEDS rather than queueing — so admitted
+    BLOCK-lane work keeps a tight latency ceiling, and the aggregate
+    p99 a looser one (tests/test_verifyd.py asserts the BLOCK SLO from
+    windowed SLIs with injected time)."""
+    return [
+        Slo(name="verifyd_block_latency", sli="verifyd_request_block_p99",
+            target=0.5, window_s=60.0, budget=0.1),
+        Slo(name="verifyd_request_latency", sli="verifyd_request_p99",
+            target=2.0, window_s=120.0, budget=0.2),
+    ]
+
+
 class _SloState:
     __slots__ = ("marks", "breached", "burn")
 

@@ -87,9 +87,12 @@ def quantile_from_buckets(bounds, counts, q: float) -> float | None:
 class SliSpec:
     """One indicator: which instrument, how to reduce it, over what.
 
-    ``labels`` is an exact labelset filter as a sorted item tuple
-    (``(("kind", "sig"),)``); ``None`` aggregates across every labelset
-    of the instrument (bucket deltas sum, counter deltas sum).
+    ``labels`` is a labelset filter as a sorted item tuple
+    (``(("kind", "sig"),)``): an exact labelset matches directly, and
+    otherwise every series CONTAINING those items aggregates (bucket
+    deltas sum, counter deltas sum) — so ``(("client", "a"),)`` covers
+    all of one verifyd client's ``{client=a, kind=...}`` series.
+    ``None`` aggregates across every labelset of the instrument.
     """
 
     name: str
@@ -135,6 +138,47 @@ def default_slis() -> list[SliSpec]:
     specs.append(SliSpec(name="process_rss_bytes",
                          metric="process_resident_memory_bytes",
                          kind="gauge"))
+    return specs
+
+
+def verifyd_slis() -> list[SliSpec]:
+    """The verification service's indicator set (docs/VERIFYD.md):
+    admitted-request latency quantiles per lane (the overload SLO
+    constrains the BLOCK lane), admission/shed rates, pending depth."""
+    specs: list[SliSpec] = []
+    specs += quantile_slis("verifyd_request_seconds", "verifyd_request")
+    for lane in ("block", "gossip", "sync"):
+        specs.append(SliSpec(name=f"verifyd_request_{lane}_p99",
+                             metric="verifyd_request_seconds",
+                             kind="quantile", q=0.99,
+                             labels=(("lane", lane),)))
+    specs.append(SliSpec(name="verifyd_items_per_sec",
+                         metric="verifyd_items_total", kind="rate"))
+    specs.append(SliSpec(name="verifyd_shed_per_sec",
+                         metric="verifyd_shed_total", kind="rate"))
+    specs.append(SliSpec(name="verifyd_pending_items",
+                         metric="verifyd_pending_items", kind="gauge"))
+    return specs
+
+
+def verifyd_client_slis(clients) -> list[SliSpec]:
+    """Per-client indicators for the given client ids — each spec's
+    labelset filter aggregates every series carrying that ``client``
+    label (admitted items/s, sheds/s, pending depth). The caller scopes
+    the list (e.g. the service's registered clients at engine build
+    time): specs are static, clients churn."""
+    specs: list[SliSpec] = []
+    for cid in clients:
+        key = (("client", str(cid)),)
+        specs.append(SliSpec(name=f"verifyd_client_{cid}_items_per_sec",
+                             metric="verifyd_items_total", kind="rate",
+                             labels=key))
+        specs.append(SliSpec(name=f"verifyd_client_{cid}_shed_per_sec",
+                             metric="verifyd_shed_total", kind="rate",
+                             labels=key))
+        specs.append(SliSpec(name=f"verifyd_client_{cid}_pending",
+                             metric="verifyd_client_pending_items",
+                             kind="gauge", labels=key))
     return specs
 
 
@@ -195,19 +239,34 @@ class SliSampler:
     @staticmethod
     def _sum_counter(data: dict, labels: tuple | None) -> float | None:
         if labels is not None:
-            return data.get(labels)
+            exact = data.get(labels)
+            if exact is not None:
+                return exact
+            # subset semantics: aggregate every series containing the
+            # filter items (a per-entity SLI over multi-label series)
+            items = set(labels)
+            vals = [v for k, v in data.items()
+                    if items.issubset(set(k))]
+            return sum(vals) if vals else None
         return sum(data.values()) if data else None
 
     @staticmethod
     def _sum_hist(data: dict, labels: tuple | None):
-        """-> (bucket counts, total count) aggregated per the filter."""
+        """-> (bucket counts, total count) aggregated per the filter
+        (exact labelset first, else every series containing it)."""
         series = data["series"]
         if labels is not None:
             s = series.get(labels)
-            return (list(s[0]), s[2]) if s is not None else None
+            if s is not None:
+                return (list(s[0]), s[2])
+            items = set(labels)
+            picked = [s for k, s in series.items()
+                      if items.issubset(set(k))]
+        else:
+            picked = list(series.values())
         agg = None
         total = 0
-        for counts, _sum, n in series.values():
+        for counts, _sum, n in picked:
             if agg is None:
                 agg = list(counts)
             else:

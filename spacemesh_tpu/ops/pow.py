@@ -161,3 +161,136 @@ def search(challenge: bytes, node_id: bytes, difficulty: bytes,
 
 def verify(challenge: bytes, node_id: bytes, difficulty: bytes, nonce: int) -> bool:
     return pow_hash(challenge, node_id, nonce) < difficulty
+
+
+# --- batched verification (verifyd / the verify farm's "pow" kind) ------
+#
+# Search batches many NONCES under one (challenge, node_id); verification
+# at service scale batches many ITEMS, each with its own prefix and its
+# own difficulty. Both 64-byte blocks run on device with per-lane state:
+# block 1 is the item's challenge||node_id, block 2 its nonce + padding.
+
+
+@jax.jit
+def below_targets_jit(digest_words, target_words):
+    """Per-lane big-endian 256-bit compare: digest < target.
+
+    digest_words, target_words: (8, B) u32. Returns (B,) bool — the
+    per-lane-target twin of :func:`below_target_jit`.
+    """
+    lt = digest_words < target_words
+    eq = digest_words == target_words
+    out = lt[7]
+    for i in range(6, -1, -1):
+        out = lt[i] | (eq[i] & out)
+    return out
+
+
+@jax.jit
+def pow_verify_batch_jit(block1, nonce_lo, nonce_hi, target_words):
+    """Verify a (B,) batch of (challenge, node_id, nonce, difficulty)
+    witnesses in one two-block SHA-256 pass.
+
+    ``block1``: (16, B) u32 — each item's challenge||node_id words.
+    ``nonce_lo/hi``: (B,) u32. ``target_words``: (8, B) u32 per-item
+    difficulty. Returns (B,) bool.
+    """
+    from .sha256 import byteswap32
+
+    b = nonce_lo.shape[0]
+    st = sha256_compress(
+        jnp.broadcast_to(jnp.asarray(IV)[:, None], (8, b)), block1)
+    tail = np.zeros((14, 1), dtype=np.uint32)
+    tail[0, 0] = 0x80000000
+    tail[13, 0] = _BIT_LEN
+    block2 = jnp.concatenate([
+        byteswap32(nonce_lo)[None],
+        byteswap32(nonce_hi)[None],
+        jnp.broadcast_to(jnp.asarray(tail), (14, b)),
+    ])
+    return below_targets_jit(sha256_compress(st, block2), target_words)
+
+
+def _verify_host(items: list) -> list[bool]:
+    import hashlib
+
+    out = []
+    for challenge, node_id, difficulty, nonce in items:
+        out.append(hashlib.sha256(
+            challenge + node_id + int(nonce).to_bytes(8, "little")
+        ).digest() < difficulty)
+    return out
+
+
+def verify_many(items: list, *, batch: int = 1 << 12,
+                inflight: int = 2, min_device: int = 8,
+                tenant: str = "-") -> list[bool]:
+    """Batched k2pow verification: ``items`` are (challenge, node_id,
+    difficulty, nonce) tuples; returns per-item validity, bit-identical
+    to :func:`verify` on every item.
+
+    Chunks of ``batch`` items run as one device program each through the
+    shared runtime engine (``kind="k2pow_verify"``, ``inflight`` chunks
+    enqueued so host packing of one chunk overlaps the previous chunk's
+    device compute); ragged chunks pad to their power-of-two shape
+    bucket by replicating lane 0, so occupancy changes reuse compiled
+    executables. Batches below ``min_device`` items skip the device
+    round-trip (two hashlib blocks are cheaper than a dispatch), and a
+    device dispatch failure degrades that chunk to the host scan
+    (``runtime_fallbacks_total{kind="k2pow_verify"}``) — never a wrong
+    or missing verdict.
+    """
+    n = len(items)
+    if n == 0:
+        return []
+    for challenge, node_id, difficulty, nonce in items:
+        if len(challenge) != 32 or len(node_id) != 32:
+            raise ValueError("challenge and node_id must be 32 bytes")
+        if len(difficulty) != 32:
+            raise ValueError("difficulty must be 32 bytes")
+        if not 0 <= int(nonce) < 1 << 64:
+            # fail fast and clearly: past this point an out-of-range
+            # nonce would surface as an OverflowError mid-batch
+            raise ValueError("nonce must be an unsigned 64-bit integer")
+    if n < min_device:
+        return _verify_host(items)
+    from ..runtime import engine
+    from . import scrypt
+
+    results = np.zeros(n, dtype=bool)
+
+    def dispatch(rng):
+        lo_i, hi_i = rng
+        chunk = items[lo_i:hi_i]
+        count = len(chunk)
+        pad = max(scrypt.shape_bucket(count), 1)
+        rows = chunk + [chunk[0]] * (pad - count)
+        block1 = np.stack([
+            np.frombuffer(c + nid, dtype=">u4").astype(np.uint32)
+            for c, nid, _d, _n in rows], axis=1)
+        targets = np.stack([
+            _words_be(d) for _c, _nid, d, _n in rows], axis=1)
+        nonces = np.array([x[3] for x in rows], dtype=np.uint64)
+        lo = jnp.asarray((nonces & 0xFFFFFFFF).astype(np.uint32))
+        hi = jnp.asarray((nonces >> 32).astype(np.uint32))
+        return rng, pow_verify_batch_jit(
+            jnp.asarray(block1), lo, hi, jnp.asarray(targets))
+
+    def fallback(rng, exc):
+        del exc  # counted by runtime_fallbacks_total{kind="k2pow_verify"}
+        return rng, None  # marker: retire re-verifies this chunk on host
+
+    def retire(ticket):
+        (lo_i, hi_i), ok = ticket
+        if ok is None:
+            results[lo_i:hi_i] = _verify_host(items[lo_i:hi_i])
+        else:
+            results[lo_i:hi_i] = np.asarray(ok)[:hi_i - lo_i]
+        return None
+
+    pipe = engine.Pipeline(kind="k2pow_verify", tenant=tenant,
+                           inflight=inflight, fallback=fallback,
+                           span="pow_verify")
+    pipe.run(((i, min(i + batch, n)) for i in range(0, n, batch)),
+             dispatch, retire)
+    return results.tolist()

@@ -109,9 +109,31 @@ def verify_many(items: list[VerifyItem], params: ProofParams | None = None,
         labels = scrypt.scrypt_labels_multi(commits[sel], idx[sel], n=n)
         lo, hi = scrypt.split_indices(idx[sel])
         lw = scrypt.labels_to_words(labels)
+        # pad the flat batch to its power-of-two shape bucket (repeat
+        # lane 0, trim after): the label recompute above already
+        # buckets inside scrypt_labels_jit, but an unbucketed
+        # proving-hash pass would compile one executable per DISTINCT
+        # spot-check count — farm batches at varying occupancy turned
+        # every new flat count into a fresh XLA compile
+        b = int(sel.sum())
+        bb = scrypt.shape_bucket(b)
+        if bb > b:
+            pad = bb - b
+
+            def _pad(a, axis=0):
+                reps = np.take(a, [0], axis=axis)
+                return np.concatenate(
+                    [a, np.repeat(reps, pad, axis=axis)], axis=axis)
+
+            chal_b = _pad(chals[:, sel], axis=1)
+            nonce_b = _pad(nonces[sel])
+            lo, hi = _pad(lo), _pad(hi)
+            lw = _pad(lw, axis=1)
+        else:
+            chal_b, nonce_b = chals[:, sel], nonces[sel]
         vals = np.asarray(proving.proving_hash_jit(
-            jnp.asarray(chals[:, sel]), jnp.asarray(nonces[sel]),
-            jnp.asarray(lo), jnp.asarray(hi), jnp.asarray(lw)))
+            jnp.asarray(chal_b), jnp.asarray(nonce_b),
+            jnp.asarray(lo), jnp.asarray(hi), jnp.asarray(lw)))[:b]
         values[sel] = vals
 
     # 3) threshold check per item

@@ -367,6 +367,14 @@ class TenantScheduler:
             t.jobs.clear()
             t.init_jobs.clear()
         metrics.runtime_tenant_queued.remove(tenant=tid)
+        # counters/histograms carrying the tenant beside other labels
+        # drop ALL of that tenant's series too (a churn of short-lived
+        # identities — verifyd clients — must not grow the registry
+        # without bound; the queued-gauge removal alone left these)
+        for inst in (metrics.runtime_tenant_jobs,
+                     metrics.runtime_tenant_labels,
+                     metrics.runtime_quantum_seconds):
+            inst.remove_matching(tenant=tid)
         for job in failed:
             self._resolve(job, error=exc)
         for job in failed_inits:

@@ -14,6 +14,9 @@ enumerate them without importing every pipeline:
 * ``verify``      — the batched POST verifier's recompute shapes
                     (per-lane commitments + proving hash).
 * ``k2pow``       — the SHA-256 nonce-search batch (ops/pow.py).
+* ``k2pow_verify`` — the per-item-prefix k2pow witness verification
+                    batch (ops/pow.py verify_many; the verifyd service
+                    and the farm's "pow" kind dispatch it).
 
 Each kind carries a ``warm(n, batch)`` recipe compiling exactly the
 executables that kind runs at one (N, bucketed batch) shape —
@@ -167,6 +170,33 @@ def _warm_verify(n: int, batch: int) -> dict:
     return doc
 
 
+def _warm_k2pow_verify(n: int, batch: int) -> dict:
+    import hashlib
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..ops import pow as k2pow
+    from ..ops import scrypt
+
+    # the verify path pads ragged chunks to their power-of-two bucket
+    # (ops/pow.py verify_many), so warm exactly that shape
+    b = max(scrypt.shape_bucket(batch), 1)
+    block1 = np.stack([np.frombuffer(
+        hashlib.sha256(b"warm-powv-%d" % i).digest() * 2,
+        dtype=">u4").astype(np.uint32) for i in range(b)], axis=1)
+    targets = np.broadcast_to(
+        np.full((8, 1), 0xFFFFFFFF, dtype=np.uint32), (8, b)).copy()
+    nonces = np.arange(b, dtype=np.uint64)
+    lo = jnp.asarray((nonces & 0xFFFFFFFF).astype(np.uint32))
+    hi = jnp.asarray((nonces >> 32).astype(np.uint32))
+    doc: dict = {"batch": b}
+    _timed(doc, "pow_verify_batch",
+           lambda: k2pow.pow_verify_batch_jit(
+               jnp.asarray(block1), lo, hi, jnp.asarray(targets)))
+    return doc
+
+
 def _warm_k2pow(n: int, batch: int) -> dict:
     import jax.numpy as jnp
     import numpy as np
@@ -198,6 +228,10 @@ VERIFY = register(WorkloadKind(
     _warm_verify))
 K2POW = register(WorkloadKind(
     "k2pow", "SHA-256 k2pow nonce-search batch", _warm_k2pow))
+K2POW_VERIFY = register(WorkloadKind(
+    "k2pow_verify",
+    "per-item-prefix k2pow witness verification batch (verifyd)",
+    _warm_k2pow_verify))
 
 
 # --- packed-init host helpers ------------------------------------------

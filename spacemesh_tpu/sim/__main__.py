@@ -67,10 +67,17 @@ def main(argv=None) -> int:
         if args.seed is not None:
             script["seed"] = args.seed
 
+    # script "engine" selects the runner: the network scenario engine
+    # (default) or the verifyd service-load engine (sim/verifyd_load.py)
+    if script.get("engine") == "verifyd":
+        from .verifyd_load import run_scenario as run_fn
+    else:
+        run_fn = run_scenario
+
     digests, ok = [], True
     result = None
     for i in range(max(args.repeat, 1)):
-        result = run_scenario(script)
+        result = run_fn(script)
         digests.append(result.digest)
         ok = ok and result.ok
         failed = [a for a in result.asserts if not a["ok"]]

@@ -21,6 +21,11 @@ reads wall time.
   returns, another dies for good; the survivors keep applying layers
   and agree on applied blocks and state roots.
 * ``smoke`` — tiny engine self-test (2 full, 8 light, one storm).
+* ``verifyd-load`` — the verification SERVICE under seeded open-loop
+  multi-client load (``"engine": "verifyd"`` dispatches to
+  sim/verifyd_load.py): three light clients + one heavy client over
+  capacity, typed rate sheds on the heavy client only, zero wrong
+  verdicts, replay-stable outcome digest.
 """
 
 from __future__ import annotations
@@ -180,8 +185,44 @@ def timeskew_kill(seed: int = 5, light: int = 16) -> dict:
     }
 
 
+def verifyd_load(seed: int = 7, light: int = 3) -> dict:
+    """Open-loop mixed load from ``light`` in-budget clients plus one
+    heavy client whose offered rate is far over its token budget: the
+    heavy client sheds (typed ``rate``), the light clients never do,
+    and every admitted verdict matches inline verification."""
+    mix = {"sig": 6, "vrf": 1, "membership": 1, "pow": 2, "post": 1}
+    clients = [
+        {"id": f"light-{i}", "rate": 8000.0, "burst": 4000.0,
+         "requests_per_wave": 2, "items": [3, 6], "mix": mix,
+         "lane": "gossip"}
+        for i in range(max(int(light), 1))]
+    clients.append(
+        {"id": "heavy", "rate": 40.0, "burst": 60.0,
+         "requests_per_wave": 4, "items": [6, 10], "mix": mix,
+         "lane": "sync"})
+    return {
+        "name": "verifyd-load", "engine": "verifyd", "seed": seed,
+        "waves": 10, "wave_interval_s": 0.05,
+        "service": {"max_clients": 8, "max_pending_items": 4096,
+                    "workers": 3},
+        "workload": {"sigs": 48, "vrfs": 6, "posts": 4,
+                     "memberships": 8, "pows": 10},
+        "clients": clients,
+        "asserts": [
+            {"kind": "no_wrong_verdicts"},
+            {"kind": "shed", "client": "heavy", "reason": "rate",
+             "min": 3},
+            {"kind": "no_shed", "client": "light-0"},
+            {"kind": "ok_requests", "client": "light-0", "min": 15},
+            {"kind": "bounded_pending", "max": 4096},
+            {"kind": "sli_present", "name": "verifyd_request_p99"},
+        ],
+    }
+
+
 _BUILTINS = {
     "smoke": smoke,
+    "verifyd-load": verifyd_load,
     "partition-heal": partition_heal,
     "storm-256": storm_256,
     "timeskew-kill": timeskew_kill,

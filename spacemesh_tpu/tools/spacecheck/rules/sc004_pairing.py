@@ -43,6 +43,18 @@ teardown runs through fixtures):
   a ``finally`` in the same function, or in a sibling method of the
   same class (the long-lived component split); a gone identity must
   not pin its per-tenant gauge series and fair-share state forever.
+* **verifyd client registration** — ``<service>.register_client(...)``
+  pairs with ``unregister_client`` under the same rules as tenants: a
+  disconnected client that is never unregistered pins its token
+  bucket, scheduler tenant, and every per-client metric series (the
+  cardinality bound the verifyd max_clients knob exists to keep).
+* **verifyd server lifecycle** — a local bound to a
+  ``VerifydServer(...)``/``VerifydService(...)`` construction that is
+  ``start()``ed must ``close()``/``aclose()``/``stop()`` under a
+  ``finally`` in the same function, or escape (returned/stored/passed
+  — the lifecycle is handed elsewhere); a server leaked on the error
+  path strands its scheduler worker threads, farm tasks, and bound
+  sockets.
 
 Suppress a deliberate unpaired site with ``# spacecheck: ok=SC004 <why>``.
 """
@@ -144,6 +156,8 @@ def check(ctx: FileContext, project: ProjectInfo) -> list[Finding]:
         unregisters: list[ast.Call] = []
         t_registers: list[ast.Call] = []
         t_unregisters: list[ast.Call] = []
+        c_registers: list[ast.Call] = []
+        c_unregisters: list[ast.Call] = []
         enters: dict[str, ast.Call] = {}
         exits: dict[str, list[int]] = {}
         for call in calls:
@@ -159,6 +173,10 @@ def check(ctx: FileContext, project: ProjectInfo) -> list[Finding]:
                 t_registers.append(call)
             elif func.attr == "unregister_tenant":
                 t_unregisters.append(call)
+            elif func.attr == "register_client":
+                c_registers.append(call)
+            elif func.attr == "unregister_client":
+                c_unregisters.append(call)
             elif func.attr == "__enter__" and recv and not cm_method:
                 enters[recv] = call
             elif func.attr == "__exit__" and recv:
@@ -215,6 +233,29 @@ def check(ctx: FileContext, project: ProjectInfo) -> list[Finding]:
                     "register_tenant without any unregister_tenant in "
                     "this function or its class: a gone identity pins "
                     "its per-tenant series and scheduler state forever"))
+        for call in c_registers:
+            if any(_in_finally(spans, u.lineno) for u in c_unregisters):
+                continue
+            if c_unregisters:
+                findings.append(ctx.finding(
+                    RULE, call,
+                    "register_client here but the unregister_client in "
+                    "this function is not under finally: the exception "
+                    "path pins the client's token bucket, tenant, and "
+                    "per-client metric series"))
+                continue
+            sib = siblings.get(id(fn), [])
+            paired = any(
+                isinstance(c.func, ast.Attribute)
+                and c.func.attr == "unregister_client"
+                for m in sib for c in _calls_in(m) if m is not fn)
+            if not paired:
+                findings.append(ctx.finding(
+                    RULE, call,
+                    "register_client without any unregister_client in "
+                    "this function or its class: a disconnected client "
+                    "pins its per-client series and admission state "
+                    "forever"))
         for recv, call in enters.items():
             ok = any(_in_finally(spans, ln) and ln > call.lineno
                      for ln in exits.get(recv, []))
@@ -226,6 +267,60 @@ def check(ctx: FileContext, project: ProjectInfo) -> list[Finding]:
                     "leaks the span/context"))
         _check_job_handles(fn, spans)
         _check_local_resources(fn, spans)
+        _check_verifyd_servers(fn, spans)
+
+    def _check_verifyd_servers(fn, spans) -> None:
+        """A locally-constructed VerifydServer/VerifydService that is
+        start()ed must close/aclose/stop under finally, or escape."""
+        nodes = _scoped(fn)
+        owners: dict[str, ast.Assign] = {}
+        for node in nodes:
+            if isinstance(node, ast.Assign) \
+                    and isinstance(node.value, ast.Call) \
+                    and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                cname = dotted_name(node.value.func)
+                if cname and cname.rsplit(".", 1)[-1] in (
+                        "VerifydServer", "VerifydService"):
+                    owners[node.targets[0].id] = node
+        if not owners:
+            return
+        started: dict[str, ast.Call] = {}
+        closed: set[str] = set()
+        escapes: set[str] = set()
+        for node in nodes:
+            if isinstance(node, ast.Call):
+                f = node.func
+                if isinstance(f, ast.Attribute) \
+                        and isinstance(f.value, ast.Name) \
+                        and f.value.id in owners:
+                    if f.attr == "start":
+                        started.setdefault(f.value.id, node)
+                    elif f.attr in ("close", "aclose", "stop") \
+                            and _in_finally(spans, node.lineno):
+                        closed.add(f.value.id)
+                    continue
+                for arg in list(node.args) + [k.value
+                                              for k in node.keywords]:
+                    if isinstance(arg, ast.Name) and arg.id in owners:
+                        escapes.add(arg.id)
+            elif isinstance(node, ast.Return) \
+                    and isinstance(node.value, ast.Name) \
+                    and node.value.id in owners:
+                escapes.add(node.value.id)
+            elif isinstance(node, ast.Assign) \
+                    and isinstance(node.value, ast.Name) \
+                    and node.value.id in owners:
+                escapes.add(node.value.id)
+        for name, call in started.items():
+            if name in closed or name in escapes:
+                continue
+            findings.append(ctx.finding(
+                RULE, call,
+                f"verifyd server {name!r} is started without a "
+                "finally-paired close/aclose/stop and never escapes: "
+                "the error path strands its scheduler workers, farm "
+                "tasks, and bound sockets"))
 
     def _check_job_handles(fn, spans) -> None:
         """Runtime scheduler submits: a JobHandle bound to a local must

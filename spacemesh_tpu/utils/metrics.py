@@ -37,6 +37,26 @@ class _Instrument:
         self._lock = sanitize.lock(f"metrics.{name}")
         self._shared = sanitize.SharedField(f"metrics.{name}.series")
 
+    def _series_map(self) -> dict:
+        return self._values  # Histogram overrides (its map is _series)
+
+    def remove_matching(self, **labels) -> int:
+        """Drop every labelset CONTAINING these label items — the
+        per-entity series-removal pattern (PR 10's
+        ``runtime_tenant_queued.remove``) extended to instruments whose
+        entity label rides with others (``{client=..., kind=...}``):
+        when the entity goes away, all of its series must leave the
+        scrape, or a churn of short-lived clients grows the registry
+        without bound. Returns the number of series removed."""
+        items = set(labels.items())
+        with self._lock:
+            self._shared.touch()
+            m = self._series_map()
+            gone = [k for k in m if items.issubset(set(k))]
+            for k in gone:
+                del m[k]
+        return len(gone)
+
 
 class Counter(_Instrument):
     def __init__(self, name, help_=""):
@@ -112,6 +132,9 @@ class Histogram(_Instrument):
         self.buckets = tuple(buckets)
         # labelset -> [per-bucket counts, sum, count]
         self._series: dict[tuple, list] = {}
+
+    def _series_map(self) -> dict:
+        return self._series
 
     def observe(self, value: float, **labels) -> None:
         key = tuple(sorted(labels.items()))
@@ -413,6 +436,34 @@ verify_farm_dispatch_seconds = REGISTRY.histogram(
     buckets=(0.001, 0.005, 0.02, 0.1, 0.5, 2.0, float("inf")))
 verify_farm_queue_depth = REGISTRY.gauge(
     "verify_farm_queue_depth", "pending requests (label: lane)")
+
+# verifyd — verification-as-a-service (spacemesh_tpu/verifyd/). Every
+# per-client series is REMOVED on unregister (remove_matching above) and
+# the client population is bounded by the service's max_clients knob, so
+# a connect-flood cannot grow the registry without bound.
+verifyd_clients = REGISTRY.gauge(
+    "verifyd_clients", "registered verifyd clients")
+verifyd_client_pending = REGISTRY.gauge(
+    "verifyd_client_pending_items",
+    "admitted items in flight per client (label: client)")
+verifyd_pending = REGISTRY.gauge(
+    "verifyd_pending_items", "admitted items in flight, all clients")
+verifyd_requests = REGISTRY.counter(
+    "verifyd_requests_total",
+    "verification requests by outcome (labels: client, outcome)")
+verifyd_items = REGISTRY.counter(
+    "verifyd_items_total",
+    "verification items admitted (labels: client, kind)")
+verifyd_shed = REGISTRY.counter(
+    "verifyd_shed_total",
+    "requests shed with a typed reason (labels: client, reason)")
+verifyd_request_seconds = REGISTRY.histogram(
+    "verifyd_request_seconds",
+    "admitted request latency, admission to verdicts (label: lane)",
+    buckets=(0.001, 0.005, 0.02, 0.1, 0.5, 2.0, 10.0, float("inf")))
+verifyd_batchtune_races = REGISTRY.counter(
+    "verifyd_batchtune_races_total",
+    "batch-size calibration races run (persisted-rates cache misses)")
 
 # pubsub delivery hardening (p2p/pubsub.py): a raising handler is
 # counted + logged, never allowed to abort delivery to the remaining

@@ -11,8 +11,8 @@ This module is that someone: the continuous-batching pattern from
 inference serving applied to crypto verification.
 
 * Callers submit one :class:`VerifyRequest` (ed25519 signature, VRF
-  proof, POST proof, poet membership) on a priority lane and await a
-  future with the boolean verdict.
+  proof, POST proof, poet membership, k2pow witness) on a priority lane
+  and await a future with the boolean verdict.
 * A per-kind scheduler coalesces pending requests and dispatches a
   batch when it reaches ``max_batch``, when the oldest request's
   lane-latency deadline (2-10 ms) expires, or immediately when the
@@ -69,7 +69,8 @@ KIND_SIG = "sig"
 KIND_VRF = "vrf"
 KIND_POST = "post"
 KIND_MEMBERSHIP = "membership"
-KINDS = (KIND_SIG, KIND_VRF, KIND_POST, KIND_MEMBERSHIP)
+KIND_POW = "pow"
+KINDS = (KIND_SIG, KIND_VRF, KIND_POST, KIND_MEMBERSHIP, KIND_POW)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -133,6 +134,25 @@ class PostRequest:
                 it.proof.pow_nonce, tuple(it.proof.indices))
 
 
+@dataclasses.dataclass(frozen=True)
+class PowRequest:
+    """k2pow witness check (ops/pow.py verify semantics): the
+    verification half of the proof-gating proof-of-work, batched across
+    items with per-item prefixes and difficulties (verifyd routes remote
+    nodes' witness checks here)."""
+
+    challenge: bytes
+    node_id: bytes
+    difficulty: bytes
+    nonce: int
+
+    kind = KIND_POW
+
+    def key(self) -> tuple:
+        return (KIND_POW, self.challenge, self.node_id, self.difficulty,
+                self.nonce)
+
+
 class _Pending:
     __slots__ = ("req", "lane", "future", "enqueued", "deadline", "span")
 
@@ -184,7 +204,8 @@ class VerificationFarm:
                  max_wait_s: dict[Lane, float] | None = None,
                  lane_bounds: dict[Lane, int] | None = None,
                  sig_threads: int | None = None,
-                 stall_deadline_s: float = 30.0):
+                 stall_deadline_s: float = 30.0,
+                 tuner=None):
         self.ed_verifier = ed_verifier or EdVerifier()
         self.vrf_verifier = vrf_verifier or VrfVerifier()
         self.post_params = post_params or ProofParams()
@@ -201,6 +222,13 @@ class VerificationFarm:
         if lane_bounds:
             self.lane_bounds.update(lane_bounds)
         self._sig_threads = sig_threads
+        # optional speculative batch-sizing policy (verifyd/batchtune.py
+        # BatchTuner, or anything with note_arrival/observe/target_batch/
+        # dispatch_now): sizes batches from MEASURED per-kind device
+        # rates and dispatches a partially-full batch as soon as the
+        # marginal wait for more items exceeds the predicted throughput
+        # gain. None keeps the static max_batch + deadline policy.
+        self._tuner = tuner
         self._pool = None  # lazy ThreadPoolExecutor for sig/vrf fan-out
         self._loop: asyncio.AbstractEventLoop | None = None
         self.stats = {
@@ -318,6 +346,9 @@ class VerificationFarm:
         self.stats["requests"] += 1
         metrics.verify_farm_requests.inc(kind=req.kind,
                                          lane=lane.name.lower())
+        if self._tuner is not None:
+            # arrival-rate EWMA feeds the speculative dispatch decision
+            self._tuner.note_arrival(req.kind, self._loop.time())
         key = req.key()
         ent = self._group.dedup.get(key)
         if ent is not None and not ent.future.done():
@@ -383,9 +414,16 @@ class VerificationFarm:
                 # one loop turn so same-tick submitters (gather bursts)
                 # land in this batch
                 await asyncio.sleep(0)
-                await self._coalesce(st)
+                await self._coalesce(kind, st)
                 if self._closed:
                     break
+                # take() is NOT capped at the tuned target: the target
+                # is the occupancy worth WAITING for, and a deeper
+                # backlog dispatching as one batch both amortizes
+                # better and feeds the tuner observations above the
+                # target — capping at the target would lock a
+                # collapsed model in place (it could never measure a
+                # fuller batch again)
                 batch = st.lanes.take(self.max_batch)
                 if not batch:
                     continue
@@ -396,15 +434,41 @@ class VerificationFarm:
         except asyncio.CancelledError:
             pass
 
-    async def _coalesce(self, st: _KindState) -> None:
+    def _batch_limit(self, kind: str) -> int:
+        """Per-kind batch-size cap: the tuner's measured-rate target when
+        one is attached (capped by max_batch — the device/memory bound),
+        else max_batch."""
+        if self._tuner is not None:
+            target = self._tuner.target_batch(kind)
+            if target:
+                return max(1, min(int(target), self.max_batch))
+        return self.max_batch
+
+    def _tuner_go(self, kind: str, st: _KindState, n: int,
+                  now: float) -> bool:
+        """Speculative early dispatch: the tuner predicts (from measured
+        per-kind rates + the arrival EWMA) that waiting for a fuller
+        batch costs more than it gains. Never extends the lane deadline
+        — it can only dispatch EARLIER than the 2-10 ms window."""
+        if self._tuner is None:
+            return False
+        oldest = min((q[0].enqueued for q in st.lanes.lanes.values()
+                      if q), default=now)
+        return bool(self._tuner.dispatch_now(kind, n,
+                                             max(now - oldest, 0.0)))
+
+    async def _coalesce(self, kind: str, st: _KindState) -> None:
         """Hold the batch open until it is worth dispatching.
 
-        Dispatch NOW when: the batch is full; the backend is idle (a lone
-        request must not wait out the coalescing window); or the oldest
-        pending deadline has passed and an in-flight slot is free. The
-        in-flight cap throttles small-batch churn under load — but a
-        pending BLOCK request bypasses the cap, so a saturated sync lane
-        can never delay block-critical dispatch beyond its deadline."""
+        Dispatch NOW when: the batch is full (the per-kind tuned target
+        when a batch tuner is attached); the backend is idle (a lone
+        request must not wait out the coalescing window); the oldest
+        pending deadline has passed and an in-flight slot is free; or
+        the tuner's speculative model says the marginal wait for more
+        items exceeds the predicted throughput gain. The in-flight cap
+        throttles small-batch churn under load — but a pending BLOCK
+        request bypasses the cap, so a saturated sync lane can never
+        delay block-critical dispatch beyond its deadline."""
         while not self._closed:
             n = st.lanes.count()
             if n == 0:
@@ -416,10 +480,22 @@ class VerificationFarm:
             # pending BLOCK request bypasses the cap.
             can_go = (len(st.inflight) < self.max_inflight
                       or bool(st.lanes.lanes[Lane.BLOCK]))
-            if can_go and (n >= self.max_batch
-                           or not st.inflight
-                           or st.lanes.earliest_deadline()
-                           <= self._loop.time()):
+            now = self._loop.time()
+            if self._tuner is None:
+                # static policy: full batch, idle fast-path, deadline
+                go = (n >= self.max_batch
+                      or not st.inflight
+                      or st.lanes.earliest_deadline() <= now)
+            else:
+                # tuned policy: the idle fast-path routes through the
+                # speculative model too — under service load an idle
+                # backend must not slice a filling batch into
+                # fragments, and with no model yet (or arrivals gone
+                # quiet) dispatch_now returns the fast-path answer
+                go = (n >= self._batch_limit(kind)
+                      or st.lanes.earliest_deadline() <= now
+                      or self._tuner_go(kind, st, n, now))
+            if can_go and go:
                 return
             st.arrived.clear()
             arr = self._loop.create_task(st.arrived.wait())
@@ -479,6 +555,12 @@ class VerificationFarm:
                     p.future.set_result(bool(ok))
                 if not bool(ok):
                     self.stats["rejected"] += 1
+            if self._tuner is not None:
+                # successful batches only refine the tuner's model — a
+                # backend that RAISED in milliseconds must not record a
+                # phantom items/s rate
+                self._tuner.observe(kind, len(batch),
+                                    time.perf_counter() - t0)
         finally:
             dt = time.perf_counter() - t0
             for p in batch:
@@ -517,6 +599,12 @@ class VerificationFarm:
                                       r.leaf_count) for r in reqs]
         if kind == KIND_POST:
             return self._verify_posts(reqs)
+        if kind == KIND_POW:
+            from ..ops import pow as k2pow
+
+            return k2pow.verify_many(
+                [(r.challenge, r.node_id, r.difficulty, r.nonce)
+                 for r in reqs])
         raise ValueError(f"unknown verify kind {kind!r}")
 
     def _verify_sig(self, r: SigRequest) -> bool:

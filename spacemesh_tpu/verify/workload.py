@@ -2,7 +2,8 @@
 profiler's --verify-farm view, and tests/test_verify_farm.py.
 
 A workload is a list of farm requests (signatures, VRF proofs, POST
-proofs, poet memberships) with a controlled invalid/malformed fraction,
+proofs, poet memberships, k2pow witnesses) with a controlled
+invalid/malformed fraction,
 plus the inline oracle that verifies each request exactly the way the
 pre-farm handlers did — the parity target the farm must match
 bit-for-bit (ISSUE 2 acceptance).
@@ -17,7 +18,13 @@ import random
 from ..core.signing import Domain, EdSigner, EdVerifier, VrfVerifier
 from ..post import verifier as post_verifier
 from ..post.prover import Proof as PostProof, ProofParams, Prover
-from .farm import MembershipRequest, PostRequest, SigRequest, VrfRequest
+from .farm import (
+    MembershipRequest,
+    PostRequest,
+    PowRequest,
+    SigRequest,
+    VrfRequest,
+)
 
 # tiny-but-real POST geometry (profiler.verify_benchmark uses the same):
 # scrypt N=2 keeps the label recompute sub-second on CPU while running
@@ -52,6 +59,11 @@ class Workload:
         if isinstance(req, PostRequest):
             return post_verifier.verify(req.item, self.post_params,
                                         seed=self.post_seed)
+        if isinstance(req, PowRequest):
+            from ..ops import pow as k2pow
+
+            return k2pow.verify(req.challenge, req.node_id,
+                                req.difficulty, req.nonce)
         raise TypeError(f"unknown request {type(req).__name__}")
 
     def inline_all(self) -> list[bool]:
@@ -63,7 +75,7 @@ def _corrupt(data: bytes, pos: int) -> bytes:
 
 
 def build(post_dir: str, *, sigs: int = 64, vrfs: int = 8, posts: int = 16,
-          memberships: int = 8, post_challenges: int = 4,
+          memberships: int = 8, pows: int = 0, post_challenges: int = 4,
           invalid_frac: float = 0.125, rng_seed: int = 7) -> Workload:
     """Build a deterministic mixed workload.
 
@@ -139,6 +151,34 @@ def build(post_dir: str, *, sigs: int = 64, vrfs: int = 8, posts: int = 16,
                     proof, nodes=[_corrupt(n, 0) for n in proof.nodes])
         requests.append(MembershipRequest(member, proof, root,
                                           len(members)))
+
+    # --- k2pow witnesses ---------------------------------------------
+    if pows > 0:
+        from ..ops import pow as k2pow
+
+        pow_challenge = hashlib.sha256(b"wl-pow-challenge").digest()
+        pow_node = hashlib.sha256(b"wl-pow-node").digest()
+        # easy difficulty so honest witnesses are found in a few hashes
+        difficulty = bytes([0x20]) + bytes([0xFF]) * 31
+        nonce, found = 0, []
+        while len(found) < max(pows // 2, 2):
+            if k2pow.verify(pow_challenge, pow_node, difficulty, nonce):
+                found.append(nonce)
+            nonce += 1
+        for i in range(pows):
+            chall, node, diff = pow_challenge, pow_node, difficulty
+            witness = found[i % len(found)]
+            if bad(i):
+                mode = i % 3
+                if mode == 0:
+                    witness = witness + 1  # walk to a guaranteed miss
+                    while k2pow.verify(chall, node, diff, witness):
+                        witness += 1
+                elif mode == 1:
+                    chall = _corrupt(chall, 0)  # wrong prefix
+                else:
+                    diff = bytes(32)  # impossible difficulty
+            requests.append(PowRequest(chall, node, diff, witness))
 
     # --- POST proofs --------------------------------------------------
     if posts > 0:

@@ -1,0 +1,34 @@
+"""verifyd — standalone verification-as-a-service (docs/VERIFYD.md).
+
+The in-process farm (spacemesh_tpu/verify/) promoted to a network
+service: a gRPC + HTTP admission front-end that verifies signatures,
+VRF proofs, NIPoST proofs, poet memberships, and k2pow witnesses for
+REMOTE nodes — per-client token-bucket admission with typed load
+shedding, stride fair share + EDF deadlines through the device runtime
+(one tenant per client), and continuous batching with speculative
+batch sizing (batchtune.py) into the farm's device batchers.
+
+    python -m spacemesh_tpu.verifyd --listen 127.0.0.1:9443
+
+Layout: service.py (admission core), server.py (sockets), client.py
+(cookbook client), batchtune.py (measured batch-size model),
+protocol.py (wire codec).
+"""
+
+from .batchtune import BatchTuner
+from .client import VerifydClient
+from .protocol import ProtocolError, request_from_doc, request_to_doc
+from .server import VerifydServer
+from .service import Shed, VerifydClosed, VerifydService
+
+__all__ = [
+    "BatchTuner",
+    "ProtocolError",
+    "Shed",
+    "VerifydClient",
+    "VerifydClosed",
+    "VerifydServer",
+    "VerifydService",
+    "request_from_doc",
+    "request_to_doc",
+]

@@ -1,0 +1,159 @@
+"""verifyd client library: the cookbook's reference implementation.
+
+:class:`VerifydClient` talks JSON-over-HTTP to a verifyd server
+(aiohttp; one session reused across calls), re-raising the server's
+typed shed docs as :class:`~.service.Shed` so an embedding node can
+react to ``reason``/``retry_after_s`` instead of parsing bodies.  The
+gRPC transport carries the identical docs — ``grpc_verify`` shows the
+two-line difference for callers who prefer HTTP/2 framing.
+
+``serial_verify`` is the one-item-at-a-time driver: it exists as the
+honest BASELINE the bench's open-loop load is compared against (the
+pre-service shape: every remote check pays a full round trip and a
+solo batch), and as the simplest possible integration example.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from . import protocol
+from .service import Shed
+
+
+class VerifydClient:
+    """HTTP client for one verifyd endpoint.
+
+    Lifecycle: construct -> ``register()`` -> ``verify(...)`` ->
+    ``aclose()`` in a ``finally`` (unregisters by default, so the
+    server's per-client series and tenant state go away with us —
+    the lifecycle spacecheck SC004 pins on package code).
+    """
+
+    def __init__(self, base_url: str, client_id: str, *,
+                 session=None, unregister_on_close: bool = True):
+        self.base_url = base_url.rstrip("/")
+        self.client_id = str(client_id)
+        self._session = session
+        self._own_session = session is None
+        self._unregister_on_close = unregister_on_close
+        self._registered = False
+
+    async def _sess(self):
+        if self._session is None:
+            import aiohttp
+
+            self._session = aiohttp.ClientSession()
+        return self._session
+
+    async def _post(self, path: str, body: dict) -> tuple[int, dict]:
+        sess = await self._sess()
+        async with sess.post(self.base_url + path, json=body) as resp:
+            if resp.content_type == "application/json":
+                return resp.status, await resp.json()
+            return resp.status, {"status": "ERROR",
+                                 "error": await resp.text()}
+
+    @staticmethod
+    def _raise_typed(doc: dict) -> None:
+        if doc.get("status") == "SHED":
+            raise Shed(doc.get("reason", "unknown"),
+                       doc.get("detail", ""), doc.get("retry_after_s"))
+        if doc.get("status") == "ERROR":
+            raise protocol.ProtocolError(doc.get("error", "bad request"))
+
+    async def register(self, **kwargs) -> dict:
+        """Register this client id (weight/rate/burst/max_queued/
+        max_inflight keywords forward to the server)."""
+        status, doc = await self._post(
+            "/v1/client/register", {"client": self.client_id, **kwargs})
+        self._raise_typed(doc)
+        if status != 200:
+            raise protocol.ProtocolError(f"register failed: {doc}")
+        self._registered = True
+        return doc
+
+    async def unregister(self) -> None:
+        self._registered = False
+        await self._post("/v1/client/unregister",
+                         {"client": self.client_id})
+
+    async def verify(self, reqs: list, *, lane: str = "gossip",
+                     deadline_s: float | None = None) -> list[bool]:
+        """Verify a batch of farm request objects; raises the server's
+        typed Shed on rejection."""
+        body = {"client": self.client_id, "lane": lane,
+                "items": [protocol.request_to_doc(r) for r in reqs]}
+        if deadline_s is not None:
+            body["deadline_s"] = deadline_s
+        _status, doc = await self._post("/v1/verify", body)
+        self._raise_typed(doc)
+        verdicts = doc.get("verdicts")
+        if doc.get("status") != "OK" or not isinstance(verdicts, list):
+            raise protocol.ProtocolError(f"verify failed: {doc}")
+        return [bool(v) for v in verdicts]
+
+    async def serial_verify(self, reqs: list, *,
+                            lane: str = "gossip") -> list[bool]:
+        """One item per request, awaited one at a time — the serial
+        baseline shape (bench.py compares open-loop load against this).
+        """
+        out: list[bool] = []
+        for r in reqs:
+            out.extend(await self.verify([r], lane=lane))
+        return out
+
+    async def stats(self) -> dict:
+        sess = await self._sess()
+        async with sess.get(self.base_url + "/v1/stats") as resp:
+            return await resp.json()
+
+    async def aclose(self) -> None:
+        try:
+            if self._registered and self._unregister_on_close:
+                await self.unregister()
+        finally:
+            if self._own_session and self._session is not None:
+                await self._session.close()
+                self._session = None
+
+
+async def grpc_verify(target: str, client_id: str, reqs: list, *,
+                      lane: str = "gossip",
+                      deadline_s: float | None = None) -> list[bool]:
+    """One verify call over the gRPC surface (same docs as HTTP; see
+    server.py).  ``target`` is "host:port" of the gRPC listener."""
+    import json
+
+    import grpc
+
+    body = {"client": str(client_id), "lane": lane,
+            "items": [protocol.request_to_doc(r) for r in reqs]}
+    if deadline_s is not None:
+        body["deadline_s"] = deadline_s
+    async with grpc.aio.insecure_channel(target) as channel:
+        call = channel.unary_unary(
+            "/spacemesh.verifyd.Verifyd/Verify",
+            request_serializer=lambda d: json.dumps(d).encode(),
+            response_deserializer=lambda b: json.loads(b or b"{}"))
+        doc = await call(body)
+    VerifydClient._raise_typed(doc)
+    if doc.get("status") != "OK":
+        raise protocol.ProtocolError(f"verify failed: {doc}")
+    return [bool(v) for v in doc.get("verdicts", [])]
+
+
+def run_serial_baseline(base_url: str, client_id: str, reqs: list,
+                        *, lane: str = "gossip") -> list[bool]:
+    """Synchronous convenience wrapper (bench.py, CLI smoke): register,
+    verify one item at a time, unregister."""
+
+    async def go():
+        client = VerifydClient(base_url, client_id)
+        try:
+            await client.register()
+            return await client.serial_verify(reqs, lane=lane)
+        finally:
+            await client.aclose()
+
+    return asyncio.run(go())

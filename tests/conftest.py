@@ -49,6 +49,16 @@ os.environ.setdefault(
     os.path.join(tempfile.gettempdir(),
                  f"spacemesh-test-romix-{os.getpid()}.json"))
 
+# the verifyd batch tuner (verifyd/batchtune.py) mirrors the ROMix
+# autotuner's discipline: no implicit backend races under test, and
+# never persist measured rates into the developer's real cache root
+# (tests that want a race opt back in with monkeypatch)
+os.environ.setdefault("SPACEMESH_VERIFYD_TUNE", "off")
+os.environ.setdefault(
+    "SPACEMESH_VERIFYD_TUNE_CACHE",
+    os.path.join(tempfile.gettempdir(),
+                 f"spacemesh-test-batchtune-{os.getpid()}.json"))
+
 # spacecheck's incremental findings cache (tools/spacecheck/engine.py)
 # must never mix test scratch trees into the developer's real cache
 # file (tests/test_racecheck.py point it at their own tmp paths)

@@ -419,6 +419,74 @@ def test_sc004_register_tenant_pairing(tmp_path):
     assert fs[0].line == 3
 
 
+def test_sc004_register_client_pairing(tmp_path):
+    """The verifyd client lifecycle (ISSUE 13): registration without a
+    paired unregister pins per-client series and admission state."""
+    fs = run_fixture(tmp_path, "spacemesh_tpu/verifyd/clients.py", """
+        def bad(service):
+            service.register_client("alice")
+            serve()
+
+        def good_finally(service):
+            service.register_client("bob")
+            try:
+                serve()
+            finally:
+                service.unregister_client("bob")
+
+        class Gateway:
+            def on_connect(self, service, cid):
+                service.register_client(cid)
+
+            def on_disconnect(self, service, cid):
+                service.unregister_client(cid)
+    """, select="SC004")
+    assert len(fs) == 1 and "register_client" in fs[0].message
+    assert fs[0].line == 3
+
+
+def test_sc004_register_client_unpaired_off_finally(tmp_path):
+    fs = run_fixture(tmp_path, "spacemesh_tpu/verifyd/leaky.py", """
+        def run(service):
+            service.register_client("a")
+            serve()   # raises -> unregister skipped
+            service.unregister_client("a")
+    """, select="SC004")
+    assert len(fs) == 1 and "not under finally" in fs[0].message
+
+
+def test_sc004_verifyd_server_start_close_pairing(tmp_path):
+    """A started verifyd server needs a finally-paired close (or must
+    escape — the lifecycle is handed elsewhere)."""
+    fs = run_fixture(tmp_path, "spacemesh_tpu/tools/verifyd_cli.py", """
+        from ..verifyd import VerifydServer
+
+        async def bad():
+            server = VerifydServer(listen="127.0.0.1:0")
+            await server.start()
+            await serve_forever()
+
+        async def good():
+            server = VerifydServer(listen="127.0.0.1:0")
+            try:
+                await server.start()
+                await serve_forever()
+            finally:
+                await server.close()
+
+        async def escapes(registry):
+            server = VerifydServer(listen="127.0.0.1:0")
+            await server.start()
+            return server   # caller owns the lifecycle now
+
+        async def never_started():
+            server = VerifydServer(listen="127.0.0.1:0")
+            return describe(server.port)
+    """, select="SC004")
+    assert len(fs) == 1 and "finally-paired close" in fs[0].message
+    assert fs[0].line == 6  # anchored at the start() call
+
+
 # --- SC005 metrics hygiene ----------------------------------------------
 
 

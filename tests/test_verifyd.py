@@ -1,0 +1,728 @@
+"""verifyd — verification-as-a-service (spacemesh_tpu/verifyd/).
+
+The acceptance properties (ISSUE 13): verdicts bit-identical to inline
+verification through admission + fair share + continuous batching;
+typed SHED responses (never silent drops) with heavy-client-first
+fairness under overload, asserted from windowed SLIs with injected
+time and zero sleeps; per-client metric series bounded under client
+churn; graceful drain with zero stranded futures; the speculative
+batch-sizing model's race/persist/policy contracts; and the wire
+protocol over real sockets (HTTP + gRPC carrying identical docs).
+"""
+
+import asyncio
+import json
+import threading
+import time
+
+import pytest
+
+from spacemesh_tpu.obs import health as health_mod
+from spacemesh_tpu.obs import sli as sli_mod
+from spacemesh_tpu.utils import metrics, tracing
+from spacemesh_tpu.verify import workload
+from spacemesh_tpu.verify.farm import Lane, PowRequest, SigRequest
+from spacemesh_tpu.verifyd import (
+    Shed,
+    VerifydClient,
+    VerifydServer,
+    VerifydService,
+    batchtune,
+    protocol,
+)
+
+
+@pytest.fixture(scope="module")
+def wl(tmp_path_factory):
+    """One small mixed workload (every kind, malformed items included)
+    per module — the POST init + proofs inside are the expensive part."""
+    d = tmp_path_factory.mktemp("verifyd-wl")
+    return workload.build(str(d), sigs=16, vrfs=4, posts=6,
+                          memberships=4, pows=8, post_challenges=2)
+
+
+@pytest.fixture(scope="module")
+def expected(wl):
+    return wl.inline_all()
+
+
+def _service(wl, **kw):
+    kw.setdefault("workers", 3)
+    svc = VerifydService(post_params=wl.post_params,
+                         post_seed=wl.post_seed, **kw)
+    svc.farm.ed_verifier = wl.ed
+    svc.farm.vrf_verifier = wl.vrf
+    return svc
+
+
+class _Clock:
+    def __init__(self, t=1000.0):
+        self.t = float(t)
+
+    def now(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def _run(coro):
+    return asyncio.run(coro)
+
+
+# --- parity + tracing ----------------------------------------------------
+
+
+def test_service_parity_and_span_linkage(wl, expected):
+    """Admitted verdicts are bit-identical to inline verification, and
+    a client request decomposes verifyd.request -> verifyd.drain ->
+    farm.request -> farm.batch in one capture (the worker-thread hop
+    re-parents explicitly)."""
+
+    async def go():
+        svc = _service(wl)
+        try:
+            await svc.start()
+            svc.register_client("alice")
+            got = await svc.verify("alice", wl.requests)
+            assert got == expected
+        finally:
+            await svc.aclose()
+
+    tracing.start(capacity=65536)
+    try:
+        _run(go())
+    finally:
+        tracing.stop()
+    doc = tracing.export()
+    tracing.validate(doc)
+    spans = [e for e in doc["traceEvents"]
+             if e["ph"] == "X" and "id" in e.get("args", {})]
+    reqs = [e for e in spans if e["name"] == "verifyd.request"]
+    drains = [e for e in spans if e["name"] == "verifyd.drain"]
+    farm_reqs = [e for e in spans if e["name"] == "farm.request"]
+    batches = [e for e in spans if e["name"] == "farm.batch"]
+    assert reqs and drains and farm_reqs and batches
+    req_ids = {e["args"]["id"] for e in reqs}
+    assert any(e["args"].get("parent") in req_ids for e in drains), \
+        "drain spans must parent into the request span across the hop"
+    drain_ids = {e["args"]["id"] for e in drains}
+    linked = [e for e in farm_reqs
+              if e["args"].get("parent") in drain_ids]
+    assert linked, "farm.request must chain under verifyd.drain"
+    batch_ids = {e["args"]["id"] for e in batches}
+    assert any(e["args"].get("batch") in batch_ids for e in linked), \
+        "the request chain must link to its farm.batch"
+
+
+def test_empty_and_unregistered(wl):
+    async def go():
+        svc = _service(wl)
+        try:
+            await svc.start()
+            svc.register_client("a")
+            assert await svc.verify("a", []) == []
+            with pytest.raises(Shed) as ei:
+                await svc.verify("ghost", wl.requests[:1])
+            assert ei.value.reason == protocol.SHED_UNREGISTERED
+        finally:
+            await svc.aclose()
+
+    _run(go())
+
+
+# --- typed admission -----------------------------------------------------
+
+
+def test_registry_full_typed_and_bounded(wl):
+    async def go():
+        svc = _service(wl, max_clients=2)
+        try:
+            await svc.start()
+            svc.register_client("a")
+            svc.register_client("b")
+            with pytest.raises(Shed) as ei:
+                svc.register_client("c")
+            assert ei.value.reason == protocol.SHED_REGISTRY_FULL
+            # re-registering an existing client is reconfig, not growth
+            svc.register_client("a", weight=2.0)
+            assert len(svc.clients) == 2
+            # every unspecified knob KEEPS its value: a rate-only
+            # update must not silently reset the fair-share weight
+            svc.register_client("a", rate=9000.0)
+            assert svc.clients["a"].weight == 2.0
+            assert svc.clients["a"].bucket.rate == 9000.0
+            assert svc.scheduler._tenants["a"].weight == 2.0
+        finally:
+            await svc.aclose()
+
+    _run(go())
+
+
+def test_rate_shed_typed_with_injected_refill(wl, expected):
+    """Token-bucket shed carries retry_after_s; advancing the INJECTED
+    clock (no sleeps) refills and re-admits."""
+    clock = _Clock()
+
+    async def go():
+        svc = _service(wl, time_source=clock.now)
+        try:
+            await svc.start()
+            # budget for exactly one 2-sig request (cost 2), no refill
+            # to speak of within the test window
+            svc.register_client("a", rate=0.5, burst=2.0)
+            reqs = [r for r in wl.requests
+                    if isinstance(r, SigRequest)][:2]
+            got = await svc.verify("a", reqs)
+            assert got == [wl.inline_verify(r) for r in reqs]
+            with pytest.raises(Shed) as ei:
+                await svc.verify("a", reqs)
+            assert ei.value.reason == protocol.SHED_RATE
+            assert ei.value.retry_after_s > 0
+            assert ei.value.to_doc()["status"] == "SHED"
+            clock.advance(ei.value.retry_after_s + 0.1)
+            got = await svc.verify("a", reqs)
+            assert got == [wl.inline_verify(r) for r in reqs]
+        finally:
+            await svc.aclose()
+
+    _run(go())
+
+
+def test_deadline_shed_predicts_miss(wl):
+    clock = _Clock()
+
+    async def go():
+        svc = _service(wl, time_source=clock.now)
+        try:
+            await svc.start()
+            svc.register_client("a")
+            # white-box backlog: 1000 pending at 10 items/s -> 100 s
+            svc._pending_items, svc._rate_ewma = 1000, 10.0
+            try:
+                with pytest.raises(Shed) as ei:
+                    await svc.verify("a", wl.requests[:1],
+                                     deadline_s=1.0)
+            finally:
+                svc._pending_items, svc._rate_ewma = 0, 0.0
+            assert ei.value.reason == protocol.SHED_DEADLINE
+            assert ei.value.retry_after_s == pytest.approx(100.0)
+        finally:
+            await svc.aclose()
+
+    _run(go())
+
+
+# --- overload: fairness, typed sheds, bounded queue, SLIs ---------------
+
+
+def _gate_farm(svc):
+    """Hold every farm backend dispatch behind a threading.Event so
+    pending work accumulates deterministically (no timing races)."""
+    gate = threading.Event()
+    orig = svc.farm._run_backend
+
+    def gated(kind, reqs):
+        gate.wait(timeout=60)
+        return orig(kind, reqs)
+
+    svc.farm._run_backend = gated
+    return gate
+
+
+def test_overload_heavy_shed_first_bounded_slis(wl, expected):
+    """Offered load far above capacity: the heavy client sheds with
+    typed overload/rate reasons FIRST, the light client's BLOCK-lane
+    work keeps being admitted, every admitted verdict is correct, the
+    queue stays bounded, and the BLOCK-lane p99 SLO evaluates green
+    from windowed SLIs on the injected clock — zero sleeps."""
+    clock = _Clock()
+    sig_pool = [r for r in wl.requests if isinstance(r, SigRequest)]
+
+    async def go():
+        svc = _service(wl, time_source=clock.now, max_pending_items=40,
+                       workers=2, default_rate=1e9, default_burst=1e9)
+        engine = health_mod.HealthEngine(
+            slis=sli_mod.verifyd_slis(),
+            slos=health_mod.verifyd_slos(), time_source=clock.now)
+        gate = _gate_farm(svc)
+        try:
+            await svc.start()
+            svc.register_client("heavy")
+            svc.register_client("light")
+            engine.tick(clock.now())
+
+            def req(n):
+                return [sig_pool[i % len(sig_pool)] for i in range(n)]
+
+            tasks = []
+
+            async def submit(cid, n, lane):
+                try:
+                    got = await svc.verify(cid, req(n), lane=lane)
+                    return ("ok", got, [wl.inline_verify(r)
+                                        for r in req(n)])
+                except Shed as e:
+                    return (e.reason, None, None)
+
+            # heavy floods: 8 requests x 10 items against a 40-item
+            # bound (fair share 20); gate holds the farm so pending
+            # accumulates deterministically
+            for _ in range(8):
+                tasks.append(asyncio.ensure_future(
+                    submit("heavy", 10, Lane.SYNC)))
+                await asyncio.sleep(0)
+            # light client's block-critical work lands anyway
+            light_tasks = []
+            for _ in range(3):
+                light_tasks.append(asyncio.ensure_future(
+                    submit("light", 4, Lane.BLOCK)))
+                await asyncio.sleep(0)
+            clock.advance(0.01)
+            gate.set()
+            heavy_out = await asyncio.gather(*tasks)
+            light_out = await asyncio.gather(*light_tasks)
+            clock.advance(1.0)
+            engine.tick(clock.now())
+
+            heavy_shed = [o for o in heavy_out if o[0] != "ok"]
+            assert heavy_shed, "heavy client must shed"
+            assert all(o[0] in (protocol.SHED_OVERLOAD,
+                                protocol.SHED_QUEUE_FULL)
+                       for o in heavy_shed), heavy_shed
+            assert all(o[0] == "ok" for o in light_out), \
+                "light BLOCK-lane work must be admitted"
+            for outcome, got, exp in heavy_out + light_out:
+                if outcome == "ok":
+                    assert got == exp, "zero wrong verdicts"
+            assert svc.stats["pending_peak"] <= 40, "bounded queue"
+            assert svc.stats["shed"].get(protocol.SHED_OVERLOAD, 0) >= 1
+            # windowed SLIs on the injected clock: BLOCK p99 exists and
+            # its SLO is green (admitted block work resolved without
+            # queueing behind the flood)
+            report = engine.tick(clock.now())
+            assert report["slis"].get("verifyd_request_block_p99") \
+                is not None
+            assert not report["slos"]["verifyd_block_latency"]["breached"]
+        finally:
+            engine.close()
+            await svc.aclose()
+
+    _run(go())
+
+
+def test_quota_shed_typed(wl):
+    async def go():
+        svc = _service(wl, workers=2)
+        gate = _gate_farm(svc)
+        try:
+            await svc.start()
+            svc.register_client("a", max_queued=1)
+            t = asyncio.ensure_future(
+                svc.verify("a", wl.requests[:2]))
+            await asyncio.sleep(0)
+            with pytest.raises(Shed) as ei:
+                await svc.verify("a", wl.requests[:2])
+            assert ei.value.reason == protocol.SHED_QUOTA
+            gate.set()
+            await t
+        finally:
+            gate.set()
+            await svc.aclose()
+
+    _run(go())
+
+
+# --- graceful drain ------------------------------------------------------
+
+
+def test_graceful_drain_zero_stranded_futures(wl, expected):
+    """aclose() drains admitted work (verdicts still delivered), then
+    sheds new submits with shutting_down; nothing hangs."""
+
+    async def go():
+        svc = _service(wl, workers=2)
+        gate = _gate_farm(svc)
+        try:
+            await svc.start()
+            svc.register_client("a")
+            pending = [asyncio.ensure_future(
+                svc.verify("a", wl.requests[i:i + 4]))
+                for i in range(0, 12, 4)]
+            await asyncio.sleep(0)
+            closer = asyncio.ensure_future(svc.aclose())
+            await asyncio.sleep(0)
+            gate.set()
+            results = await asyncio.gather(*pending,
+                                           return_exceptions=True)
+            await closer
+            for i, r in enumerate(results):
+                assert not isinstance(r, BaseException), r
+                assert r == expected[4 * i:4 * i + 4]
+            with pytest.raises(Shed) as ei:
+                await svc.verify("a", wl.requests[:1])
+            assert ei.value.reason == protocol.SHED_SHUTTING_DOWN
+        finally:
+            gate.set()
+            await svc.aclose()
+
+    _run(go())
+
+
+# --- per-client metric cardinality --------------------------------------
+
+
+def test_client_churn_bounds_metric_cardinality(wl):
+    """The satellite regression: a churn loop of poisoned client ids
+    must leave ZERO per-client series behind (gauge, counters,
+    scheduler tenant series), and the exposition stays parseable."""
+    poisoned = [f'churn-{i}-"quote"\\back\nline' for i in range(24)]
+
+    async def go():
+        svc = _service(wl, max_clients=8)
+        try:
+            await svc.start()
+            req = wl.requests[:2]
+            for cid in poisoned:
+                svc.register_client(cid)
+                await svc.verify(cid, req)
+                with pytest.raises(Shed):
+                    await svc.verify("nobody", req)  # "-" series only
+                svc.unregister_client(cid)
+            assert len(svc.clients) == 0
+        finally:
+            await svc.aclose()
+
+    _run(go())
+    churned = set(poisoned)
+    for inst in (metrics.verifyd_client_pending, metrics.verifyd_items,
+                 metrics.verifyd_requests, metrics.verifyd_shed,
+                 metrics.runtime_tenant_queued,
+                 metrics.runtime_tenant_jobs,
+                 metrics.runtime_quantum_seconds):
+        leaked = [k for k in inst.sample()
+                  if dict(k).get("client", dict(k).get("tenant"))
+                  in churned]
+        assert not leaked, (inst.name, leaked)
+    # the poisoned ids contained every escape-relevant character; the
+    # full exposition must still round-trip the text format
+    text = metrics.REGISTRY.expose()
+    assert "verifyd_clients" in text
+
+
+# --- batchtune -----------------------------------------------------------
+
+
+def test_batchtune_race_persists_and_reloads(tmp_path, monkeypatch):
+    monkeypatch.setenv(batchtune.ENV_CACHE,
+                       str(tmp_path / "tune.json"))
+    monkeypatch.delenv(batchtune.ENV_TUNE, raising=False)
+    calls = []
+
+    def backend(kind, reqs):
+        calls.append((kind, len(reqs)))
+        return [True] * len(reqs)
+
+    t1 = batchtune.BatchTuner(backend=backend, platform="cpu")
+    raced = t1.ensure_raced(kinds=["membership"])
+    assert "membership" in raced and calls
+    assert (tmp_path / "tune.json").exists()
+    doc = json.loads((tmp_path / "tune.json").read_text())
+    assert "v1:cpu:membership" in doc
+    # a fresh tuner (new process) loads the rows without re-racing
+    calls.clear()
+    t2 = batchtune.BatchTuner(backend=backend, platform="cpu")
+    assert t2.ensure_raced(kinds=["membership"]) == {}
+    assert not calls
+    assert t2.rates("membership")
+    # a corrupt cache is ignored and re-raced
+    (tmp_path / "tune.json").write_text("{broken")
+    t3 = batchtune.BatchTuner(backend=backend, platform="cpu")
+    assert "membership" in t3.ensure_raced(kinds=["membership"])
+    assert calls
+
+
+def test_batchtune_race_disabled_by_env(tmp_path, monkeypatch):
+    monkeypatch.setenv(batchtune.ENV_CACHE, str(tmp_path / "t.json"))
+    monkeypatch.setenv(batchtune.ENV_TUNE, "off")
+    calls = []
+    t = batchtune.BatchTuner(
+        backend=lambda k, r: calls.append(k), platform="cpu")
+    assert t.ensure_raced() == {}
+    assert not calls
+    # static default target serves until observations arrive
+    assert t.target_batch("sig") == batchtune.STATIC_TARGETS["sig"]
+
+
+def test_batchtune_model_and_policy(tmp_path, monkeypatch):
+    monkeypatch.setenv(batchtune.ENV_CACHE, str(tmp_path / "t.json"))
+    monkeypatch.setenv(batchtune.ENV_TUNE, "off")
+    clock = _Clock()
+    t = batchtune.BatchTuner(platform="cpu", max_batch=256,
+                             time_source=clock.now)
+    # cold-discard: the FIRST observation per bucket (the compile) is
+    # dropped; the second creates the row
+    t.observe("sig", 32, 10.0)
+    assert not t.rates("sig")
+    t.observe("sig", 32, 0.001)
+    t.observe("sig", 1, 1.0)   # discarded (first at bucket 1)
+    t.observe("sig", 1, 0.01)  # 100/s
+    rows = t.rates("sig")
+    assert rows[32] == pytest.approx(32000.0)
+    assert rows[1] == pytest.approx(100.0)
+    assert t.target_batch("sig") == 32
+    # interpolated service model
+    assert t.service_s("sig", 32) == pytest.approx(0.001)
+    assert t.service_s("sig", 64) == pytest.approx(0.002)
+    # no arrival estimate -> dispatch now (nothing else is coming)
+    assert t.dispatch_now("sig", 4, 0.0)
+    # fast arrivals -> waiting for the target pays; lingering is chosen
+    for i in range(6):
+        t.note_arrival("sig", 1000.0 + i * 0.0001)
+    assert t.arrival_rate("sig") > 1000
+    assert not t.dispatch_now("sig", 4, 0.0)
+    # at/above target -> always dispatch
+    assert t.dispatch_now("sig", 32, 0.0)
+    # slow arrivals -> waiting costs more than the gain
+    t2 = batchtune.BatchTuner(platform="cpu", max_batch=256)
+    t2.observe("sig", 32, 10.0)
+    t2.observe("sig", 32, 0.001)
+    t2.observe("sig", 1, 1.0)
+    t2.observe("sig", 1, 0.01)
+    t2.note_arrival("sig", 0.0)
+    t2.note_arrival("sig", 100.0)  # one item per 100 s
+    assert t2.dispatch_now("sig", 4, 0.0)
+
+
+def test_farm_consumes_tuner_targets(wl, expected):
+    """A farm with a tuner dispatches per the tuned policy and feeds
+    observations back; verdicts stay bit-identical."""
+    t = batchtune.BatchTuner(platform="cpu", max_batch=64)
+
+    async def go():
+        svc = _service(wl, tuner=t, max_batch=64)
+        try:
+            await svc.start()
+            svc.register_client("a")
+            got = await svc.verify("a", wl.requests)
+            assert got == expected
+        finally:
+            await svc.aclose()
+
+    _run(go())
+    assert t.stats["observations"] + t.stats["discarded_cold"] > 0
+
+
+# --- protocol ------------------------------------------------------------
+
+
+def test_protocol_roundtrip_every_kind(wl):
+    for req in wl.requests:
+        doc = protocol.request_to_doc(req)
+        back = protocol.request_from_doc(json.loads(json.dumps(doc)))
+        assert protocol.request_to_doc(back) == doc
+        assert back.kind == req.kind
+
+
+def test_protocol_malformed_docs():
+    with pytest.raises(protocol.ProtocolError, match="kind"):
+        protocol.request_from_doc({"kind": "nope"})
+    with pytest.raises(protocol.ProtocolError, match="public_key"):
+        protocol.request_from_doc({"kind": "sig", "domain": 1,
+                                   "public_key": "zz", "msg": "",
+                                   "signature": ""})
+    with pytest.raises(protocol.ProtocolError, match="challenge"):
+        protocol.request_from_doc({"kind": "pow", "challenge": "ab",
+                                   "node_id": "00" * 32,
+                                   "difficulty": "00" * 32, "nonce": 1})
+    with pytest.raises(protocol.ProtocolError, match="nonce"):
+        protocol.request_from_doc({"kind": "pow",
+                                   "challenge": "00" * 32,
+                                   "node_id": "00" * 32,
+                                   "difficulty": "00" * 32,
+                                   "nonce": "7"})
+    # JSON ints are unbounded: an out-of-u64 nonce must be a typed 400
+    # at the boundary, not an OverflowError poisoning a co-batched
+    # dispatch deep inside the farm
+    for bad in (1 << 64, -1):
+        with pytest.raises(protocol.ProtocolError, match="64-bit"):
+            protocol.request_from_doc({"kind": "pow",
+                                       "challenge": "00" * 32,
+                                       "node_id": "00" * 32,
+                                       "difficulty": "00" * 32,
+                                       "nonce": bad})
+        with pytest.raises(protocol.ProtocolError, match="64-bit"):
+            protocol.request_from_doc({
+                "kind": "post", "challenge": "00" * 32,
+                "node_id": "00" * 32, "commitment": "00" * 32,
+                "scrypt_n": 2, "total_labels": 64,
+                "proof": {"nonce": 0, "indices": [1, 2],
+                          "pow_nonce": bad, "k2": 2}})
+    with pytest.raises(protocol.ProtocolError, match="lane"):
+        protocol.parse_lane("express")
+
+
+# --- the network surface (real sockets) ---------------------------------
+
+
+def test_server_http_e2e(wl, expected):
+    async def go():
+        server = VerifydServer(listen="127.0.0.1:0",
+                               post_params=wl.post_params,
+                               post_seed=wl.post_seed, workers=3)
+        server.service.farm.ed_verifier = wl.ed
+        server.service.farm.vrf_verifier = wl.vrf
+        try:
+            port = await server.start()
+            base = f"http://127.0.0.1:{port}"
+            c = VerifydClient(base, "alice")
+            await c.register()
+            got = await c.verify(wl.requests)
+            assert got == expected
+            sess = await c._sess()
+            # typed shed over the wire: 429 + structured body
+            tiny = VerifydClient(base, "tiny", session=sess,
+                                 unregister_on_close=False)
+            await tiny.register(rate=0.001, burst=1)
+            with pytest.raises(Shed) as ei:
+                await tiny.verify(wl.requests)
+            assert ei.value.reason == protocol.SHED_RATE
+            async with sess.post(base + "/v1/verify", json={
+                    "client": "tiny",
+                    "items": [protocol.request_to_doc(r)
+                              for r in wl.requests]}) as resp:
+                assert resp.status == 429
+                doc = await resp.json()
+                assert doc["status"] == "SHED"
+                assert doc["reason"] == protocol.SHED_RATE
+                assert doc["retry_after_s"] > 0
+            # malformed item -> 400 with a field-qualified message
+            async with sess.post(base + "/v1/verify", json={
+                    "client": "alice",
+                    "items": [{"kind": "martian"}]}) as resp:
+                assert resp.status == 400
+                assert "kind" in await resp.text()
+            # unregistered -> 403 typed
+            async with sess.post(base + "/v1/verify", json={
+                    "client": "ghost", "items": []}) as resp:
+                assert resp.status == 403
+                assert (await resp.json())["reason"] == \
+                    protocol.SHED_UNREGISTERED
+            # observability surface
+            async with sess.get(base + "/readyz") as resp:
+                assert resp.status == 200
+                rep = await resp.json()
+                assert rep["ready"] and "verifyd" in rep["components"]
+            async with sess.get(base + "/metrics") as resp:
+                text = await resp.text()
+                assert 'verifyd_items_total' in text
+            async with sess.get(base + "/v1/stats") as resp:
+                st = await resp.json()
+                assert st["clients"] == 2
+            async with sess.get(base + "/v1/tune") as resp:
+                assert "targets" in await resp.json()
+            async with sess.post(base + "/v1/client/unregister",
+                                 json={"client": "tiny"}) as resp:
+                assert (await resp.json())["unregistered"] is True
+            await c.aclose()  # unregisters alice, closes the session
+        finally:
+            await server.close()
+
+    _run(go())
+
+
+def test_server_grpc_same_docs(wl, expected):
+    pytest.importorskip("grpc")
+    from spacemesh_tpu.verifyd.client import grpc_verify
+
+    async def go():
+        server = VerifydServer(listen="127.0.0.1:0",
+                               grpc_listen="127.0.0.1:0",
+                               post_params=wl.post_params,
+                               post_seed=wl.post_seed, workers=3)
+        server.service.farm.ed_verifier = wl.ed
+        server.service.farm.vrf_verifier = wl.vrf
+        try:
+            await server.start()
+            assert server.grpc_port
+            server.service.register_client("g")
+            got = await grpc_verify(f"127.0.0.1:{server.grpc_port}",
+                                    "g", wl.requests[:8])
+            assert got == expected[:8]
+            server.service.unregister_client("g")
+        finally:
+            await server.close()
+
+    _run(go())
+
+
+def test_server_sheds_during_shutdown(wl):
+    """Admission during drain is a typed shutting_down, and close is
+    idempotent."""
+
+    async def go():
+        server = VerifydServer(listen="127.0.0.1:0",
+                               post_params=wl.post_params,
+                               post_seed=wl.post_seed, workers=2)
+        port = await server.start()
+        base = f"http://127.0.0.1:{port}"
+        c = VerifydClient(base, "a", unregister_on_close=False)
+        await c.register()
+        await server.service.aclose()  # drain the service first
+        with pytest.raises(Shed) as ei:
+            await c.verify(wl.requests[:1])
+        assert ei.value.reason == protocol.SHED_SHUTTING_DOWN
+        await c.aclose()
+        await server.close()
+        await server.close()  # idempotent
+
+    _run(go())
+
+
+# --- the pow farm kind ---------------------------------------------------
+
+
+def test_farm_pow_kind_parity(wl, expected):
+    """PowRequests through the farm match inline k2pow.verify exactly
+    (valid, walked-to-miss, wrong-prefix, impossible-difficulty)."""
+    pow_reqs = [(i, r) for i, r in enumerate(wl.requests)
+                if isinstance(r, PowRequest)]
+    assert pow_reqs
+
+    async def go():
+        svc = _service(wl)
+        try:
+            await svc.start()
+            svc.register_client("a")
+            got = await svc.verify("a", [r for _i, r in pow_reqs])
+            assert got == [expected[i] for i, _r in pow_reqs]
+        finally:
+            await svc.aclose()
+
+    _run(go())
+
+
+# --- the sim scenario ----------------------------------------------------
+
+
+def test_sim_verifyd_load_replays_byte_identical():
+    from spacemesh_tpu.sim import verifyd_load
+    from spacemesh_tpu.sim.scenarios import builtin
+
+    script = builtin("verifyd-load", light=2)
+    script["waves"] = 4
+    script["workload"] = {"sigs": 24, "vrfs": 4, "posts": 2,
+                          "memberships": 4, "pows": 6}
+    script["asserts"] = [
+        {"kind": "no_wrong_verdicts"},
+        {"kind": "shed", "client": "heavy", "reason": "rate", "min": 1},
+        {"kind": "no_shed", "client": "light-0"},
+        {"kind": "sli_present", "name": "verifyd_request_p99"},
+    ]
+    r1 = verifyd_load.run_scenario(script)
+    r2 = verifyd_load.run_scenario(script)
+    assert r1.ok, r1.asserts
+    assert r2.ok
+    assert r1.digest == r2.digest
+    assert r1.stats["hub"]["shed"] >= 1
+    json.loads(r1.to_json())  # result serializes
