@@ -28,7 +28,11 @@ def _build(name: str) -> Path | None:
     cmd = ["g++", "-O3", "-shared", "-fPIC", "-o", str(tmp), str(src)]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
-        os.replace(tmp, lib)  # atomic: concurrent builders race safely
+        # durable publish (utils/fsio): fsync + atomic rename + dir
+        # fsync — a half-flushed .so dlopens as garbage after a crash
+        from ..utils import fsio
+
+        fsio.persist(tmp, lib)
         return lib
     except (subprocess.SubprocessError, OSError):
         tmp.unlink(missing_ok=True)

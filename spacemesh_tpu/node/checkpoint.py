@@ -19,6 +19,7 @@ from ..storage import layers as layerstore
 from ..storage import misc as miscstore
 from ..storage import transactions as txstore
 from ..storage.db import Database
+from ..utils import fsio
 
 VERSION = 1
 
@@ -68,9 +69,10 @@ def write(db: Database, path: str | Path, layer: int | None = None) -> dict:
     snapshot = generate(db, layer)
     p = Path(path)
     p.parent.mkdir(parents=True, exist_ok=True)
-    tmp = p.with_suffix(".tmp")
-    tmp.write_text(json.dumps(snapshot))
-    tmp.replace(p)
+    # durable write (utils/fsio): a checkpoint exists precisely for the
+    # crash case — a rename that beats its payload to the platter would
+    # leave a truncated snapshot for the recovery it was meant to serve
+    fsio.atomic_write_text(p, json.dumps(snapshot))
     return snapshot
 
 

@@ -34,6 +34,7 @@ import shutil
 import time
 from pathlib import Path
 
+from ..utils import fsio
 from ..utils import logging as slog
 from ..utils import metrics, tracing
 
@@ -124,7 +125,10 @@ class FlightRecorder:
                  for et, etype, ev in (events or [])]))
             (tmp / HEALTH).write_text(
                 json.dumps(_jsonable(health or {}), indent=1))
-            os.replace(tmp, path)  # bundle appears atomically or not
+            # durable publish (utils/fsio): fsync + atomic rename +
+            # parent-dir fsync — the bundle an operator reaches for
+            # after a crash must not itself be a casualty of the crash
+            fsio.persist(tmp, path)
         except OSError as exc:
             _log.error("flight dump failed: %r", exc)
             shutil.rmtree(tmp, ignore_errors=True)

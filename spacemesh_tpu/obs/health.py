@@ -99,12 +99,44 @@ class Watchdog:
 def writer_watchdog(writer, deadline_s: float = DEFAULT_STALL_DEADLINE_S
                     ) -> Watchdog:
     """The LabelWriter liveness contract: while writes are queued or in
-    flight, the DURABLE cursor (contiguous bytes on disk) must advance
-    within the deadline — a wedged disk shows up here before the
-    bounded queue backpressures the whole init pipeline to a halt."""
-    return Watchdog("post.writer", progress=writer.durable,
-                    deadline_s=deadline_s,
-                    active=lambda: writer.pending() > 0)
+    flight, bytes must keep moving — the FLUSHED cursor (contiguous
+    bytes handed to the OS) advances per completed write, the DURABLE
+    cursor (contiguous bytes *fsynced*) at checkpoint boundaries; either
+    advancing counts as progress, so the interval between metadata
+    checkpoints never reads as a stall, while a wedged disk shows up
+    here before the bounded queue backpressures the whole init pipeline
+    to a halt. A writer parked in the ENOSPC retry loop is DEGRADED,
+    not stalled — that is ``store_probe``'s verdict, not this one's —
+    so the watchdog stays quiet while the pool waits out a full disk.
+    (Older writers without ``flushed()`` fall back to the durable
+    cursor alone.)"""
+    flushed = getattr(writer, "flushed", writer.durable)
+
+    def progress():
+        return (flushed(), writer.durable())
+
+    def active():
+        if getattr(writer, "degraded", lambda: None)():
+            return False  # ENOSPC park: degraded is store_probe's call
+        return writer.pending() > 0
+
+    return Watchdog("post.writer", progress=progress,
+                    deadline_s=deadline_s, active=active)
+
+
+def store_probe(writer) -> Probe:
+    """The ``post.store`` readiness probe: healthy while the label
+    writer is not parked in ENOSPC degradation. Flipping /readyz (and
+    never the process) is the whole point — a full disk pauses init,
+    the operator frees space, init resumes (docs/CRASH_SAFETY.md)."""
+
+    def probe(now: float) -> tuple[bool, str]:
+        reason = writer.degraded()
+        if reason:
+            return False, f"degraded: {reason}"
+        return True, "ok"
+
+    return probe
 
 
 # --- the component health registry --------------------------------------

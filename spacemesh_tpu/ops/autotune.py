@@ -146,10 +146,13 @@ def _store(key: str, entry: dict) -> None:
     doc[key] = entry
     try:
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-        tmp = f"{path}.tmp.{os.getpid()}"
-        with open(tmp, "w", encoding="utf-8") as f:
-            json.dump(doc, f, indent=1, sort_keys=True)
-        os.replace(tmp, path)  # atomic: concurrent racers lose, not corrupt
+        # durable write (tmp + fsync + rename + dir-fsync): a power cut
+        # mid-save must never leave a half-written winners file that the
+        # corrupt-cache-ignored path above silently re-races away
+        from ..utils import fsio
+
+        fsio.atomic_write_text(
+            path, json.dumps(doc, indent=1, sort_keys=True))
     except OSError as e:
         # persistence is an optimization (read-only HOME, sandboxed CI)
         _log(f"romix autotune: cannot persist winner ({e})")

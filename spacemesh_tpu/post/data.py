@@ -4,24 +4,75 @@ Mirrors the reference initializer's data directory contract (post-rs writes
 ``postdata_N.bin`` label files plus a metadata file; resume is driven by the
 number of labels already on disk — reference activation/post.go:267-270
 "initialization will resume from NumLabelsWritten"). Here metadata is JSON,
-written atomically (tmp + rename) after every flushed batch so a killed
-init resumes exactly where the bytes stopped.
+written durably (tmp + fsync + rename + dir-fsync, utils/fsio.py) on an
+interval so a killed init resumes exactly where the *fsynced* bytes stopped.
+
+Durability contract (docs/CRASH_SAFETY.md):
+
+* the LabelWriter tracks two cursors — ``flushed()`` (contiguous bytes
+  handed to the OS) and ``durable()`` (contiguous bytes **fsynced**);
+  only the durable cursor is ever persisted as ``labels_written``;
+* every metadata checkpoint carries a CRC32 of the label interval it
+  covers (``PostMetadata.intervals``), so reopen can verify the tail
+  and truncate torn bytes back to the last checkpoint that checks out
+  (:func:`recover_store`);
+* all file I/O goes through an injectable ``fs`` (utils/fsio.RealFS by
+  default) so the deterministic disk-fault shim (post/faultfs.py) can
+  crash the pipeline at exact operation counts;
+* ENOSPC in the writer pool is graceful degradation, not death: the
+  pool parks in a retry loop, the ``post.store`` health probe flips
+  (/readyz degraded), and the init resumes when space returns.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import errno
 import json
 import os
 import queue
 import threading
 import time
+import zlib
 from pathlib import Path
 
 from ..ops.scrypt import LABEL_BYTES
-from ..utils import metrics, sanitize, tracing
+from ..utils import fsio, metrics, sanitize, tracing
 
 METADATA_FILE = "postdata_metadata.json"
+
+# bounded retry for transient read errors (the prover's disk passes):
+# mirrors p2p/fetch.py's capped exponential backoff idiom
+READ_RETRIES = 3
+READ_BACKOFF_BASE_S = 0.05
+READ_BACKOFF_CAP_S = 1.0
+
+# ledger backfill segment for pre-checksum stores (recover_store): the
+# tail interval is what every reopen re-reads to verify, so it must
+# stay bounded — matches the initializer's default checkpoint interval
+BACKFILL_INTERVAL_LABELS = 1 << 20
+
+
+class PostMetaCorrupt(ValueError):
+    """postdata_metadata.json exists but cannot be decoded (truncated
+    write, torn sector, wrong schema). Carries the offending path so
+    the operator knows WHICH identity's resume state is gone."""
+
+    def __init__(self, path, detail: str):
+        super().__init__(f"corrupt POST metadata at {path}: {detail}")
+        self.path = str(path)
+
+
+class LabelWriteError(RuntimeError):
+    """The background label writer failed; ``errno`` is set for OS-level
+    failures so callers can branch on ENOSPC/EIO without string
+    matching. Message kept compatible with the historical
+    "background label writer failed" surface."""
+
+    def __init__(self, msg: str = "background label writer failed",
+                 errno_: int | None = None):
+        super().__init__(msg)
+        self.errno = errno_
 
 
 @dataclasses.dataclass
@@ -34,9 +85,13 @@ class PostMetadata:
     num_units: int
     labels_per_unit: int
     max_file_size: int         # bytes per postdata file
-    labels_written: int = 0    # resume cursor
+    labels_written: int = 0    # resume cursor: contiguous FSYNCED labels
     vrf_nonce: int | None = None       # index of the numerically smallest label
     vrf_nonce_value: str | None = None  # hex of that label (16 bytes)
+    # checkpoint ledger: [[end_label, crc32-of-[prev_end, end)], ...] —
+    # reopen verifies the tail interval and steps back through this list
+    # until one checks out (recover_store). Empty on pre-checksum stores.
+    intervals: list = dataclasses.field(default_factory=list)
 
     @property
     def total_labels(self) -> int:
@@ -46,26 +101,42 @@ class PostMetadata:
     def labels_per_file(self) -> int:
         return self.max_file_size // LABEL_BYTES
 
-    def save(self, data_dir: str | Path) -> None:
+    def save(self, data_dir: str | Path, fs=None) -> None:
         path = Path(data_dir) / METADATA_FILE
-        tmp = path.with_suffix(".tmp")
-        tmp.write_text(json.dumps(dataclasses.asdict(self), indent=1))
-        os.replace(tmp, path)
+        fsio.atomic_write_text(
+            path, json.dumps(dataclasses.asdict(self), indent=1), fs=fs)
 
     @classmethod
-    def load(cls, data_dir: str | Path) -> "PostMetadata":
-        return cls(**json.loads((Path(data_dir) / METADATA_FILE).read_text()))
+    def load(cls, data_dir: str | Path, fs=None) -> "PostMetadata":
+        path = Path(data_dir) / METADATA_FILE
+        # a crash between tmp write and rename leaves a stray staging
+        # file whose payload was never published; the durable truth is
+        # ``path`` itself — drop the stragglers
+        fsio.cleanup_stale_tmps(path, fs=fs)
+        text = path.read_text()  # FileNotFoundError propagates: no store
+        try:
+            doc = json.loads(text)
+        except ValueError as e:
+            raise PostMetaCorrupt(path, f"unparseable JSON ({e})") from e
+        if not isinstance(doc, dict):
+            raise PostMetaCorrupt(path, "document is not an object")
+        try:
+            return cls(**doc)
+        except TypeError as e:
+            raise PostMetaCorrupt(path, f"wrong schema ({e})") from e
 
 
 class LabelStore:
     """Reads/writes the ``postdata_N.bin`` files for one data directory."""
 
-    def __init__(self, data_dir: str | Path, meta: PostMetadata):
+    def __init__(self, data_dir: str | Path, meta: PostMetadata, fs=None):
         self.dir = Path(data_dir)
         self.meta = meta
+        self.fs = fs if fs is not None else fsio.REAL
         self.dir.mkdir(parents=True, exist_ok=True)
         self._fd_lock = sanitize.lock("post.data.LabelStore.fds")
         self._read_fds: dict[int, int] = {}
+        self._dirty: set[int] = set()  # file indices written, not fsynced
 
     def _file(self, i: int) -> Path:
         return self.dir / f"postdata_{i}.bin"
@@ -77,9 +148,18 @@ class LabelStore:
         with self._fd_lock:
             fd = self._read_fds.get(i)
             if fd is None:
-                fd = os.open(self._file(i), os.O_RDONLY)
+                fd = self.fs.open(self._file(i), os.O_RDONLY)
                 self._read_fds[i] = fd
             return fd
+
+    def _drop_read_fd(self, i: int) -> None:
+        with self._fd_lock:
+            fd = self._read_fds.pop(i, None)
+        if fd is not None:
+            try:
+                self.fs.close(fd)
+            except OSError:
+                pass
 
     def close(self) -> None:
         """Drop cached read fds (safe to call repeatedly; reads reopen)."""
@@ -87,9 +167,15 @@ class LabelStore:
             fds, self._read_fds = self._read_fds, {}
         for fd in fds.values():
             try:
-                os.close(fd)
+                self.fs.close(fd)
             except OSError:
                 pass
+
+    def invalidate(self) -> None:
+        """Recovery hook: a cached read fd pins the pre-truncation inode
+        — after recovery rewrites or truncates label files, cached fds
+        must not serve stale bytes. Alias of close(); reads reopen."""
+        self.close()
 
     def write_labels(self, start_index: int, labels: bytes) -> None:
         """Write ``labels`` (concatenated 16B records) at ``start_index``.
@@ -97,6 +183,8 @@ class LabelStore:
         Thread-safe: O_CREAT without O_TRUNC plus positioned pwrite, so
         concurrent writers (the background pool, per-shard stripes) landing
         in the same file never truncate or clobber each other's ranges.
+        Short writes (POSIX-legal, and one of faultfs's injected faults)
+        are retried until the range is fully handed to the OS.
         """
         lpf = self.meta.labels_per_file
         idx = start_index
@@ -104,23 +192,85 @@ class LabelStore:
         while off < len(labels):
             fi, within = divmod(idx, lpf)
             take = min(len(labels) - off, (lpf - within) * LABEL_BYTES)
-            fd = os.open(self._file(fi), os.O_CREAT | os.O_WRONLY, 0o644)
+            fd = self.fs.open(self._file(fi),
+                              os.O_CREAT | os.O_WRONLY, 0o644)
             try:
-                os.pwrite(fd, labels[off:off + take], within * LABEL_BYTES)
+                view = memoryview(labels)[off:off + take]
+                pos = within * LABEL_BYTES
+                while len(view):
+                    n = self.fs.pwrite(fd, view, pos)
+                    if n <= 0:
+                        raise IOError(
+                            f"zero-length write at label {idx} "
+                            f"(file {fi})")
+                    view = view[n:]
+                    pos += n
             finally:
-                os.close(fd)
+                self.fs.close(fd)
+            with self._fd_lock:
+                self._dirty.add(fi)
             off += take
             idx += take // LABEL_BYTES
 
-    def start_writer(self, threads: int = 2,
-                     queue_depth: int = 8) -> "LabelWriter":
-        """A background writer pool bound to this store."""
-        return LabelWriter(self, threads=threads, queue_depth=queue_depth)
+    def sync(self) -> None:
+        """fsync every label file written since the last sync — the
+        durability boundary the writer pool's durable cursor (and so
+        the persisted resume cursor) advances over. On failure the
+        un-synced files stay marked dirty."""
+        with self._fd_lock:
+            dirty, self._dirty = self._dirty, set()
+        done = set()
+        try:
+            for fi in sorted(dirty):
+                path = self._file(fi)
+                try:
+                    fd = self.fs.open(path, os.O_RDONLY)
+                except FileNotFoundError:
+                    done.add(fi)  # recovery removed it; nothing to sync
+                    continue
+                try:
+                    self.fs.fsync(fd)
+                finally:
+                    self.fs.close(fd)
+                metrics.post_store_fsyncs.inc()
+                done.add(fi)
+        finally:
+            failed = dirty - done
+            if failed:
+                with self._fd_lock:
+                    self._dirty |= failed
+
+    def start_writer(self, threads: int = 2, queue_depth: int = 8,
+                     **writer_opts) -> "LabelWriter":
+        """A background writer pool bound to this store (``writer_opts``
+        pass through: enospc_wait, enospc_retry_s)."""
+        return LabelWriter(self, threads=threads, queue_depth=queue_depth,
+                           **writer_opts)
 
     def start_reader(self, ranges, threads: int = 2,
                      depth: int = 4) -> "LabelReader":
         """A background prefetching reader pool bound to this store."""
         return LabelReader(self, ranges, threads=threads, depth=depth)
+
+    def _pread_retry(self, fi: int, nbytes: int, offset: int) -> bytes:
+        """One positioned read with bounded EIO retry (the p2p/fetch.py
+        capped-backoff idiom): a transient medium error mid-prove costs
+        a short pause and a reopen, not the whole multi-window pass.
+        Anything past the retry budget (or any other errno) propagates."""
+        attempt = 0
+        while True:
+            try:
+                return self.fs.pread(self._read_fd(fi), nbytes, offset)
+            except OSError as e:
+                if e.errno != errno.EIO or attempt >= READ_RETRIES:
+                    raise
+                metrics.post_store_read_retries.inc()
+                # the cached fd may be the problem (stale mapping,
+                # revoked descriptor): reopen before retrying
+                self._drop_read_fd(fi)
+                time.sleep(min(READ_BACKOFF_CAP_S,
+                               READ_BACKOFF_BASE_S * (2 ** attempt)))
+                attempt += 1
 
     def read_labels(self, start_index: int, count: int) -> bytes:
         lpf = self.meta.labels_per_file
@@ -130,8 +280,8 @@ class LabelStore:
         while remaining > 0:
             fi, within = divmod(idx, lpf)
             take = min(remaining, lpf - within)
-            chunk = os.pread(self._read_fd(fi), take * LABEL_BYTES,
-                             within * LABEL_BYTES)
+            chunk = self._pread_retry(fi, take * LABEL_BYTES,
+                                      within * LABEL_BYTES)
             if len(chunk) != take * LABEL_BYTES:
                 raise IOError(
                     f"short read at label {idx}: file {fi} truncated")
@@ -152,28 +302,50 @@ class LabelWriter:
     ``submit`` blocks the dispatch loop (a visible stall, counted by the
     caller) instead of buffering unboundedly.
 
-    Durability ordering: ``durable()`` is the label index up to which ALL
-    bytes are contiguously on disk (writes may complete out of order across
-    pool threads and mesh shard stripes). The initializer never persists a
-    metadata cursor beyond this point — that is the crash-consistency
-    contract the resume path relies on.
+    Durability ordering: ``flushed()`` is the label index up to which ALL
+    bytes are contiguously handed to the OS (writes may complete out of
+    order across pool threads and mesh shard stripes); ``durable()`` is
+    the index up to which they are contiguously **fsynced** — it advances
+    only at checkpoint/drain boundaries, after the dirty label files are
+    synced. The initializer never persists a metadata cursor beyond
+    ``durable()`` — that is the crash-consistency contract the resume
+    path (and :func:`recover_store`) relies on.
+
+    ENOSPC is graceful degradation, not death (``enospc_wait=True``):
+    the failing worker parks in a bounded-interval retry loop,
+    ``degraded()`` reports why (the ``post.store`` health probe serves
+    it on /readyz), backpressure pauses the dispatch loop, and the
+    pipeline resumes by itself when space returns. Any other OS error —
+    or ENOSPC with the wait disabled — fails the pool with a typed
+    :class:`LabelWriteError` and unblocks queued submitters.
     """
 
     _STOP = object()
 
     def __init__(self, store: LabelStore, threads: int = 2,
-                 queue_depth: int = 8):
+                 queue_depth: int = 8, enospc_wait: bool = True,
+                 enospc_retry_s: float = 0.5):
         self.store = store
+        self.enospc_wait = enospc_wait
+        self.enospc_retry_s = enospc_retry_s
         self._q: queue.Queue = queue.Queue(maxsize=max(queue_depth, 1))
         self._lock = sanitize.lock("post.data.LabelWriter")
         self._idle = sanitize.condition("post.data.LabelWriter.idle",
                                         self._lock)
-        # the durable cursor and its completion map are DECLARED SHARED
+        # the cursors and their completion map are DECLARED SHARED
         # (SPACEMESH_SANITIZE=race): the dispatch thread, the pool
         # threads and the watchdog all meet here, always under _lock
         self._shared = sanitize.SharedField("post.data.LabelWriter.cursor")
-        self._done: dict[int, int] = {}   # completed start -> end
+        self._done: dict[int, tuple[int, bytes]] = {}  # start -> (end, bytes)
+        self._flushed = store.meta.labels_written
         self._durable = store.meta.labels_written
+        # running CRC32 over the contiguous flushed bytes of the OPEN
+        # checkpoint interval; cut (and reset) at checkpoint() — feeding
+        # happens in completion order under _lock, so at any instant the
+        # CRC covers exactly [interval start, _flushed)
+        self._crc = 0
+        self._degraded: str | None = None
+        self._ckpt_active = False  # parks the flushed/CRC advance
         self._inflight = 0
         self._error: BaseException | None = None
         self._closed = False
@@ -190,23 +362,125 @@ class LabelWriter:
     # -- dispatch side ------------------------------------------------------
 
     def submit(self, start_index: int, labels: bytes) -> None:
-        """Enqueue one write; blocks when the queue is full (backpressure)."""
+        """Enqueue one write; blocks when the queue is full (backpressure).
+
+        A blocked submitter re-checks the pool's failure flag between
+        bounded put attempts, so a writer that dies with the queue full
+        unblocks every waiting submitter with the typed error instead
+        of deadlocking them against a queue nobody will drain."""
         self._raise_if_failed()
-        if self._closed:
-            raise RuntimeError("writer is closed")
         with self._lock:
             self._shared.touch()
+            if self._closed:
+                raise RuntimeError("writer is closed")
             self._inflight += 1
         self.labels_submitted += len(labels) // LABEL_BYTES
         # pool threads are long-lived and cannot inherit the submitter's
         # contextvars; the span parent rides along with the work item
-        self._q.put((start_index, labels, tracing.current_id()))
+        item = (start_index, labels, tracing.current_id())
+        while True:
+            try:
+                self._q.put(item, timeout=0.2)
+                return
+            except queue.Full:
+                try:
+                    self._raise_if_failed()
+                except LabelWriteError:
+                    with self._lock:
+                        self._shared.touch()
+                        self._inflight -= 1
+                    raise
+
+    def flushed(self) -> int:
+        """Highest label index with every prior label contiguously handed
+        to the OS (NOT necessarily on the platter — see durable())."""
+        with self._lock:
+            self._shared.touch(write=False)
+            return self._flushed
 
     def durable(self) -> int:
-        """Highest label index with every prior label contiguously on disk."""
+        """Highest label index with every prior label contiguously
+        FSYNCED. Advances at checkpoint()/drain() boundaries only."""
         with self._lock:
             self._shared.touch(write=False)
             return self._durable
+
+    def degraded(self) -> str | None:
+        """Why the pool is parked (ENOSPC retry loop), or None while
+        healthy — the ``post.store`` health probe's source."""
+        with self._lock:
+            self._shared.touch(write=False)
+            return self._degraded
+
+    def kick(self) -> None:
+        """Wake a parked ENOSPC retry immediately (tests, and the
+        operator's 'I freed space, go' signal)."""
+        with self._idle:
+            self._shared.touch()
+            self._idle.notify_all()
+
+    def wait_for_space(self, what: str) -> None:
+        """Park the caller in the ENOSPC degraded state for one retry
+        interval: flips ``degraded()`` (the ``post.store`` probe), then
+        waits ``enospc_retry_s`` or a ``kick()``. The pool's own write
+        path parks itself here; the initializer's checkpoint/metadata
+        saves park through it too, so EVERY ENOSPC in the storage plane
+        pauses the pipeline instead of killing the session."""
+        with self._idle:
+            self._shared.touch()
+            if self._closed:
+                raise LabelWriteError("writer closed while waiting "
+                                      "for disk space",
+                                      errno_=errno.ENOSPC)
+            self._degraded = f"enospc: {what} waiting for space"
+            metrics.post_store_degraded.set(1.0)
+            metrics.post_store_enospc_waits.inc()
+            self._idle.wait(timeout=self.enospc_retry_s)
+
+    def clear_degraded(self) -> None:
+        with self._idle:
+            self._shared.touch()
+            was = self._degraded is not None
+            self._degraded = None
+        if was:
+            metrics.post_store_degraded.set(0.0)
+
+    def checkpoint(self) -> tuple[int, int]:
+        """Make the flushed prefix durable: snapshot the flushed cursor
+        and the open interval's CRC, fsync the dirty label files, then
+        advance the durable cursor (and cut the CRC interval) at the
+        snapshot. Returns ``(durable, interval_crc)`` where the CRC
+        covers [previous checkpoint, durable) — the pair the
+        initializer persists in ``PostMetadata.intervals``.
+
+        The contiguous-flushed advance is held parked while the fsync
+        runs (completed chunks buffer in the out-of-order map), so the
+        CRC cut lands exactly at the durable cursor even when pool
+        threads complete writes mid-checkpoint — and a FAILED fsync
+        (ENOSPC wait-and-retry) leaves the interval intact for the
+        retry instead of zeroing it."""
+        with self._lock:
+            self._shared.touch()
+            self._ckpt_active = True
+            f = self._flushed
+            crc = self._crc
+        try:
+            self.store.sync()
+        except BaseException:
+            with self._idle:
+                self._shared.touch()
+                self._ckpt_active = False
+                self._advance_locked()
+                self._idle.notify_all()
+            raise
+        with self._idle:
+            self._shared.touch()
+            self._durable = f
+            self._crc = 0
+            self._ckpt_active = False
+            self._advance_locked()
+            self._idle.notify_all()
+        return f, crc
 
     def pending(self) -> int:
         """Writes submitted but not yet on disk — the stall watchdog's
@@ -220,31 +494,60 @@ class LabelWriter:
         return self._q.qsize()
 
     def drain(self) -> None:
-        """Block until every submitted write has hit the filesystem."""
+        """Block until every submitted write is durably on disk: waits
+        the pool idle, fsyncs the dirty files, advances the durable
+        cursor. Does NOT cut the checkpoint CRC interval — a checkpoint
+        after drain still covers [last checkpoint, here)."""
         with self._idle:
             self._shared.touch(write=False)
             while self._inflight > 0 and self._error is None:
                 self._idle.wait(timeout=0.1)
         self._raise_if_failed()
+        with self._lock:
+            self._shared.touch(write=False)
+            f = self._flushed
+        while True:
+            try:
+                self.store.sync()
+                break
+            except OSError as e:
+                if e.errno != errno.ENOSPC or not self.enospc_wait:
+                    raise
+                self.wait_for_space("label fsync at drain")
+        self.clear_degraded()
+        with self._lock:
+            self._shared.touch()
+            self._durable = f
 
     def close(self, drain: bool = True) -> None:
-        if self._closed:
-            return
         try:
-            # the error flag is written by pool threads under the lock;
-            # an unlocked read here could miss a just-landed failure
-            # and drain() a pool that will never go idle (SC007)
+            # the error/closed flags are written by pool threads under
+            # the lock; an unlocked read here could miss a just-landed
+            # failure and drain() a pool that will never go idle (SC007)
             with self._lock:
+                self._shared.touch(write=False)
+                if self._closed:
+                    return
                 failed = self._error is not None
             if drain and not failed:
                 self.drain()
         finally:
             # a drain() error must still stop the pool: workers keep
             # consuming the queue even after a write failure, so the STOP
-            # sentinels always get through
-            self._closed = True
+            # sentinels always get through. A worker parked in the ENOSPC
+            # retry loop is kicked awake (it sees _closed and surfaces),
+            # so a full queue can always make room for the sentinels.
+            with self._lock:
+                self._shared.touch()
+                self._closed = True
+            self.kick()
             for _ in self._threads:
-                self._q.put(self._STOP)
+                while True:
+                    try:
+                        self._q.put(self._STOP, timeout=0.2)
+                        break
+                    except queue.Full:
+                        self.kick()
             for t in self._threads:
                 t.join(timeout=10)
 
@@ -252,10 +555,27 @@ class LabelWriter:
         with self._lock:
             error = self._error
         if error is not None:
-            raise RuntimeError("background label writer failed") \
-                from error
+            raise LabelWriteError(
+                errno_=getattr(error, "errno", None)) from error
 
     # -- pool side ----------------------------------------------------------
+
+    def _write_with_enospc_wait(self, start: int, labels: bytes) -> None:
+        """One write; ENOSPC parks this worker in a retry loop (the
+        degraded mode) instead of failing the pool. Every retry is a
+        real write attempt — under faultfs the attempts advance the op
+        counter, so a plan's ``hold_ops`` window releases space at a
+        deterministic attempt number, sleep-free for tests via
+        ``kick()`` + a short ``enospc_retry_s``."""
+        while True:
+            try:
+                self.store.write_labels(start, labels)
+                self.clear_degraded()
+                return
+            except OSError as e:
+                if e.errno != errno.ENOSPC or not self.enospc_wait:
+                    raise
+                self.wait_for_space(f"label write at {start}")
 
     def _worker(self) -> None:
         while True:
@@ -270,7 +590,7 @@ class LabelWriter:
                                    "labels": len(labels) // LABEL_BYTES}
                                   if tracing.is_enabled() else None,
                                   parent=parent):
-                    self.store.write_labels(start, labels)
+                    self._write_with_enospc_wait(start, labels)
             except BaseException as e:  # noqa: BLE001 — surfaced to caller
                 with self._idle:
                     self._shared.touch()
@@ -284,11 +604,23 @@ class LabelWriter:
                 self._shared.touch()
                 self.write_seconds += time.perf_counter() - t0
                 self.bytes_written += len(labels)
-                self._done[start] = start + count
-                while self._durable in self._done:
-                    self._durable = self._done.pop(self._durable)
+                self._done[start] = (start + count, labels)
+                self._advance_locked()
                 self._inflight -= 1
                 self._idle.notify_all()
+
+    # guarded by: self._lock — callers advance the cursor with the lock held
+    def _advance_locked(self) -> None:
+        """Advance the contiguous-flushed cursor, feeding each chunk to
+        the open checkpoint interval's CRC in order. Parked while a
+        checkpoint snapshot is being fsynced so the CRC cut lands
+        exactly at the durable cursor."""
+        if self._ckpt_active:
+            return
+        while self._flushed in self._done:
+            end, chunk = self._done.pop(self._flushed)
+            self._crc = zlib.crc32(chunk, self._crc)
+            self._flushed = end
 
 
 class LabelReader:
@@ -395,3 +727,184 @@ class LabelReader:
                 self.bytes_read += len(data)
                 self._results[slot] = data
                 self._cond.notify_all()
+
+
+# --- crash recovery ------------------------------------------------------
+
+
+@dataclasses.dataclass
+class RecoveryReport:
+    """What reopen had to repair (all zero on a clean shutdown)."""
+
+    verified_labels: int = 0       # tail-interval labels crc-checked
+    truncated_bytes: int = 0       # torn/un-fsynced bytes removed
+    removed_files: int = 0         # label files wholly past the cursor
+    intervals_dropped: int = 0     # checkpoints that failed their CRC
+    rolled_back_labels: int = 0    # cursor retreat across dropped intervals
+    cursor: int = 0                # the verified resume cursor
+
+    @property
+    def acted(self) -> bool:
+        return bool(self.truncated_bytes or self.removed_files
+                    or self.intervals_dropped)
+
+
+def _disk_extent(store: LabelStore, total: int) -> int:
+    """Contiguous labels actually present on disk from index 0."""
+    lpf = store.meta.labels_per_file
+    extent = 0
+    fi = 0
+    while extent < total:
+        path = store._file(fi)
+        try:
+            size = store.fs.getsize(path)
+        except OSError:
+            break
+        extent += min(size // LABEL_BYTES, lpf)
+        if size < lpf * LABEL_BYTES:
+            break
+        fi += 1
+    return min(extent, total)
+
+
+def _crc_of_range(store: LabelStore, start: int, end: int,
+                  chunk: int = 1 << 16) -> int:
+    crc = 0
+    idx = start
+    while idx < end:
+        take = min(chunk, end - idx)
+        crc = zlib.crc32(store.read_labels(idx, take), crc)
+        idx += take
+    return crc
+
+
+def recover_store(data_dir: str | Path, meta: PostMetadata, fs=None,
+                  store: LabelStore | None = None) -> RecoveryReport:
+    """Reopen-time recovery: converge the on-disk label files and the
+    metadata cursor to a verified, mutually consistent state.
+
+    1. Clamp the cursor to the contiguous on-disk extent (a durable
+       claim past the actual bytes means the metadata survived a crash
+       its label fsync did not — step back to a checkpoint that did).
+    2. Verify the tail checkpoint interval's CRC32, stepping back
+       through ``meta.intervals`` until one checks out (pre-checksum
+       stores with no ledger are trusted as-is, the historical
+       behavior).
+    3. Truncate torn/un-fsynced bytes past the verified cursor, remove
+       label files wholly past it, fsync what was touched.
+    4. Persist the repaired metadata (durable write, utils/fsio).
+
+    Every reopen runs this; a clean shutdown no-ops. Emits
+    ``post_store_recovery_*`` metrics and an ``init.recover`` span.
+    Raises nothing store-specific on a healthy directory; I/O errors
+    propagate (under a fault plan, possibly as further injected
+    faults — the crash harness reboots and reopens again).
+    """
+    own_store = store is None
+    st = store if store is not None else LabelStore(data_dir, meta, fs=fs)
+    report = RecoveryReport()
+    span = tracing.span("init.recover", {"dir": str(data_dir)}
+                        if tracing.is_enabled() else None)
+    span.__enter__()
+    try:
+        total = meta.total_labels
+        lpf = meta.labels_per_file
+        cursor = min(meta.labels_written, total)
+        intervals = [list(map(int, iv)) for iv in (meta.intervals or [])]
+        extent = _disk_extent(st, total)
+
+        if intervals:
+            # the ledger's last entry IS the durable claim; a cursor
+            # past it (or past the disk) steps back through checkpoints
+            cursor = min(cursor, intervals[-1][0])
+            while intervals and intervals[-1][0] > extent:
+                intervals.pop()
+                report.intervals_dropped += 1
+            cursor = min(cursor,
+                         intervals[-1][0] if intervals else 0)
+            # tail verification: re-read the newest surviving interval
+            # and step back until a checkpoint's CRC checks out
+            while intervals:
+                end, want = intervals[-1]
+                prev = intervals[-2][0] if len(intervals) > 1 else 0
+                st.invalidate()  # never verify through stale fds
+                try:
+                    got = _crc_of_range(st, prev, end)
+                except (OSError, IOError):
+                    got = None  # unreadable tail counts as failed
+                if got == want:
+                    report.verified_labels += end - prev
+                    cursor = end
+                    break
+                intervals.pop()
+                report.intervals_dropped += 1
+                cursor = prev
+            if not intervals:
+                cursor = 0
+        else:
+            # pre-checksum metadata: trust the cursor up to the bytes
+            # actually present (the historical contract), and backfill
+            # the ledger so the NEXT checkpoint's interval starts from
+            # a boundary recovery can verify — without this, the first
+            # post-upgrade checkpoint would claim [0, durable) with a
+            # CRC that only covers the new bytes. Backfill in bounded
+            # SEGMENTS: one whole-store interval would make every later
+            # reopen's tail verification a full-store scan.
+            cursor = min(cursor, extent)
+            if cursor > 0:
+                st.invalidate()
+                intervals = []
+                start = 0
+                while start < cursor:
+                    end = min(start + BACKFILL_INTERVAL_LABELS, cursor)
+                    intervals.append([end, _crc_of_range(st, start, end)])
+                    start = end
+
+        report.rolled_back_labels = max(meta.labels_written - cursor, 0)
+
+        # drop torn/un-fsynced bytes past the verified cursor — every
+        # postdata file on disk, holes included (a stray high-index
+        # file is exactly what an un-fsynced out-of-order stripe leaves)
+        touched = False
+        for path in sorted(Path(st.dir).glob("postdata_*.bin")):
+            try:
+                fi = int(path.stem.rsplit("_", 1)[1])
+            except ValueError:
+                continue
+            try:
+                size = st.fs.getsize(path)
+            except OSError:
+                continue
+            expect = max(0, min(cursor - fi * lpf, lpf)) * LABEL_BYTES
+            if size > expect:
+                if expect == 0 and fi * lpf >= cursor:
+                    st.fs.unlink(path)
+                    report.removed_files += 1
+                else:
+                    st.fs.truncate(path, expect)
+                report.truncated_bytes += size - expect
+                touched = True
+        if touched:
+            st.invalidate()
+            fsio.fsync_dir(st.dir, fs=st.fs)
+
+        changed = (cursor != meta.labels_written
+                   or intervals != [list(map(int, iv))
+                                    for iv in (meta.intervals or [])])
+        meta.labels_written = cursor
+        meta.intervals = intervals
+        report.cursor = cursor
+        if changed or report.acted:
+            meta.save(st.dir, fs=st.fs)
+            metrics.post_store_recovery_runs.inc()
+            metrics.post_store_recovery_truncated_bytes.inc(
+                report.truncated_bytes)
+            metrics.post_store_recovery_intervals_dropped.inc(
+                report.intervals_dropped)
+        span.set(cursor=cursor, truncated=report.truncated_bytes,
+                 dropped=report.intervals_dropped)
+        return report
+    finally:
+        span.__exit__(None, None, None)
+        if own_store:
+            st.close()

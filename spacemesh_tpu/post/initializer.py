@@ -41,6 +41,7 @@ from __future__ import annotations
 
 import dataclasses
 import enum
+import errno
 import os
 import sys
 import time
@@ -116,9 +117,20 @@ class Initializer:
                  meta_interval_labels: int = DEFAULT_META_INTERVAL_LABELS,
                  mesh="auto",
                  stall_deadline_s: float = 30.0,
-                 tenant: str = "-"):
+                 tenant: str = "-",
+                 fs=None,
+                 enospc_retry_s: float = 0.5,
+                 save_barrier: bool = False):
         self.tenant = tenant
-        self.store = LabelStore(data_dir, meta)
+        self.store = LabelStore(data_dir, meta, fs=fs)
+        self.enospc_retry_s = enospc_retry_s
+        # save_barrier drains the writer pool before every metadata
+        # checkpoint: the op stream over the fs layer becomes a pure
+        # function of the batch schedule (no writer-thread timing),
+        # which is what makes faultfs plans replay-stable — the crash
+        # sweep tests and the crash-recovery sim scenario set it; the
+        # production default keeps disk/compute overlap through saves
+        self.save_barrier = save_barrier
         self.meta = meta
         self.batch = batch_size
         self.progress = progress
@@ -133,6 +145,7 @@ class Initializer:
         self.meta_interval_labels = meta_interval_labels
         self.stall_deadline_s = stall_deadline_s
         self._fetched = meta.labels_written  # fetch frontier (watchdog)
+        self._resume_at = meta.labels_written  # submit-frontier base
         self._mesh_arg = mesh
         self.status = (Status.COMPLETE
                        if meta.labels_written >= meta.total_labels
@@ -223,13 +236,17 @@ class Initializer:
         # while the donated carry buffer keeps rotating on device
         self._snapshot = carry_host
 
-        writer = self.store.start_writer(self.writers, self.writer_queue)
+        writer = self.store.start_writer(
+            self.writers, self.writer_queue,
+            enospc_retry_s=self.enospc_retry_s)
         self._last_save_t = time.monotonic()
         self._last_save_labels = written0
         # liveness (obs/health.py): the fetch frontier and the writer's
-        # durable cursor must both keep advancing while the session runs
-        # — a wedged device or disk flips /readyz instead of hanging a
-        # silent init forever
+        # flushed/durable cursors must both keep advancing while the
+        # session runs — a wedged device or disk flips /readyz instead
+        # of hanging a silent init forever. post.store is the DEGRADED
+        # probe: ENOSPC parks the pool and flips /readyz until space
+        # returns (docs/CRASH_SAFETY.md), without killing the session.
         from ..obs import health as health_mod
 
         init_wd = health_mod.Watchdog(
@@ -238,8 +255,10 @@ class Initializer:
             active=lambda: self.status == Status.IN_PROGRESS)
         writer_wd = health_mod.writer_watchdog(
             writer, deadline_s=self.stall_deadline_s)
+        store_probe = health_mod.store_probe(writer)
         health_mod.HEALTH.register("post.init", init_wd.check)
         health_mod.HEALTH.register("post.writer", writer_wd.check)
+        health_mod.HEALTH.register("post.store", store_probe)
         session = tracing.span("init.run",
                                {"total": total, "resume_at": written0,
                                 "batch": self.batch,
@@ -297,6 +316,11 @@ class Initializer:
             writer.close(drain=False)
             health_mod.HEALTH.unregister("post.init", init_wd.check)
             health_mod.HEALTH.unregister("post.writer", writer_wd.check)
+            health_mod.HEALTH.unregister("post.store", store_probe)
+            # clears the degraded gauge only if THIS session's writer
+            # set it — an unconditional zero would clobber another
+            # session's live ENOSPC signal (the gauge is process-global)
+            writer.clear_degraded()
             metrics.post_pipeline_inflight.set(0)
             metrics.post_pipeline_queue_depth.set(0)
 
@@ -413,22 +437,45 @@ class Initializer:
 
     def _maybe_save(self, writer: LabelWriter, stats: PipelineStats) -> None:
         now = time.monotonic()
-        durable = writer.durable()
+        # the label trigger fires on the SUBMIT frontier (deterministic
+        # per batch schedule), not the flushed cursor (writer-thread
+        # timing) — so checkpoint op sequences replay bit-identically
+        # under a fault plan
+        frontier = self._resume_at + writer.labels_submitted
         if (now - self._last_save_t < self.meta_interval_s
-                and durable - self._last_save_labels
+                and frontier - self._last_save_labels
                 < self.meta_interval_labels):
             return
         self._save_meta(writer, stats)
 
     def _save_meta(self, writer: LabelWriter, stats: PipelineStats) -> None:
         """Persist resume metadata. Ordering rule: the cursor is the
-        writer's durable (contiguous-on-disk) label count — never the
-        dispatch or fetch frontier."""
+        writer's durable (contiguous-FSYNCED) label count — never the
+        dispatch or fetch frontier, and never bytes merely handed to
+        the OS. ``checkpoint()`` fsyncs the dirty label files first and
+        hands back the interval CRC the recovery path verifies on
+        reopen (docs/CRASH_SAFETY.md)."""
         meta = self.meta
         t0 = time.perf_counter()
-        durable = writer.durable()
+        if self.save_barrier:
+            writer.drain()
+        # ENOSPC on the checkpoint fsync or the metadata save degrades
+        # exactly like an ENOSPC label write: the save path parks (the
+        # post.store probe flips, /readyz shows degraded), retries on
+        # the writer's interval/kick, and the session survives
+        while True:
+            try:
+                durable, crc = writer.checkpoint()
+                break
+            except OSError as e:
+                if e.errno != errno.ENOSPC or not writer.enospc_wait:
+                    raise
+                writer.wait_for_space("label-file fsync")
         decoded = scrypt.vrf_carry_decode(self._snapshot)
         meta.labels_written = durable
+        prev_end = meta.intervals[-1][0] if meta.intervals else 0
+        if durable > prev_end:
+            meta.intervals.append([durable, crc])
         if decoded is not None:
             idx, (hi, lo) = decoded
             meta.vrf_nonce = idx
@@ -436,25 +483,45 @@ class Initializer:
                 lo.to_bytes(8, "little") + hi.to_bytes(8, "little")).hex()
         with tracing.span("init.save_meta", {"durable": durable}
                           if tracing.is_enabled() else None):
-            meta.save(self.store.dir)
+            while True:
+                try:
+                    meta.save(self.store.dir, fs=self.store.fs)
+                    break
+                except OSError as e:
+                    if e.errno != errno.ENOSPC or not writer.enospc_wait:
+                        raise
+                    writer.wait_for_space("metadata save")
+        writer.clear_degraded()
         stats.meta_saves += 1
         stats.save_s += time.perf_counter() - t0
         metrics.post_pipeline_meta_saves.inc()
         self._last_save_t = time.monotonic()
-        self._last_save_labels = durable
+        # record the SAME frontier the trigger compares against: with
+        # the durable cursor here, a writer backlog >= the interval
+        # would re-trip the label trigger on every retire (a checkpoint
+        # storm — fsync + durable metadata write per batch)
+        self._last_save_labels = self._resume_at + writer.labels_submitted
 
 
 def open_or_create_meta(data_dir: Path, *, node_id: bytes,
                         commitment: bytes, num_units: int,
                         labels_per_unit: int, scrypt_n: int = 8192,
-                        max_file_size: int = 64 * 1024 * 1024
-                        ) -> PostMetadata:
+                        max_file_size: int = 64 * 1024 * 1024,
+                        fs=None) -> PostMetadata:
     """Load (and parameter-check) or create one identity's metadata —
     the create-or-resume gate shared by :func:`initialize` and the
-    multi-tenant scheduler's packed init path (runtime/scheduler.py)."""
+    multi-tenant scheduler's packed init path (runtime/scheduler.py).
+
+    Every reopen runs crash recovery (post/data.py recover_store):
+    tail-interval CRC verification, truncation of torn/un-fsynced
+    bytes back to the last verified checkpoint, and stray staging-file
+    cleanup — so a resumed init always starts from a state the
+    durability ledger can vouch for."""
+    from .data import recover_store
+
     dir_ = Path(data_dir)
     if (dir_ / "postdata_metadata.json").exists():
-        meta = PostMetadata.load(dir_)
+        meta = PostMetadata.load(dir_, fs=fs)
         if (meta.node_id != node_id.hex()
                 or meta.commitment != commitment.hex()
                 or meta.scrypt_n != scrypt_n
@@ -464,11 +531,18 @@ def open_or_create_meta(data_dir: Path, *, node_id: bytes,
             raise ValueError(
                 "existing POST data directory was initialized with different "
                 "parameters; refusing to mix label sets")
+        recover_store(dir_, meta, fs=fs)
         return meta
-    return PostMetadata(
+    meta = PostMetadata(
         node_id=node_id.hex(), commitment=commitment.hex(),
         scrypt_n=scrypt_n, num_units=num_units,
         labels_per_unit=labels_per_unit, max_file_size=max_file_size)
+    if any(dir_.glob("postdata_*.bin")):
+        # a crash before the first metadata save: label bytes with no
+        # durable claim behind them — recovery wipes them so the fresh
+        # init cannot build on un-fsynced (possibly torn) data
+        recover_store(dir_, meta, fs=fs)
+    return meta
 
 
 def initialize(data_dir: str | Path, *, node_id: bytes, commitment: bytes,
@@ -476,10 +550,12 @@ def initialize(data_dir: str | Path, *, node_id: bytes, commitment: bytes,
                max_file_size: int = 64 * 1024 * 1024,
                batch_size: int = DEFAULT_BATCH,
                progress: Callable[[int, int], None] | None = None,
+               fs=None,
                **pipeline_opts) -> tuple[PostMetadata, InitResult]:
     """Create-or-resume an init session (the `PostSetupManager.StartSession`
     equivalent). Returns final metadata + timing. ``pipeline_opts`` pass
-    through to Initializer (inflight, writers, mesh, meta intervals)."""
+    through to Initializer (inflight, writers, mesh, meta intervals);
+    ``fs`` is the injectable I/O layer (post/faultfs.py fault plans)."""
     from ..utils import accel
 
     accel.enable_persistent_cache()
@@ -487,8 +563,8 @@ def initialize(data_dir: str | Path, *, node_id: bytes, commitment: bytes,
     meta = open_or_create_meta(
         dir_, node_id=node_id, commitment=commitment, num_units=num_units,
         labels_per_unit=labels_per_unit, scrypt_n=scrypt_n,
-        max_file_size=max_file_size)
+        max_file_size=max_file_size, fs=fs)
     init = Initializer(dir_, meta, batch_size=batch_size, progress=progress,
-                       **pipeline_opts)
+                       fs=fs, **pipeline_opts)
     res = init.run()
     return meta, res

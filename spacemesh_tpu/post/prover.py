@@ -122,11 +122,17 @@ class Prover:
                  readers: int | None = None,
                  reader_queue: int | None = None,
                  mesh="auto",
-                 stall_deadline_s: float = 30.0):
-        self.meta = PostMetadata.load(data_dir)
+                 stall_deadline_s: float = 30.0,
+                 fs=None):
+        # load() raises typed PostMetaCorrupt on a torn/truncated
+        # metadata file and clears crash-leftover staging tmps; label
+        # reads below get bounded EIO retry (LabelStore._pread_retry),
+        # so one transient medium error cannot abort a multi-window
+        # disk pass
+        self.meta = PostMetadata.load(data_dir, fs=fs)
         if self.meta.labels_written < self.meta.total_labels:
             raise ValueError("POST data is not fully initialized")
-        self.store = LabelStore(data_dir, self.meta)
+        self.store = LabelStore(data_dir, self.meta, fs=fs)
         self.params = params or ProofParams()
         self.nonce_group = nonce_group
         self._platform = jax.devices()[0].platform

@@ -50,6 +50,7 @@ import concurrent.futures
 import itertools
 import threading
 import time
+import zlib
 from collections import deque
 from pathlib import Path
 
@@ -153,7 +154,7 @@ class _InitJob:
     __slots__ = ("handle", "tenant", "store", "meta", "writer", "cw",
                  "total", "next_index", "outstanding", "written",
                  "min_carry", "cancelled", "error", "progress",
-                 "finalized")
+                 "finalized", "crc")
 
     def __init__(self, handle: JobHandle, tenant: _Tenant, store, meta,
                  writer, cw, progress=None):
@@ -167,6 +168,12 @@ class _InitJob:
         self.next_index = meta.labels_written   # next lane to pack
         self.outstanding = 0               # lanes dispatched, not retired
         self.written = meta.labels_written
+        # running CRC32 of the inline-written bytes (segments retire in
+        # ascending-start order per job: next_index is monotone and the
+        # engine retires packs FIFO) — finalize appends it to the
+        # metadata's checkpoint ledger so the next reopen's recovery
+        # does not roll a verified cursor back to a stale interval
+        self.crc = 0
         self.min_carry = None              # (u128 value, index) | None
         if meta.vrf_nonce is not None and meta.vrf_nonce_value is not None:
             v = bytes.fromhex(meta.vrf_nonce_value)
@@ -828,6 +835,7 @@ class TenantScheduler:
                             job.writer.submit(s.start, data)
                         else:
                             job.store.write_labels(s.start, data)
+                            job.crc = zlib.crc32(data, job.crc)
                         job.min_carry = workloads.fold_min_host(
                             job.min_carry, data, s.start)
                         job.written = max(job.written, s.start + s.count)
@@ -867,14 +875,27 @@ class TenantScheduler:
         error = job.error
         try:
             if job.writer is not None:
+                # drain + checkpoint fsync the dirty label files before
+                # advancing the durable cursor (post/data.py fsync
+                # discipline) — the cursor persisted below means
+                # FSYNCED, not "handed to the page cache" — and hand
+                # back the interval CRC for the ledger
                 job.writer.drain()
-                durable = job.writer.durable()
+                durable, crc = job.writer.checkpoint()
                 job.writer.close(drain=False)
             else:
-                durable = job.written
+                job.store.sync()  # same contract on the inline path
+                durable, crc = job.written, job.crc
             if error is None and not job.cancelled:
                 meta = job.meta
                 meta.labels_written = durable
+                # the checkpoint ledger must cover the cursor it backs:
+                # a cursor ahead of a stale ledger would be rolled BACK
+                # (and its durable labels truncated) by the next
+                # reopen's recovery (post/data.py recover_store)
+                prev_end = meta.intervals[-1][0] if meta.intervals else 0
+                if durable > prev_end:
+                    meta.intervals.append([durable, crc])
                 nonce, value = workloads.min_carry_to_meta(job.min_carry)
                 if nonce is not None:
                     meta.vrf_nonce = nonce

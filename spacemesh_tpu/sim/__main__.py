@@ -68,9 +68,12 @@ def main(argv=None) -> int:
             script["seed"] = args.seed
 
     # script "engine" selects the runner: the network scenario engine
-    # (default) or the verifyd service-load engine (sim/verifyd_load.py)
+    # (default), the verifyd service-load engine (sim/verifyd_load.py),
+    # or the POST crash-recovery engine (sim/crash_recovery.py)
     if script.get("engine") == "verifyd":
         from .verifyd_load import run_scenario as run_fn
+    elif script.get("engine") == "crashrec":
+        from .crash_recovery import run_scenario as run_fn
     else:
         run_fn = run_scenario
 

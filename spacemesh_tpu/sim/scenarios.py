@@ -26,6 +26,12 @@ reads wall time.
   sim/verifyd_load.py): three light clients + one heavy client over
   capacity, typed rate sheds on the heavy client only, zero wrong
   verdicts, replay-stable outcome digest.
+* ``crash-recovery`` — the POST storage plane under deterministic
+  disk faults (``"engine": "crashrec"`` dispatches to
+  sim/crash_recovery.py): power-cut and torn-write crashes swept over
+  the write-path op sites of a tiny init, each reboot recovered to a
+  bit-identical store, plus an ENOSPC hold that must degrade (not
+  kill) the pipeline and release cleanly (docs/CRASH_SAFETY.md).
 """
 
 from __future__ import annotations
@@ -220,9 +226,33 @@ def verifyd_load(seed: int = 7, light: int = 3) -> dict:
     }
 
 
+def crash_recovery(seed: int = 7) -> dict:
+    """Crash-injection sweep over a tiny init's write-path op sites
+    (every 3rd site, seed-offset; power-cut and torn-write variants
+    alternating), each restart recovered and asserted bit-identical to
+    the uninjected reference, then an ENOSPC hold window that must
+    flip the ``post.store`` probe degraded and converge after the plan
+    releases space. All fault points are exact op counts — no sleeps,
+    byte-identical digest across ``--repeat`` runs."""
+    return {
+        "name": "crash-recovery", "engine": "crashrec", "seed": seed,
+        "labels": 512, "batch": 128, "scrypt_n": 2,
+        "max_file_size": 4096, "interval_labels": 128,
+        "crash_every": 3, "variants": ["powercut", "torn"],
+        "enospc": {"op": 2, "hold": 6},
+        "asserts": [
+            {"kind": "bit_identical"},
+            {"kind": "recovered", "min": 3},
+            {"kind": "enospc_degraded"},
+            {"kind": "fault_metrics", "min": 3},
+        ],
+    }
+
+
 _BUILTINS = {
     "smoke": smoke,
     "verifyd-load": verifyd_load,
+    "crash-recovery": crash_recovery,
     "partition-heal": partition_heal,
     "storm-256": storm_256,
     "timeskew-kill": timeskew_kill,

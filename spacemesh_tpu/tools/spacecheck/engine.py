@@ -21,7 +21,7 @@ import re
 import tokenize
 
 RULE_IDS = ("SC001", "SC002", "SC003", "SC004", "SC005", "SC006",
-            "SC007", "SC008")
+            "SC007", "SC008", "SC009")
 
 # paths (relative, forward-slash) matched against these prefixes are
 # skipped entirely
@@ -310,10 +310,12 @@ def _write_cache(path: str, rules_digest: str, tree_digest: str,
            "tree_digest": tree_digest, "files": per_file}
     try:
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-        tmp = f"{path}.tmp.{os.getpid()}"
-        with open(tmp, "w", encoding="utf-8") as fh:
-            json.dump(doc, fh)
-        os.replace(tmp, path)
+        # durable write (utils/fsio is stdlib-only, so the pre-install
+        # CI constraint holds): a crash mid-save must not leave a
+        # half-written cache the loader silently discards
+        from ...utils import fsio
+
+        fsio.atomic_write_text(path, json.dumps(doc))
     except OSError:
         pass  # persistence is an optimization (read-only HOME, CI)
 

@@ -349,6 +349,33 @@ post_store_read_calls = REGISTRY.counter(
     "post_store_read_calls_total", "label-store read_labels invocations")
 post_store_read_bytes = REGISTRY.counter(
     "post_store_read_bytes_total", "label bytes read back from disk")
+post_store_read_retries = REGISTRY.counter(
+    "post_store_read_retries_total",
+    "transient-EIO label reads retried with backoff (post/data.py)")
+
+# POST store crash safety (post/data.py recover_store + LabelWriter
+# fsync discipline, post/faultfs.py injection — docs/CRASH_SAFETY.md)
+post_store_fsyncs = REGISTRY.counter(
+    "post_store_fsyncs_total",
+    "label-file fsyncs at checkpoint/drain boundaries")
+post_store_fault_injections = REGISTRY.counter(
+    "post_store_fault_injections_total",
+    "disk faults fired by a faultfs plan (label=kind)")
+post_store_recovery_runs = REGISTRY.counter(
+    "post_store_recovery_runs_total",
+    "reopens where recovery repaired files or rolled the cursor back")
+post_store_recovery_truncated_bytes = REGISTRY.counter(
+    "post_store_recovery_truncated_bytes_total",
+    "torn/un-fsynced label bytes truncated on reopen")
+post_store_recovery_intervals_dropped = REGISTRY.counter(
+    "post_store_recovery_intervals_dropped_total",
+    "checkpoint intervals that failed CRC verification on reopen")
+post_store_degraded = REGISTRY.gauge(
+    "post_store_degraded",
+    "1 while the label writer is parked waiting out ENOSPC")
+post_store_enospc_waits = REGISTRY.counter(
+    "post_store_enospc_waits_total",
+    "ENOSPC retry waits entered by the label writer pool")
 
 # POST proving streaming pipeline (post/prover.py). Stage seconds carry a
 # stage label (read/dispatch/retire) mirroring the init pipeline's.

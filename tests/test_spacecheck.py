@@ -591,6 +591,82 @@ def test_sc006_out_of_scope_package_clean(tmp_path):
     assert not fs
 
 
+# --- SC009 durability (fsync-bracketed persistence) ----------------------
+
+
+def test_sc009_flags_naked_rename_persistence(tmp_path):
+    fs = run_fixture(tmp_path, "spacemesh_tpu/ops/persist.py", """
+        import os
+        from pathlib import Path
+
+        def save_cache(tmp, path):
+            os.replace(tmp, path)
+
+        def save_cache2(tmp, path):
+            os.rename(tmp, path)
+
+        def save_cache3(doc, path: Path):
+            tmp = path.with_suffix(".tmp")
+            tmp.write_text(doc)
+            tmp.replace(path)
+
+        def move(p: Path, dest: Path):
+            p.rename(dest)
+
+        def constant_target(tmp: Path):
+            tmp.replace("cache.json")
+    """, select="SC009")
+    assert len(fs) == 5
+    assert all(f.rule == "SC009" for f in fs)
+    assert any("os.replace" in f.message for f in fs)
+    assert any("utils/fsio" in f.message for f in fs)
+
+
+def test_sc009_fixed_twin_and_string_replace_clean(tmp_path):
+    fs = run_fixture(tmp_path, "spacemesh_tpu/ops/persist_ok.py", """
+        import json
+        from ..utils import fsio
+
+        def save_cache(doc, path):
+            fsio.atomic_write_text(path, json.dumps(doc))
+
+        def publish_built(tmp, lib):
+            fsio.persist(tmp, lib)
+
+        def munge(s: str) -> str:
+            # str.replace takes two+ args: never a rename
+            return s.replace("a", "b").replace("c", "d", 1)
+
+        def label(v):
+            return str(v).replace("\\n", " ")
+    """, select="SC009")
+    assert not fs
+
+
+def test_sc009_pragma_and_out_of_package_clean(tmp_path):
+    fs = run_fixture(tmp_path, "spacemesh_tpu/tools/mover.py", """
+        def archive(key_file):
+            key_file.rename(key_file.with_suffix(".merged"))  # spacecheck: ok=SC009 archival move of an already-durable file
+    """, select="SC009")
+    assert not fs
+    # the fsio module itself implements the discipline: exempt
+    fs = run_fixture(tmp_path, "spacemesh_tpu/utils/fsio.py", """
+        import os
+
+        def replace(src, dst):
+            os.replace(src, dst)
+    """, select="SC009")
+    assert not fs
+    # outside the package: none of spacecheck's business
+    fs = run_fixture(tmp_path, "scripts/move.py", """
+        import os
+
+        def mv(a, b):
+            os.replace(a, b)
+    """, select="SC009")
+    assert not fs
+
+
 # --- engine: pragmas, fingerprints, errors ------------------------------
 
 
@@ -714,6 +790,9 @@ SEEDS = {
               "    with B:\n"
               "        with A:\n"
               "            pass\n"),
+    "SC009": ("import os\n"
+              "def persist(tmp, path):\n"
+              "    os.replace(tmp, path)\n"),
 }
 
 
