@@ -99,6 +99,9 @@ class ApiServer:
         r.add_get("/readyz", self.readyz)
         r.add_get("/debug/flight", self.debug_flight)
         r.add_post("/debug/flight", self.debug_flight)
+        # self-healing surface (obs/remediate.py, docs/SELF_HEALING.md):
+        # breaker states, recovery-action history, budgets
+        r.add_get("/debug/remediation", self.debug_remediation)
 
     # --- lifecycle ---------------------------------------------------
 
@@ -434,8 +437,33 @@ class ApiServer:
             report = {"ready": all(e["healthy"]
                                    for e in components.values()),
                       "components": components, "slos": {}, "slis": {}}
+        from ..obs import remediate as remediate_mod
+
+        breakers = remediate_mod.BREAKERS.states()
+        if breakers:
+            # breaker states ride the readiness report (a COPY — the
+            # engine's cached report must not accrete keys): an open
+            # breaker is not unreadiness (the fallback is carrying the
+            # load), but it is the first thing an operator should see
+            report = {**report, "breakers": breakers}
         return web.json_response(
             report, status=200 if report["ready"] else 503)
+
+    async def debug_remediation(self, req) -> web.Response:
+        """Breaker states, action history, and budgets — the
+        self-healing node's introspection surface."""
+        from ..obs import remediate as remediate_mod
+
+        engine = getattr(self.node, "remediation", None)
+        if engine is not None:
+            doc = engine.snapshot()
+        else:
+            doc = {"breakers": remediate_mod.BREAKERS.snapshot(),
+                   "actions": [], "budgets": {}, "quarantined": []}
+        fv = getattr(self.node, "failover_verifier", None)
+        if fv is not None:
+            doc["failover"] = fv.state_doc()
+        return web.json_response(doc)
 
     async def debug_flight(self, req) -> web.Response:
         """Spool a flight bundle NOW (manual trigger; bypasses the

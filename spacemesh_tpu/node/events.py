@@ -98,6 +98,20 @@ class ComponentHealth:
     reason: str
 
 
+@dataclasses.dataclass
+class RemediationAction:
+    """The remediation engine decided a recovery action
+    (obs/remediate.py): ``outcome`` is ok/error/no_hook/rate_limited/
+    escalated/quarantined — every decision is an event, including the
+    refusals, so an operator can replay WHY a component was (not)
+    restarted."""
+
+    component: str
+    action: str
+    outcome: str
+    detail: str = ""
+
+
 class Subscription:
     def __init__(self, bus: "EventBus", types: tuple, size: int):
         self._bus = bus

@@ -15,11 +15,17 @@ NOW". This package does:
 * ``flight.py``  — the flight recorder: on a breach or stall, dump a
                    spooled diagnostic bundle (trace export, metrics
                    snapshot, recent events, health report).
+* ``remediate.py`` — the layer that ACTS on the verdicts: circuit
+                   breakers around the chronic retry-forever sites,
+                   declarative recovery policies with budgets and
+                   quarantine escalation, and the process-global
+                   breaker/action-hook registries behind
+                   ``/debug/remediation`` (docs/SELF_HEALING.md).
 
 docs/OBSERVABILITY.md documents the SLO spec format, the HTTP surface
 and the flight-bundle layout.
 """
 
-from . import flight, health, sli  # noqa: F401
+from . import flight, health, remediate, sli  # noqa: F401
 
-__all__ = ["sli", "health", "flight"]
+__all__ = ["sli", "health", "flight", "remediate"]

@@ -82,6 +82,7 @@ class FlightRecorder:
 
     def dump(self, reason: str, *, now: float | None = None,
              health: dict | None = None, events=None,
+             remediation: dict | None = None,
              force: bool = False) -> Path | None:
         """Write one bundle; returns its path, or None when rate-limited.
 
@@ -104,12 +105,21 @@ class FlightRecorder:
             tmp.mkdir(parents=True, exist_ok=True)
             from ..utils import sanitize
 
+            if remediation is None:
+                # breaker states always ride along: a bundle taken at
+                # the unhealthy moment must answer "was the node
+                # already remediating?" even for loop-less embedders
+                from . import remediate as remediate_mod
+
+                remediation = {
+                    "breakers": remediate_mod.BREAKERS.snapshot()}
             manifest = {
                 "reason": reason,
                 "unix_ts": time.time(),
                 "pid": os.getpid(),
                 "trace_enabled": tracing.is_enabled(),
                 "health": health,
+                "remediation": remediation,
                 # sanitizer findings ride along so a bundle taken at the
                 # unhealthy moment carries the race/slow-callback reports
                 # (the counters themselves survive via metrics.prom)

@@ -300,6 +300,10 @@ class HealthEngine:
             spool_dir, registry=registry, time_source=time_source)
             if spool_dir is not None else None)
         self._slo_state = {s.name: _SloState() for s in self.slos}
+        # an attached RemediationEngine (obs/remediate.py): its snapshot
+        # rides into every flight-bundle manifest so the bundle records
+        # what the node was already doing about the breach
+        self.remediation = None
         self._component_state: dict[str, bool] = {}
         self._last_tick: float | None = None
         self._last_loop_tick: float | None = None
@@ -343,7 +347,8 @@ class HealthEngine:
         if pending is None or self.recorder is None:
             return
         reason, t, report, events = pending
-        self.recorder.dump(reason, now=t, health=report, events=events)
+        self.recorder.dump(reason, now=t, health=report, events=events,
+                           remediation=self._remediation_doc())
 
     # guarded by: self._lock — tick() is the only caller and enters with the engine lock held
     def _tick_locked(self, t: float) -> dict:
@@ -506,8 +511,19 @@ class HealthEngine:
         path = self.recorder.dump(reason, now=self.time_source(),
                                   health=self._last_report or None,
                                   events=self._recent_events(),
+                                  remediation=self._remediation_doc(),
                                   force=True)
         return str(path) if path is not None else None
+
+    def _remediation_doc(self) -> dict | None:
+        """The attached remediation engine's snapshot (None lets the
+        recorder fall back to the global breaker registry alone)."""
+        if self.remediation is None:
+            return None
+        try:
+            return self.remediation.snapshot()
+        except Exception:  # noqa: BLE001 — a bundle beats a perfect bundle
+            return None
 
     # --- production scheduling ----------------------------------------
 

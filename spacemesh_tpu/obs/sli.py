@@ -161,6 +161,27 @@ def verifyd_slis() -> list[SliSpec]:
     return specs
 
 
+def failover_slis() -> list[SliSpec]:
+    """The failover verifier's indicator set (verifyd/failover.py): the
+    latency the NODE saw regardless of serving path — the signal that
+    must stay green straight through a verifyd outage (the BLOCK-lane
+    p99 is the verifyd-outage scenario's acceptance SLO) — plus
+    per-path request rates that make a failover visible as a rate
+    crossover."""
+    specs: list[SliSpec] = []
+    specs += quantile_slis("failover_verify_seconds", "failover_verify")
+    for lane in ("block", "gossip", "sync"):
+        specs.append(SliSpec(name=f"failover_{lane}_p99",
+                             metric="failover_verify_seconds",
+                             kind="quantile", q=0.99,
+                             labels=(("lane", lane),)))
+    for path in ("remote", "local", "local_fastfail"):
+        specs.append(SliSpec(name=f"failover_{path}_per_sec",
+                             metric="failover_requests_total",
+                             kind="rate", labels=(("path", path),)))
+    return specs
+
+
 def verifyd_client_slis(clients) -> list[SliSpec]:
     """Per-client indicators for the given client ids — each spec's
     labelset filter aggregates every series carrying that ``client``

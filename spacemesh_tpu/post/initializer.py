@@ -249,16 +249,34 @@ class Initializer:
         # returns (docs/CRASH_SAFETY.md), without killing the session.
         from ..obs import health as health_mod
 
+        # an ENOSPC hold parks the writer pool and backpressure stalls
+        # the fetch frontier — that is post.store's DEGRADED verdict,
+        # not an init stall (docs/CRASH_SAFETY.md), and it must not
+        # read as one: with a restart hook registered below, a
+        # stall verdict would STOP a session that PR 13 promised
+        # resumes unaided when space returns
         init_wd = health_mod.Watchdog(
             "post.init", progress=lambda: self._fetched,
             deadline_s=self.stall_deadline_s,
-            active=lambda: self.status == Status.IN_PROGRESS)
+            active=lambda: (self.status == Status.IN_PROGRESS
+                            and not writer.degraded()))
         writer_wd = health_mod.writer_watchdog(
             writer, deadline_s=self.stall_deadline_s)
         store_probe = health_mod.store_probe(writer)
         health_mod.HEALTH.register("post.init", init_wd.check)
         health_mod.HEALTH.register("post.writer", writer_wd.check)
         health_mod.HEALTH.register("post.store", store_probe)
+        # recovery hooks beside the watchdogs (obs/remediate.py): a
+        # stalled-init verdict STOPS the session — init is resumable
+        # from the durable cursor, so a clean stop hands the restart to
+        # the owning supervisor instead of hanging a wedged pipeline
+        # forever (docs/SELF_HEALING.md)
+        from ..obs import remediate as remediate_mod
+
+        remediate_mod.ACTIONS.register("post.init", "restart_component",
+                                       self.stop)
+        remediate_mod.ACTIONS.register("post.writer", "restart_component",
+                                       self.stop)
         session = tracing.span("init.run",
                                {"total": total, "resume_at": written0,
                                 "batch": self.batch,
@@ -317,6 +335,10 @@ class Initializer:
             health_mod.HEALTH.unregister("post.init", init_wd.check)
             health_mod.HEALTH.unregister("post.writer", writer_wd.check)
             health_mod.HEALTH.unregister("post.store", store_probe)
+            remediate_mod.ACTIONS.unregister(
+                "post.init", "restart_component", self.stop)
+            remediate_mod.ACTIONS.unregister(
+                "post.writer", "restart_component", self.stop)
             # clears the degraded gauge only if THIS session's writer
             # set it — an unconditional zero would clobber another
             # session's live ENOSPC signal (the gauge is process-global)

@@ -102,6 +102,15 @@ class Pipeline:
     ``fallback``  ``(item, exc) -> ticket`` — a dispatch exception goes
                   here once per item (device-failure fallback); absent,
                   the exception propagates.
+    ``breaker``   an ``obs.remediate.CircuitBreaker`` wrapped around the
+                  device-dispatch attempt.  Without one, a permanently
+                  dead backend re-pays the failing dispatch on EVERY
+                  batch (the pre-remediation behavior: no memory
+                  between batches); with one, failures trip it open and
+                  dispatch goes straight to ``fallback`` — the item
+                  sees a typed :class:`~..obs.remediate.BreakerOpen`
+                  instead of the long-dead device error — until a
+                  half-open probe finds the device back.
     ``span``      span name prefix; None disables the engine's spans
                   (callers that still own their own, e.g. during
                   migration tests).  Dispatch spans are named
@@ -114,6 +123,7 @@ class Pipeline:
     def __init__(self, *, kind: str, tenant: str = "-", inflight: int = 3,
                  stop: Optional[Callable[[], bool]] = None,
                  fallback: Optional[Callable[[Any, Exception], Any]] = None,
+                 breaker=None,
                  span: str | None = None,
                  attrs: Optional[Callable[[Any], dict]] = None,
                  on_inflight: Optional[Callable[[int], None]] = None):
@@ -122,6 +132,7 @@ class Pipeline:
         self.inflight = max(int(inflight), 1)
         self._stop = stop
         self._fallback = fallback
+        self._breaker = breaker
         self._span = span
         self._attrs = attrs
         self._on_inflight = on_inflight
@@ -153,15 +164,35 @@ class Pipeline:
                 attrs.update(self._attrs(item))
         sp = (tracing.span(f"{self._span}.dispatch", attrs)
               if self._span is not None else tracing._NOP)
+        br = self._breaker
         with sp:
-            try:
-                ticket = dispatch(item)
-            except Exception as exc:  # noqa: BLE001 — routed to fallback
+            if br is not None and not br.allow():
+                # open breaker: the device path is known-dead, go
+                # straight to the fallback WITHOUT re-paying the
+                # failing dispatch attempt (sustained-failure memory
+                # between batches)
+                from ..obs.remediate import BreakerOpen
+
                 if self._fallback is None:
-                    raise
-                ticket = self._fallback(item, exc)
+                    raise BreakerOpen(br.component, br.retry_in())
+                ticket = self._fallback(
+                    item, BreakerOpen(br.component, br.retry_in()))
                 self.stats.fallbacks += 1
                 metrics.runtime_fallbacks.inc(kind=self.kind)
+            else:
+                try:
+                    ticket = dispatch(item)
+                except Exception as exc:  # noqa: BLE001 — routed to fallback
+                    if br is not None:
+                        br.record_failure()
+                    if self._fallback is None:
+                        raise
+                    ticket = self._fallback(item, exc)
+                    self.stats.fallbacks += 1
+                    metrics.runtime_fallbacks.inc(kind=self.kind)
+                else:
+                    if br is not None:
+                        br.record_success()
         self.stats.dispatch_s += time.perf_counter() - t0
         self.stats.batches += 1
         metrics.runtime_dispatched.inc(kind=self.kind, tenant=self.tenant)

@@ -69,11 +69,14 @@ def main(argv=None) -> int:
 
     # script "engine" selects the runner: the network scenario engine
     # (default), the verifyd service-load engine (sim/verifyd_load.py),
-    # or the POST crash-recovery engine (sim/crash_recovery.py)
+    # the POST crash-recovery engine (sim/crash_recovery.py), or the
+    # self-healing failover engine (sim/failover.py)
     if script.get("engine") == "verifyd":
         from .verifyd_load import run_scenario as run_fn
     elif script.get("engine") == "crashrec":
         from .crash_recovery import run_scenario as run_fn
+    elif script.get("engine") == "failover":
+        from .failover import run_scenario as run_fn
     else:
         run_fn = run_scenario
 

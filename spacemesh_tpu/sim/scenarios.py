@@ -32,6 +32,17 @@ reads wall time.
   the write-path op sites of a tiny init, each reboot recovered to a
   bit-identical store, plus an ENOSPC hold that must degrade (not
   kill) the pipeline and release cleanly (docs/CRASH_SAFETY.md).
+* ``verifyd-outage`` — the self-healing drill (``"engine":
+  "failover"`` dispatches to sim/failover.py): verifyd killed
+  mid-load, the node keeps verifying on the local farm with zero
+  verdict divergence and a green BLOCK-lane SLO, the breaker stops
+  re-paying the dead service, and traffic fails back to remote after
+  recovery (docs/SELF_HEALING.md).
+* ``runtime-degrade`` — the device-decay drill (same engine): a
+  seeded device-dispatch fault plan; the runtime breaker opens after
+  its failure budget (N device attempts for an M≫N-batch outage, not
+  M), the host fallback carries the load bit-identically, and device
+  recovery re-closes the breaker.
 """
 
 from __future__ import annotations
@@ -249,6 +260,61 @@ def crash_recovery(seed: int = 7) -> dict:
     }
 
 
+def verifyd_outage(seed: int = 7) -> dict:
+    """Kill verifyd mid-load; the node must serve every request from
+    the local farm (bit-identical verdicts), keep the BLOCK-lane p99
+    green, bound its attempts against the dead service to the breaker
+    budget + probes, and fail back to remote after recovery."""
+    return {
+        "name": "verifyd-outage", "engine": "failover",
+        "mode": "verifyd-outage", "seed": seed,
+        "waves": 20, "wave_interval_s": 0.5, "requests_per_wave": 2,
+        "items": [3, 6],
+        "mix": {"sig": 6, "vrf": 1, "membership": 1, "pow": 2},
+        "outage": {"kill_wave": 5, "restore_wave": 11},
+        "breaker": {"failure_budget": 2, "window_s": 60.0,
+                    "cooldown_s": 1.0, "cooldown_cap_s": 2.0},
+        "service": {"max_clients": 4, "max_pending_items": 4096,
+                    "workers": 2},
+        "workload": {"sigs": 48, "vrfs": 6, "posts": 2,
+                     "memberships": 8, "pows": 10},
+        "asserts": [
+            {"kind": "no_wrong_verdicts"},
+            {"kind": "outage_local"},
+            {"kind": "path_served", "path": "remote", "min": 10},
+            {"kind": "path_served", "path": "local", "min": 8},
+            {"kind": "remote_attempts_bounded", "max": 6},
+            {"kind": "failback"},
+            {"kind": "breaker_sequence"},
+            {"kind": "sli_present", "name": "failover_block_p99"},
+            {"kind": "slo_green", "name": "failover_block_p99",
+             "target": 0.25},
+        ],
+    }
+
+
+def runtime_degrade(seed: int = 3) -> dict:
+    """Seeded device-dispatch fault plan through the runtime engine's
+    breaker: open after the failure budget, host fallback carries the
+    fault span bit-identically, device recovery re-closes."""
+    return {
+        "name": "runtime-degrade", "engine": "failover",
+        "mode": "runtime-degrade", "seed": seed,
+        "batches": 80, "inflight": 3, "step_s": 0.5,
+        "fault": {"start": 10, "end": 30},
+        "breaker": {"failure_budget": 3, "window_s": 120.0,
+                    "cooldown_s": 2.0, "cooldown_cap_s": 6.0,
+                    "recover_slack": 14},
+        "asserts": [
+            {"kind": "bit_identical"},
+            {"kind": "device_attempts_bounded", "max": 10},
+            {"kind": "fallbacks", "min": 15},
+            {"kind": "breaker_sequence"},
+            {"kind": "breaker_recloses"},
+        ],
+    }
+
+
 _BUILTINS = {
     "smoke": smoke,
     "verifyd-load": verifyd_load,
@@ -256,6 +322,8 @@ _BUILTINS = {
     "partition-heal": partition_heal,
     "storm-256": storm_256,
     "timeskew-kill": timeskew_kill,
+    "verifyd-outage": verifyd_outage,
+    "runtime-degrade": runtime_degrade,
 }
 
 

@@ -55,6 +55,18 @@ teardown runs through fixtures):
   — the lifecycle is handed elsewhere); a server leaked on the error
   path strands its scheduler worker threads, farm tasks, and bound
   sockets.
+* **remediation lifecycles (ISSUE 15)** — ``RemediationEngine(...)``
+  and ``FailoverVerifier(...)`` locals that are ``start()``ed follow
+  the same started-must-close rule (a leaked engine keeps consuming
+  bus verdicts; a leaked failover verifier pins its breaker series);
+  and breaker/hook registrations —
+  ``<...>BREAKERS.register(...)`` / ``<...>ACTIONS.register(...)``
+  (obs/remediate.py's global registries) — pair with ``unregister``
+  exactly like HEALTH probes: in a ``finally`` in the same function,
+  or in a sibling method (the long-lived component split).  An
+  unpaired breaker pins its ``remediation_breaker_*`` series forever;
+  an unpaired hook lets a dead component keep receiving recovery
+  actions.
 
 Suppress a deliberate unpaired site with ``# spacecheck: ok=SC004 <why>``.
 """
@@ -79,6 +91,15 @@ def _is_health_recv(recv: str | None) -> bool:
         return False
     last = recv.rsplit(".", 1)[-1]
     return last in ("HEALTH", "health") or last.endswith("HEALTH")
+
+
+def _is_remediation_recv(recv: str | None) -> bool:
+    """The obs/remediate.py global registries: breaker registrations
+    (``BREAKERS``) and recovery-action hooks (``ACTIONS``)."""
+    if not recv:
+        return False
+    last = recv.rsplit(".", 1)[-1]
+    return last.endswith("BREAKERS") or last.endswith("ACTIONS")
 
 
 def _finally_linenos(fn: ast.AST) -> list[tuple[int, int, int]]:
@@ -158,6 +179,8 @@ def check(ctx: FileContext, project: ProjectInfo) -> list[Finding]:
         t_unregisters: list[ast.Call] = []
         c_registers: list[ast.Call] = []
         c_unregisters: list[ast.Call] = []
+        r_registers: list[ast.Call] = []
+        r_unregisters: list[ast.Call] = []
         enters: dict[str, ast.Call] = {}
         exits: dict[str, list[int]] = {}
         for call in calls:
@@ -169,6 +192,11 @@ def check(ctx: FileContext, project: ProjectInfo) -> list[Finding]:
                 registers.append(call)
             elif func.attr == "unregister" and _is_health_recv(recv):
                 unregisters.append(call)
+            elif func.attr == "register" and _is_remediation_recv(recv):
+                r_registers.append(call)
+            elif func.attr == "unregister" \
+                    and _is_remediation_recv(recv):
+                r_unregisters.append(call)
             elif func.attr == "register_tenant":
                 t_registers.append(call)
             elif func.attr == "unregister_tenant":
@@ -211,6 +239,31 @@ def check(ctx: FileContext, project: ProjectInfo) -> list[Finding]:
                     "function or its class: a finished component pins "
                     "its probe (and its component_healthy series) "
                     "forever"))
+        for call in r_registers:
+            if any(_in_finally(spans, u.lineno) for u in r_unregisters):
+                continue
+            if r_unregisters:
+                findings.append(ctx.finding(
+                    RULE, call,
+                    "BREAKERS/ACTIONS register here but the unregister "
+                    "in this function is not under finally: the "
+                    "exception path pins the breaker's per-component "
+                    "series (or leaves a dead component's recovery "
+                    "hook live)"))
+                continue
+            sib = siblings.get(id(fn), [])
+            paired = any(
+                isinstance(c.func, ast.Attribute)
+                and c.func.attr == "unregister"
+                and _is_remediation_recv(dotted_name(c.func.value))
+                for m in sib for c in _calls_in(m) if m is not fn)
+            if not paired:
+                findings.append(ctx.finding(
+                    RULE, call,
+                    "BREAKERS/ACTIONS register without any unregister "
+                    "in this function or its class: a finished "
+                    "component pins its remediation_breaker_* series "
+                    "(or keeps receiving recovery actions) forever"))
         for call in t_registers:
             if any(_in_finally(spans, u.lineno) for u in t_unregisters):
                 continue
@@ -270,8 +323,9 @@ def check(ctx: FileContext, project: ProjectInfo) -> list[Finding]:
         _check_verifyd_servers(fn, spans)
 
     def _check_verifyd_servers(fn, spans) -> None:
-        """A locally-constructed VerifydServer/VerifydService that is
-        start()ed must close/aclose/stop under finally, or escape."""
+        """A locally-constructed VerifydServer/VerifydService/
+        RemediationEngine/FailoverVerifier that is start()ed must
+        close/aclose/stop under finally, or escape."""
         nodes = _scoped(fn)
         owners: dict[str, ast.Assign] = {}
         for node in nodes:
@@ -281,7 +335,8 @@ def check(ctx: FileContext, project: ProjectInfo) -> list[Finding]:
                     and isinstance(node.targets[0], ast.Name):
                 cname = dotted_name(node.value.func)
                 if cname and cname.rsplit(".", 1)[-1] in (
-                        "VerifydServer", "VerifydService"):
+                        "VerifydServer", "VerifydService",
+                        "RemediationEngine", "FailoverVerifier"):
                     owners[node.targets[0].id] = node
         if not owners:
             return
@@ -317,10 +372,10 @@ def check(ctx: FileContext, project: ProjectInfo) -> list[Finding]:
                 continue
             findings.append(ctx.finding(
                 RULE, call,
-                f"verifyd server {name!r} is started without a "
-                "finally-paired close/aclose/stop and never escapes: "
-                "the error path strands its scheduler workers, farm "
-                "tasks, and bound sockets"))
+                f"started component {name!r} has no finally-paired "
+                "close/aclose/stop and never escapes: the error path "
+                "strands its workers/subscriptions and pins its "
+                "breaker/metric series"))
 
     def _check_job_handles(fn, spans) -> None:
         """Runtime scheduler submits: a JobHandle bound to a local must

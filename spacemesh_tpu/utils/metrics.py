@@ -577,6 +577,34 @@ component_stalls = REGISTRY.counter(
 flight_bundles = REGISTRY.counter(
     "flight_bundles_total", "diagnostic bundles written (label: trigger)")
 
+# remediation engine + circuit breakers (obs/remediate.py). Per-component
+# breaker series are REMOVED when the breaker unregisters
+# (remove/remove_matching — the PR-12 cardinality pattern), so pipeline
+# churn cannot grow the registry without bound.
+remediation_actions = REGISTRY.counter(
+    "remediation_actions_total",
+    "recovery actions decided by the remediation engine "
+    "(labels: component, action, outcome)")
+remediation_breaker_state = REGISTRY.gauge(
+    "remediation_breaker_state",
+    "0 closed, 1 open, 2 half-open, 3 quarantined (label: component)")
+remediation_breaker_transitions = REGISTRY.counter(
+    "remediation_breaker_transitions_total",
+    "breaker state transitions (labels: component, to)")
+
+# verifyd failover client (verifyd/failover.py): requests by serving
+# path, and the latency the node actually saw regardless of path — the
+# signal that proves a verifyd outage never dented the BLOCK lane.
+failover_requests = REGISTRY.counter(
+    "failover_requests_total",
+    "failover verifier batches by serving path "
+    "(labels: path=remote|local|local_fastfail, lane)")
+failover_verify_seconds = REGISTRY.histogram(
+    "failover_verify_seconds",
+    "failover verifier batch latency by serving path "
+    "(labels: path, lane)",
+    buckets=(0.001, 0.005, 0.02, 0.1, 0.5, 2.0, 10.0, float("inf")))
+
 # runtime sanitizers (utils/sanitize.py, SPACEMESH_SANITIZE=1): each
 # recorded violation — a slow event-loop callback, an off-thread
 # instrument creation, an off-bucket jit dispatch — counts here so a

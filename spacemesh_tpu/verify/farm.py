@@ -254,6 +254,7 @@ class VerificationFarm:
         # deadline — a wedged backend thread or a dead worker task shows
         # up on /readyz instead of as silently-hanging submitters
         from ..obs import health as health_mod
+        from ..obs import remediate as remediate_mod
 
         self._watchdog = health_mod.Watchdog(
             "verify.farm",
@@ -261,6 +262,19 @@ class VerificationFarm:
             active=lambda: self._group.total() > 0,
             deadline_s=stall_deadline_s)
         health_mod.HEALTH.register("verify.farm", self._watchdog.check)
+        # per-kind backend breakers (obs/remediate.py): a device backend
+        # that keeps raising stops being re-paid per batch — its batches
+        # fail FAST with a typed BreakerOpen until a half-open probe
+        # batch finds it recovered. Sized generously: only a sustained
+        # failure run trips (a lone flaky batch never opens it).
+        self._breakers: dict[str, remediate_mod.CircuitBreaker] = {}
+        self._breaker_cfg = {"failure_budget": 5, "window_s": 30.0,
+                             "cooldown_s": 5.0, "cooldown_cap_s": 60.0}
+        # the farm's recovery hook: a stalled-farm verdict resets lanes
+        # (fails wedged waiters typed, restarts workers) instead of
+        # waiting for an operator (docs/SELF_HEALING.md)
+        remediate_mod.ACTIONS.register("verify.farm", "reset_farm_lanes",
+                                       self.reset_lanes)
 
     def _on_depth(self, lane: Lane, depth: int) -> None:
         lname = lane.name.lower()
@@ -331,8 +345,54 @@ class VerificationFarm:
             self._pool.shutdown(wait=False, cancel_futures=True)
             self._pool = None
         from ..obs import health as health_mod
+        from ..obs import remediate as remediate_mod
 
         health_mod.HEALTH.unregister("verify.farm", self._watchdog.check)
+        remediate_mod.ACTIONS.unregister("verify.farm",
+                                         "reset_farm_lanes",
+                                         self.reset_lanes)
+        for br in self._breakers.values():
+            remediate_mod.BREAKERS.unregister(br)
+        self._breakers.clear()
+
+    def reset_lanes(self) -> None:
+        """The remediation engine's ``reset_farm_lanes`` action: fail
+        every queued request and backpressure waiter with a typed
+        FarmClosed and restart the workers — a wedged lane recovers to
+        an empty, serving farm instead of pinning its submitters until
+        process restart. Pending verdicts are LOST (their callers see
+        the typed error and re-submit); in-flight backend batches
+        resolve normally."""
+        if self._closed or self._loop is None or self._loop.is_closed():
+            return
+        reset_exc = FarmClosed("farm lanes reset by remediation")
+        for st in self._kinds.values():
+            st.arrived.set()
+            for p in st.lanes.drain_all():
+                # unlike the close path, the farm keeps serving: every
+                # drained entry's lane slot must be released or the
+                # lanes stay "full" forever
+                self._group.release(p.lane)
+                if self._group.dedup.get(p.req.key()) is p:
+                    del self._group.dedup[p.req.key()]
+                if not p.future.done():
+                    p.future.set_exception(reset_exc)
+            if st.worker is not None and not st.worker.done():
+                st.worker.cancel()
+                st.worker = None
+        self._group.fail_waiters()
+
+    def _breaker(self, kind: str):
+        br = self._breakers.get(kind)
+        if br is None:
+            from ..obs import remediate as remediate_mod
+
+            br = self._breakers[kind] = remediate_mod.BREAKERS.register(
+                remediate_mod.CircuitBreaker(
+                    f"verify.farm.{kind}",
+                    time_source=self._loop.time,
+                    **self._breaker_cfg))
+        return br
 
     # --- submission ---------------------------------------------------
 
@@ -541,15 +601,29 @@ class VerificationFarm:
         for p in batch:
             p.span.set(batch=bsp.id)
         t0 = time.perf_counter()
+        br = self._breaker(kind)
         try:
             with bsp:
+                if not br.allow():
+                    # the kind's backend is known-dead: fail the batch
+                    # fast with the typed breaker error instead of
+                    # re-paying the failing dispatch (a half-open probe
+                    # batch goes through once the cooldown elapses)
+                    from ..obs.remediate import BreakerOpen
+
+                    raise BreakerOpen(br.component, br.retry_in())
                 results = await asyncio.to_thread(
                     self._run_backend, kind, [p.req for p in batch])
         except Exception as exc:  # noqa: BLE001 — fail the batch, not the farm
+            from ..obs.remediate import BreakerOpen
+
+            if not isinstance(exc, BreakerOpen):
+                br.record_failure()
             for p in batch:
                 if not p.future.done():
                     p.future.set_exception(exc)
         else:
+            br.record_success()
             for p, ok in zip(batch, results):
                 if not p.future.done():
                     p.future.set_result(bool(ok))

@@ -16,14 +16,17 @@ protocol.py (wire codec).
 """
 
 from .batchtune import BatchTuner
-from .client import VerifydClient
+from .client import RetryPolicy, VerifydClient
+from .failover import FailoverVerifier
 from .protocol import ProtocolError, request_from_doc, request_to_doc
 from .server import VerifydServer
 from .service import Shed, VerifydClosed, VerifydService
 
 __all__ = [
     "BatchTuner",
+    "FailoverVerifier",
     "ProtocolError",
+    "RetryPolicy",
     "Shed",
     "VerifydClient",
     "VerifydClosed",

@@ -16,9 +16,53 @@ solo batch), and as the simplest possible integration example.
 from __future__ import annotations
 
 import asyncio
+import dataclasses
 
+from ..obs.remediate import backoff_delay
 from . import protocol
 from .service import Shed
+
+# shed reasons worth retrying: the condition clears on its own (tokens
+# refill, the queue drains). A config/lifecycle shed (unregistered,
+# registry_full, shutting_down) never clears by waiting — re-raise it
+# immediately, whatever the retry policy says.
+RETRYABLE_SHEDS = frozenset({protocol.SHED_RATE, protocol.SHED_OVERLOAD,
+                             protocol.SHED_QUEUE_FULL,
+                             protocol.SHED_DEADLINE})
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """Bounded shed-retry budget with capped, seeded-jitter backoff.
+
+    The wait for attempt ``k`` is :func:`~..obs.remediate.backoff_delay`
+    — the SAME rule that times the failover breaker's half-open probes,
+    so the cookbook client and the breaker cannot drift — floored at
+    the server's ``retry_after_s`` hint.  A hint beyond ``cap_s`` means
+    the condition will not clear within this client's patience: the
+    shed re-raises immediately instead of sleeping toward a foregone
+    conclusion.  ``max_attempts`` counts verify attempts, not waits
+    (``max_attempts=1`` disables retrying).
+    """
+
+    max_attempts: int = 3
+    base_s: float = 0.05
+    cap_s: float = 2.0
+    seed: int = 0
+
+    def should_retry(self, exc: Shed, attempt: int) -> bool:
+        if attempt + 1 >= max(int(self.max_attempts), 1):
+            return False
+        if exc.reason not in RETRYABLE_SHEDS:
+            return False
+        return not (exc.retry_after_s is not None
+                    and exc.retry_after_s > self.cap_s)
+
+    def delay(self, exc: Shed, attempt: int) -> float:
+        return backoff_delay(attempt, base_s=self.base_s,
+                             cap_s=self.cap_s,
+                             retry_after_s=exc.retry_after_s,
+                             seed=self.seed)
 
 
 class VerifydClient:
@@ -28,16 +72,27 @@ class VerifydClient:
     ``aclose()`` in a ``finally`` (unregisters by default, so the
     server's per-client series and tenant state go away with us —
     the lifecycle spacecheck SC004 pins on package code).
+
+    ``retry`` honors the server's typed-shed ``retry_after_s``: a
+    retryable shed waits out a capped seeded-jitter backoff (floored at
+    the hint) and re-verifies, up to the policy's attempt budget; pass
+    ``retry=None`` for the raw one-shot behavior.  ``sleep`` injects
+    the wait primitive so tests assert the exact delays with zero real
+    sleeping.
     """
 
     def __init__(self, base_url: str, client_id: str, *,
-                 session=None, unregister_on_close: bool = True):
+                 session=None, unregister_on_close: bool = True,
+                 retry: RetryPolicy | None = RetryPolicy(),
+                 sleep=asyncio.sleep):
         self.base_url = base_url.rstrip("/")
         self.client_id = str(client_id)
         self._session = session
         self._own_session = session is None
         self._unregister_on_close = unregister_on_close
         self._registered = False
+        self.retry = retry
+        self._sleep = sleep
 
     async def _sess(self):
         if self._session is None:
@@ -81,7 +136,22 @@ class VerifydClient:
     async def verify(self, reqs: list, *, lane: str = "gossip",
                      deadline_s: float | None = None) -> list[bool]:
         """Verify a batch of farm request objects; raises the server's
-        typed Shed on rejection."""
+        typed Shed on rejection (after the retry policy's budget of
+        ``retry_after_s``-honoring backoff waits, when one is set)."""
+        attempt = 0
+        while True:
+            try:
+                return await self._verify_once(reqs, lane=lane,
+                                               deadline_s=deadline_s)
+            except Shed as e:
+                if self.retry is None \
+                        or not self.retry.should_retry(e, attempt):
+                    raise
+                await self._sleep(self.retry.delay(e, attempt))
+                attempt += 1
+
+    async def _verify_once(self, reqs: list, *, lane: str,
+                           deadline_s: float | None) -> list[bool]:
         body = {"client": self.client_id, "lane": lane,
                 "items": [protocol.request_to_doc(r) for r in reqs]}
         if deadline_s is not None:
@@ -111,7 +181,13 @@ class VerifydClient:
     async def aclose(self) -> None:
         try:
             if self._registered and self._unregister_on_close:
-                await self.unregister()
+                try:
+                    await self.unregister()
+                except Exception:  # noqa: BLE001 — best-effort: a client
+                    # closing BECAUSE the server died must not raise out
+                    # of the caller's finally; the server's own client
+                    # registry bound (max_clients) is the backstop
+                    pass
         finally:
             if self._own_session and self._session is not None:
                 await self._session.close()

@@ -208,8 +208,15 @@ class VerifydService:
         the batch-sizing model (off-loop; a warm host loads it)."""
         self._loop = asyncio.get_running_loop()
         from ..obs import health as health_mod
+        from ..obs import remediate as remediate_mod
 
         health_mod.HEALTH.register("verifyd", self._watchdog.check)
+        # recovery hook beside the watchdog (obs/remediate.py): a
+        # wedged-drain verdict resets the farm's lanes — stuck client
+        # requests fail typed and re-submit instead of pinning the
+        # service until an operator restart
+        remediate_mod.ACTIONS.register("verifyd", "reset_farm_lanes",
+                                       self.farm.reset_lanes)
         await asyncio.to_thread(self.tuner.ensure_raced)
 
     async def aclose(self) -> None:
@@ -230,8 +237,11 @@ class VerifydService:
                 await self.farm.aclose()
         finally:
             from ..obs import health as health_mod
+            from ..obs import remediate as remediate_mod
 
             health_mod.HEALTH.unregister("verifyd", self._watchdog.check)
+            remediate_mod.ACTIONS.unregister(
+                "verifyd", "reset_farm_lanes", self.farm.reset_lanes)
 
     # -- clients --------------------------------------------------------
 

@@ -294,3 +294,43 @@ def test_admin_chaos_link_validates_bodies(stub_api):
     calls = _with_server(stub_api, go)
     assert calls == [{"loss": 0.25, "delay": 0.1, "jitter": 0.0,
                       "dup": 0.0, "seed": 3}]
+
+
+def test_debug_remediation_and_readyz_breakers(stub_api):
+    """/debug/remediation serves breaker states + action history +
+    budgets; /readyz carries breaker states while any are registered
+    (ISSUE 15)."""
+    from spacemesh_tpu.obs import remediate
+
+    clock = [0.0]
+    br = remediate.CircuitBreaker("http-test", failure_budget=1,
+                                  time_source=lambda: clock[0])
+    eng = remediate.RemediationEngine(
+        time_source=lambda: clock[0],
+        policy=[remediate.RecoveryRule(component="http-test",
+                                       action="restart_component",
+                                       cooldown_s=0.0)])
+    stub_api.node.remediation = eng
+    remediate.BREAKERS.register(br)
+    br.record_failure()
+    eng.handle_component("http-test", "stalled")
+
+    async def go(s, base):
+        doc = await (await s.get(f"{base}/debug/remediation")).json()
+        ready = await (await s.get(f"{base}/readyz")).json()
+        return doc, ready
+
+    try:
+        doc, ready = _with_server(stub_api, go)
+    finally:
+        remediate.BREAKERS.unregister(br)
+    assert doc["breakers"]["http-test"]["state"] == "open"
+    assert doc["breakers"]["http-test"]["failure_budget"] == 1
+    acts = [a for a in doc["actions"]
+            if a["component"] == "http-test"]
+    assert acts and acts[-1]["action"] == "restart_component"
+    assert doc["budgets"]["http-test"]["used"] == 1
+    # an open breaker is visible on readiness but is NOT unreadiness:
+    # the fallback is carrying the load
+    assert ready["breakers"]["http-test"] == "open"
+    assert ready["ready"] is True

@@ -95,6 +95,76 @@ def test_pipeline_fallback_on_dispatch_failure():
         engine.Pipeline(kind="t").run(range(3), dispatch, out.append)
 
 
+def test_pipeline_sustained_failure_repays_device_without_breaker():
+    """The pre-remediation regression, pinned: with no breaker there is
+    no memory between batches — a permanently dead backend is re-paid
+    the failing dispatch on EVERY batch."""
+    attempts = [0]
+
+    def dispatch(i):
+        attempts[0] += 1
+        raise RuntimeError("device permanently dead")
+
+    pipe = engine.Pipeline(kind="t-nobreak", inflight=2,
+                           fallback=lambda i, exc: ("host", i))
+    pipe.run(range(50), dispatch, lambda t: None)
+    assert attempts[0] == 50            # one failing attempt per batch
+    assert pipe.stats.fallbacks == 50
+
+
+def test_pipeline_breaker_stops_repaying_dead_device():
+    """ISSUE 15 satellite: after the breaker trips, dispatch goes
+    straight to fallback — exactly N device attempts for an M>>N-batch
+    run, and runtime_fallbacks_total still counts every batch."""
+    from spacemesh_tpu.obs import remediate
+
+    clock = [0.0]  # frozen: the open breaker never reaches half-open
+    br = remediate.CircuitBreaker("t-dev", failure_budget=3,
+                                  window_s=60.0, cooldown_s=30.0,
+                                  time_source=lambda: clock[0])
+    attempts = [0]
+
+    def dispatch(i):
+        attempts[0] += 1
+        raise RuntimeError("device permanently dead")
+
+    before = sum(metrics.runtime_fallbacks.sample().values())
+    out = []
+    pipe = engine.Pipeline(kind="t-break", inflight=2, breaker=br,
+                           fallback=lambda i, exc: ("host", i, exc))
+    pipe.run(range(50), dispatch, out.append)
+    assert attempts[0] == 3             # the budget, NOT one per batch
+    assert len(out) == 50               # every batch still answered
+    assert pipe.stats.fallbacks == 50
+    assert sum(metrics.runtime_fallbacks.sample().values()) == before + 50
+    assert br.state == remediate.OPEN
+    # post-trip batches carry the typed BreakerOpen, not the stale
+    # device error
+    assert isinstance(out[-1][2], remediate.BreakerOpen)
+    # device recovery: cooldown elapses, ONE probe re-closes, dispatch
+    # resumes on the device path
+    clock[0] = 100.0
+    good = engine.Pipeline(kind="t-break", inflight=2, breaker=br,
+                           fallback=lambda i, exc: ("host", i, exc))
+    dev_out = []
+    good.run(range(5), lambda i: ("dev", i), dev_out.append)
+    assert good.stats.fallbacks == 0
+    assert dev_out == [("dev", i) for i in range(5)]
+    assert br.state == remediate.CLOSED
+
+
+def test_pipeline_breaker_open_without_fallback_raises_typed():
+    from spacemesh_tpu.obs import remediate
+
+    br = remediate.CircuitBreaker("t-nofb", failure_budget=1,
+                                  cooldown_s=30.0,
+                                  time_source=lambda: 0.0)
+    br.record_failure()
+    with pytest.raises(remediate.BreakerOpen):
+        engine.Pipeline(kind="t-nofb", breaker=br).run(
+            range(3), lambda i: i, lambda t: None)
+
+
 def test_pipeline_idle_sentinel_retires_without_dispatch():
     retired = []
     pipe = engine.Pipeline(kind="t", inflight=8)

@@ -82,3 +82,50 @@ def test_storm_256_replay_is_byte_identical(tmp_path):
     b = run_scenario(builtin("storm-256"), tmp=tmp_path / "b")
     assert a.ok and b.ok
     assert a.digest == b.digest
+
+
+# --- self-healing scenarios (ISSUE 15, sim/failover.py) -----------------
+
+
+def test_verifyd_outage_heals_and_replays_identically():
+    """The tentpole acceptance drill: verifyd killed mid-load, the node
+    keeps verifying locally with zero verdict divergence and a green
+    BLOCK-lane SLO, bounds its attempts against the dead service to
+    the breaker budget + probes, and fails back after recovery — twice,
+    byte-identical digests."""
+    from spacemesh_tpu.sim.failover import run_scenario as run_failover
+
+    a = run_failover(builtin("verifyd-outage"))
+    b = run_failover(builtin("verifyd-outage"))
+    assert a.ok, [x for x in a.asserts if not x["ok"]]
+    assert b.ok
+    assert a.digest == b.digest
+    kinds = {x["kind"]: x for x in a.asserts}
+    assert kinds["no_wrong_verdicts"]["ok"]
+    assert kinds["outage_local"]["ok"], kinds["outage_local"]
+    assert kinds["remote_attempts_bounded"]["ok"]
+    assert kinds["failback"]["ok"], kinds["failback"]
+    assert kinds["breaker_sequence"]["ok"]
+    assert kinds["slo_green"]["ok"], kinds["slo_green"]
+    # the outage and both breaker edges are digest-recorded
+    assert any(e.get("fault") == "kill_verifyd" for e in a.events)
+    assert any(e.get("fault") == "restore_verifyd" for e in a.events)
+    assert any(e.get("breaker") == "open" for e in a.events)
+    assert any(e.get("breaker") == "closed" for e in a.events)
+
+
+def test_runtime_degrade_bounds_device_attempts():
+    """The runtime breaker drill: N device attempts across an M>>N
+    fault span, host fallback bit-identical, breaker re-closes."""
+    from spacemesh_tpu.sim.failover import run_scenario as run_failover
+
+    a = run_failover(builtin("runtime-degrade"))
+    b = run_failover(builtin("runtime-degrade"))
+    assert a.ok, [x for x in a.asserts if not x["ok"]]
+    assert a.digest == b.digest
+    rt = a.stats["runtime"]
+    fault_span = 30 - 10
+    assert rt["device_attempts_in_fault"] < fault_span, \
+        "breaker never stopped the per-batch re-pay"
+    assert rt["fallbacks"] >= fault_span
+    assert rt["breaker"]["state"] == "closed"

@@ -487,6 +487,95 @@ def test_sc004_verifyd_server_start_close_pairing(tmp_path):
     assert fs[0].line == 6  # anchored at the start() call
 
 
+def test_sc004_breaker_and_action_registry_pairing(tmp_path):
+    """The ISSUE 15 remediation lifecycles: BREAKERS/ACTIONS
+    registrations pair with unregister (finally or class split); an
+    unpaired breaker pins its per-component series forever."""
+    fs = run_fixture(tmp_path, "spacemesh_tpu/post/breakers.py", """
+        from ..obs.remediate import ACTIONS, BREAKERS, CircuitBreaker
+
+        def bad(br):
+            BREAKERS.register(br)
+            run_forever()
+
+        def good_finally(br):
+            BREAKERS.register(br)
+            try:
+                run_forever()
+            finally:
+                BREAKERS.unregister(br)
+
+        def good_hook_finally(pipe):
+            ACTIONS.register("post.init", "restart_component",
+                             pipe.stop)
+            try:
+                run_forever()
+            finally:
+                ACTIONS.unregister("post.init", "restart_component",
+                                   pipe.stop)
+
+        class Component:
+            def start(self):
+                ACTIONS.register("comp", "restart_component",
+                                 self.restart)
+
+            def close(self):
+                ACTIONS.unregister("comp", "restart_component",
+                                   self.restart)
+    """, select="SC004")
+    assert len(fs) == 1 and "BREAKERS/ACTIONS register" in fs[0].message
+    assert fs[0].line == 5  # the bad() register call
+
+
+def test_sc004_breaker_unregister_off_finally_flags(tmp_path):
+    fs = run_fixture(tmp_path, "spacemesh_tpu/post/leaky_breaker.py", """
+        from ..obs.remediate import BREAKERS
+
+        def run(br):
+            BREAKERS.register(br)
+            serve()   # raises -> unregister skipped
+            BREAKERS.unregister(br)
+    """, select="SC004")
+    assert len(fs) == 1 and "not under finally" in fs[0].message
+
+
+def test_sc004_remediation_engine_start_close_pairing(tmp_path):
+    """RemediationEngine/FailoverVerifier follow the started-must-close
+    rule: a leaked engine keeps consuming bus verdicts."""
+    fs = run_fixture(tmp_path, "spacemesh_tpu/tools/remed_cli.py", """
+        from ..obs.remediate import RemediationEngine
+        from ..verifyd.failover import FailoverVerifier
+
+        async def bad(bus):
+            engine = RemediationEngine(bus=bus)
+            engine.start()
+            await serve_forever()
+
+        async def good(bus):
+            engine = RemediationEngine(bus=bus)
+            try:
+                engine.start()
+                await serve_forever()
+            finally:
+                engine.close()
+
+        async def good_failover(remote, farm):
+            fv = FailoverVerifier(remote=remote, farm=farm)
+            try:
+                fv.start()
+                await drive(fv)
+            finally:
+                await fv.aclose()
+
+        async def escapes(bus, registry):
+            engine = RemediationEngine(bus=bus)
+            engine.start()
+            return engine   # caller owns the lifecycle now
+    """, select="SC004")
+    assert len(fs) == 1 and "finally-paired close" in fs[0].message
+    assert fs[0].line == 7  # anchored at the start() call
+
+
 # --- SC005 metrics hygiene ----------------------------------------------
 
 
