@@ -62,13 +62,19 @@ goes red — on any mismatch:
 On a real multi-device accelerator the same measurement runs in-process
 (the devices are physical; nothing to force). BENCH_MESH=0 disables.
 
-After the prove bench, the MULTI-TENANT headline (ISSUE 11): 16 tenants'
-small init jobs through the runtime scheduler's packed fair-share
-admission (spacemesh_tpu/runtime/) vs the same jobs run one tenant at a
-time, per-tenant sha256 label digests + VRF nonces asserted identical
-before any rate is reported (a mismatch exits non-zero):
+After the prove bench, the MULTI-TENANT headline (ISSUE 11/16): 16
+tenants' small init jobs through the runtime scheduler's packed
+fair-share admission (spacemesh_tpu/runtime/) vs the same jobs run one
+tenant at a time, per-tenant sha256 label digests + VRF nonces asserted
+identical before any rate is reported (a mismatch exits non-zero). On
+the CPU platform (unless BENCH_MESH=0) the measurement runs in a
+SUBPROCESS with forced virtual host devices — the environment where the
+scheduler's pack dispatch routes through the mesh-sharded program —
+for the same single-device-honesty reason as the mesh probe;
+"pack_devices" records how the tuned routing actually dispatched packs:
   {"metric": "post_multi_tenant_labels_per_sec", ..., "tenants": 16,
-   "sequential": N, "vs_sequential": N, "bit_identical": true}
+   "pack_devices": D, "sequential": N, "vs_sequential": N,
+   "bit_identical": true}
 
 After the farm verify bench, the VERIFYD headline (ISSUE 13): the same
 mixed workload plus k2pow witnesses through the standalone verification
@@ -85,8 +91,9 @@ BENCH_PROVE_BATCH, BENCH_TENANTS / BENCH_TENANT_LABELS / BENCH_TENANT_N
 / BENCH_TENANT_REPS / BENCH_PACK_LANES (the multi-tenant line; tenants=0
 disables), BENCH_VERIFYD_ITEMS / BENCH_VERIFYD_CLIENTS /
 BENCH_VERIFYD_PER_REQUEST / BENCH_VERIFYD_WORKERS (the verifyd line;
-items=0 disables), BENCH_MESH (0 disables the mesh line),
-BENCH_MESH_TIMEOUT (probe subprocess seconds, default 1800),
+items=0 disables), BENCH_MESH (0 disables the mesh line AND pins the
+multi-tenant bench in-process single-device), BENCH_MESH_TIMEOUT /
+BENCH_MT_TIMEOUT (probe subprocess seconds, default 1800),
 SPACEMESH_JAX_CACHE (cache dir, `off` to disable), plus the kernel
 overrides SPACEMESH_ROMIX / SPACEMESH_ROMIX_CHUNK /
 SPACEMESH_ROMIX_AUTOTUNE / SPACEMESH_MESH (docs/ROMIX_KERNEL.md).
@@ -179,6 +186,52 @@ def mesh_probe_main() -> int:
     doc = measure_mesh(n, batch, reps)
     print(json.dumps(doc), flush=True)
     return 0
+
+
+def mt_probe_main() -> int:
+    """Child-process entry (``bench.py --mt-probe``): the multi-tenant
+    packer bench on the CPU fallback with forced virtual host devices —
+    the environment where the scheduler's pack dispatch routes through
+    the mesh-sharded program (runtime/scheduler.py _dispatch_pack). A
+    subprocess for the same reason the mesh probe is one: the forced
+    device split would degrade the parent's single-device lines. The
+    bit-identity gate (per-tenant sha256 + VRF nonce vs the sequential
+    Initializer) runs INSIDE this child and exits non-zero on any
+    divergence; the parent propagates that as a red build."""
+    from spacemesh_tpu.utils import accel
+
+    accel.force_cpu_platform()
+    accel.ensure_host_devices()
+    accel.enable_persistent_cache()
+    multi_tenant_bench()
+    return 0
+
+
+def run_mt_probe() -> None:
+    """Run multi_tenant_bench in a subprocess with forced host devices,
+    forwarding its JSON line; a failed child fails the bench."""
+    timeout = int(os.environ.get("BENCH_MT_TIMEOUT", 1800))
+    log(f"multi-tenant probe: packed admission over the mesh in a "
+        f"subprocess (<= {timeout}s) ...")
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--mt-probe"],
+            env=dict(os.environ), timeout=timeout,
+            capture_output=True, text=True)
+    except subprocess.TimeoutExpired:
+        log("multi-tenant probe: timed out; skipping the line")
+        return
+    sys.stderr.write(r.stderr)
+    for line in r.stdout.strip().splitlines():
+        try:
+            json.loads(line)
+        except ValueError:
+            continue
+        print(line, flush=True)
+    if r.returncode != 0:
+        # the child's bit-identity gate (or an outright crash) — red
+        log(f"multi-tenant probe: FAILED (rc={r.returncode})")
+        sys.exit(1)
 
 
 def run_mesh_probe(n: int, batch: int, reps: int) -> dict | None:
@@ -355,9 +408,17 @@ def multi_tenant_bench() -> None:
 
     seq_rate = total / best_seq
     mt_rate = total / best_mt
+    # how the packer's dispatch actually routed at the pack bucket: the
+    # same tuned routing runtime/scheduler.py _dispatch_pack consults —
+    # 1 means the tuner honestly kept single-device on this host
+    from spacemesh_tpu.ops import autotune, scrypt
+
+    devs, _d = autotune.resolve_auto_mesh(n, scrypt.shape_bucket(pack))
+    pack_devices = len(devs) if devs is not None else 1
     log(f"multi-tenant: sequential {best_seq * 1e3:.0f}ms "
         f"({seq_rate:,.0f} labels/s), scheduled {best_mt * 1e3:.0f}ms "
-        f"({mt_rate:,.0f} labels/s, {mt_rate / seq_rate:.2f}x)")
+        f"({mt_rate:,.0f} labels/s, {mt_rate / seq_rate:.2f}x, "
+        f"pack_devices={pack_devices})")
     print(json.dumps({
         "metric": "post_multi_tenant_labels_per_sec",
         "value": round(mt_rate, 1),
@@ -366,6 +427,7 @@ def multi_tenant_bench() -> None:
         "labels_per_tenant": labels,
         "n": n,
         "pack_lanes": pack,
+        "pack_devices": pack_devices,
         "sequential": round(seq_rate, 1),
         "vs_sequential": round(mt_rate / seq_rate, 2),
         "bit_identical": True,  # per-tenant sha256 + VRF nonce checked
@@ -793,7 +855,14 @@ def main() -> None:
                     int(os.environ.get("BENCH_PROVE_BATCH", 2048)))
 
     if int(os.environ.get("BENCH_TENANTS", 16)) > 0:
-        multi_tenant_bench()
+        if (fallback or jax.default_backend() == "cpu") \
+                and os.environ.get("BENCH_MESH", "1") not in ("0", "off"):
+            # CPU platform: measure the packer over forced virtual host
+            # devices in a subprocess (the mesh-sharded pack dispatch),
+            # keeping this process honestly single-device
+            run_mt_probe()
+        else:
+            multi_tenant_bench()
 
     verify_items = int(os.environ.get("BENCH_VERIFY_ITEMS", 512))
     if verify_items > 0:
@@ -807,4 +876,6 @@ def main() -> None:
 if __name__ == "__main__":
     if "--mesh-probe" in sys.argv[1:]:
         raise SystemExit(mesh_probe_main())
+    if "--mt-probe" in sys.argv[1:]:
+        raise SystemExit(mt_probe_main())
     main()

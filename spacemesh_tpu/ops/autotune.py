@@ -62,6 +62,24 @@ SCHEMA = 2  # v2: rows/winners carry a "devices" mesh dimension
 IMPLS = ("xla", "xla-rows", "pallas")
 MAX_MESH_DEVICES = 8  # the raced device-count grid is {1, 2, 4, 8}
 
+# The two mesh SHAPES a sharded label batch can take over the topology's
+# ``data`` axis (docs/ROMIX_KERNEL.md):
+#   lane   — the word-major kernel: arrays stay (words, B), the lane axis
+#            shards, V is gathered word-major per shard;
+#   vshard — the contiguous-row kernel: V lives as per-lane (32,) rows,
+#            so sharding the lanes shards each device's V scratch with
+#            them (the row-sharded ROMix layout).
+# Rows and winners are tagged with their shape, and race() additionally
+# persists the best row PER shape — tools/warmcache.py warms both so a
+# later SPACEMESH_ROMIX flip or a re-race that flips the winner still
+# hits the persistent compile cache.
+MESH_SHAPES = ("lane", "vshard")
+
+
+def shape_of(impl: str) -> str:
+    """The mesh shape an impl uses when its lanes shard over ``data``."""
+    return "vshard" if impl == "xla-rows" else "lane"
+
 ENV_IMPL = "SPACEMESH_ROMIX"
 ENV_CHUNK = "SPACEMESH_ROMIX_CHUNK"
 ENV_AUTOTUNE = "SPACEMESH_ROMIX_AUTOTUNE"
@@ -94,10 +112,13 @@ class Decision:
     #                              silently fall back from it — ops/scrypt.py)
     devices: int = 1          # lane-shard the batch over this many devices
     #                           (parallel/mesh.py; 1 = single-device dispatch)
+    mesh_shape: str = "lane"  # which MESH_SHAPES layout the sharded
+    #                           dispatch uses (meaningful when devices > 1)
 
     def as_json(self) -> dict:
         return {"impl": self.impl, "chunk": self.chunk,
                 "source": self.source, "devices": self.devices,
+                "shape": self.mesh_shape,
                 "labels_per_sec": self.labels_per_sec}
 
 
@@ -124,6 +145,14 @@ def _key(platform: str, n: int, batch: int, dev_cap: int = 1) -> str:
     return f"v{SCHEMA}:{platform}:n{n}:b{batch}:d{dev_cap}"
 
 
+def _shape_key(platform: str, n: int, batch: int, dev_cap: int,
+               shape: str) -> str:
+    # the best row PER mesh shape under the same budget — what
+    # shape_winner() serves warmcache and the sharded entry points so
+    # both layouts' executables land in the persistent compile cache
+    return _key(platform, n, batch, dev_cap) + f":s{shape}"
+
+
 def _load_cache(path: str | None = None) -> dict:
     path = path or cache_path()
     try:
@@ -141,9 +170,13 @@ def _load_cache(path: str | None = None) -> dict:
 
 
 def _store(key: str, entry: dict) -> None:
+    _store_many({key: entry})
+
+
+def _store_many(entries: dict) -> None:
     path = cache_path()
     doc = _load_cache(path)
-    doc[key] = entry
+    doc.update(entries)
     try:
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         # durable write (tmp + fsync + rename + dir-fsync): a power cut
@@ -171,10 +204,13 @@ def _entry_decision(entry: dict, batch: int, source: str) -> Decision | None:
         return None
     if chunk is not None and chunk >= batch:
         chunk = None
+    shape = entry.get("shape") or shape_of(impl)
+    if shape not in MESH_SHAPES:
+        return None
     rate = entry.get("labels_per_sec")
     return Decision(impl, chunk, source,
                     rate if isinstance(rate, (int, float)) else None,
-                    devices=devices)
+                    devices=devices, mesh_shape=shape)
 
 
 def read_env() -> tuple[str | None, int | None, bool, bool]:
@@ -373,6 +409,9 @@ def _valid_rows(rows) -> list[dict]:
                 and r.get("devices", 1) >= 1
                 and isinstance(r.get("labels_per_sec"), (int, float))):
             r.setdefault("devices", 1)
+            # pre-shape rows (written by an older process) tag by impl
+            if r.setdefault("shape", shape_of(r["impl"])) not in MESH_SHAPES:
+                continue
             out.append(r)
     return out
 
@@ -465,6 +504,7 @@ def _race_rows(platform: str, n: int,
             csp.set(labels_per_sec=round(rate, 1),
                     compile_s=round(compile_s, 3))
             rows.append({"impl": impl, "chunk": chunk, "devices": devices,
+                         "shape": shape_of(impl),
                          "labels_per_sec": round(rate, 1)})
         except Exception as e:  # noqa: BLE001 — a candidate that cannot
             # compile on this host simply loses the race. Persisted as a
@@ -475,7 +515,7 @@ def _race_rows(platform: str, n: int,
                  f"({type(e).__name__}: {e})")
             csp.set(failed=type(e).__name__)
             rows.append({"impl": impl, "chunk": chunk, "devices": devices,
-                         "labels_per_sec": 0.0,
+                         "shape": shape_of(impl), "labels_per_sec": 0.0,
                          "failed": type(e).__name__})
         finally:
             csp.__exit__(None, None, None)
@@ -523,20 +563,58 @@ def race(platform: str, n: int, batch: int, dev_cap: int = 1,
     win = _select_winner(usable)
     chunk = win["chunk"]
     d = Decision(win["impl"], chunk, "race", win["labels_per_sec"],
-                 devices=win["devices"])
+                 devices=win["devices"], mesh_shape=win["shape"])
     if pin_devices is not None:
         return dataclasses.replace(d, source="env")
-    entry = {"impl": win["impl"], "chunk": chunk,
-             "devices": win["devices"],
-             "labels_per_sec": win["labels_per_sec"],
-             "cal_batch": CAL_BATCH, "raced": rows,
-             "tuned_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())}
-    _store(_key(platform, n, batch, dev_cap), entry)
+    stamp = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    entries = {_key(platform, n, batch, dev_cap): {
+        "impl": win["impl"], "chunk": chunk,
+        "devices": win["devices"], "shape": win["shape"],
+        "labels_per_sec": win["labels_per_sec"],
+        "cal_batch": CAL_BATCH, "raced": rows, "tuned_at": stamp}}
+    # the best row per mesh SHAPE, under the same budget: warmcache
+    # compiles both layouts into the persistent cache, so a later winner
+    # flip (re-race, SPACEMESH_ROMIX override) never cold-compiles
+    for shape in MESH_SHAPES:
+        srows = [r for r in usable if r["shape"] == shape]
+        if not srows:
+            continue
+        sw = _select_winner(srows)
+        entries[_shape_key(platform, n, batch, dev_cap, shape)] = {
+            "impl": sw["impl"], "chunk": sw["chunk"],
+            "devices": sw["devices"], "shape": shape,
+            "labels_per_sec": sw["labels_per_sec"],
+            "cal_batch": CAL_BATCH, "tuned_at": stamp}
+    _store_many(entries)
     _log(f"romix autotune: winner for {platform} n={n} b={batch} "
          f"(<= {dev_cap} devices): {win['impl']}"
          + (f"/chunk={chunk}" if chunk else "")
          + (f"/devices={win['devices']}" if win["devices"] > 1 else "")
          + f" ({win['labels_per_sec']:,.0f} labels/s, persisted)")
+    return d
+
+
+def shape_winner(n: int, batch: int, shape: str, *,
+                 platform: str | None = None,
+                 max_devices: int | None = None) -> Decision | None:
+    """The persisted winner for one mesh *shape* under the caller's
+    device budget, or None when no race has measured that shape yet (or
+    every candidate of that shape failed on this host). A pure cache
+    read — never races — so warmcache and tests can enumerate both
+    layouts' winners without re-paying measurement."""
+    if shape not in MESH_SHAPES:
+        raise ValueError(
+            f"mesh shape {shape!r}: expected one of {', '.join(MESH_SHAPES)}")
+    if platform is None:
+        import jax
+
+        platform = jax.default_backend()
+    dev_cap = _device_cap(max_devices)
+    entry = _load_cache().get(
+        _shape_key(platform, n, batch, dev_cap, shape), {})
+    d = _entry_decision(entry, batch, "cache")
+    if d is not None and d.mesh_shape != shape:
+        return None  # entry corrupted by hand-editing: shape key disagrees
     return d
 
 

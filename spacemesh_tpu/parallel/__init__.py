@@ -9,6 +9,7 @@ axis, sharded over devices; XLA inserts the (few) collectives, which ride
 ICI. See mesh.py.
 """
 
+from . import topology  # noqa: F401
 from .mesh import (  # noqa: F401
     data_mesh,
     init_step_sharded,

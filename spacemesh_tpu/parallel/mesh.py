@@ -1,4 +1,4 @@
-"""SPMD sharding of the POST pipeline over a device mesh.
+"""SPMD sharding of the POST pipeline over the process-wide topology.
 
 One parallelism axis matters for this workload (SURVEY.md §2.4): the label
 batch — spanning one identity's index range, or many identities' ranges
@@ -7,9 +7,14 @@ arithmetic with no cross-lane dataflow except reductions (init stats, VRF
 scan), so: shard the batch axis over the mesh, let XLA all-reduce the
 scalar stats over ICI.
 
-Mesh axis name: "data". Mainnet-scale example (BASELINE config 5): 16
-smeshers x 4 SU on a v5e-8 = batch lanes striped across 8 chips; each chip
-labels its stripe and the host shards disk writes per smesher.
+Mesh axis names: ``data`` (the lane/batch axis) and ``model`` (reserved
+for V-sharded ROMix; size 1). The mesh and its ``NamedSharding`` layouts
+are NOT built here — parallel/topology.py constructs them once per
+process and this module's entry points consume the persistent catalog
+(spacecheck SC010 keeps per-call construction from growing back).
+Mainnet-scale example (BASELINE config 5): 16 smeshers x 4 SU on a
+v5e-8 = batch lanes striped across 8 chips; each chip labels its stripe
+and the host shards disk writes per smesher.
 """
 
 from __future__ import annotations
@@ -18,30 +23,40 @@ import functools
 
 import jax
 import jax.numpy as jnp
-import numpy as np
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, NamedSharding
 
 from ..ops import proving, scrypt
 from ..ops.sha256 import byteswap32
+from . import topology
 
-DATA_AXIS = "data"
+DATA_AXIS = topology.DATA_AXIS
 
 
 def data_mesh(devices=None) -> Mesh:
-    """A 1-D data mesh over all (or the given) devices."""
-    devices = np.asarray(devices if devices is not None else jax.devices())
-    return Mesh(devices.reshape(-1), (DATA_AXIS,))
+    """The process topology's mesh over all (or the given) devices.
+
+    Same Mesh OBJECT on every call for a given device count — the
+    topology builds each count once, so jit caches key on a stable mesh
+    and sharded executables are reused across sessions and tenants."""
+    if devices is None:
+        return topology.get().layouts().mesh
+    return topology.get().layouts_for_devices(list(devices)).mesh
+
+
+def _layouts(mesh: Mesh) -> topology.MeshLayouts:
+    return topology.get().layouts_for(mesh)
 
 
 def _batch_sharding(mesh: Mesh) -> NamedSharding:
-    return NamedSharding(mesh, P(DATA_AXIS))
+    return _layouts(mesh).batch
 
 
 def lane_sharding(mesh: Mesh) -> NamedSharding:
     """Sharding for word-major arrays: (words, B) — shard the minor/lane
     axis (the autotuner's mesh race places its calibration block with
-    this, the same placement the sharded label entry points use)."""
-    return NamedSharding(mesh, P(None, DATA_AXIS))
+    this, the same placement the sharded label entry points use). Served
+    from the topology catalog, never constructed per call."""
+    return _layouts(mesh).lane
 
 
 _lane_sharding = lane_sharding  # historical private alias
@@ -49,8 +64,11 @@ _lane_sharding = lane_sharding  # historical private alias
 
 def replicate(mesh: Mesh, value) -> jax.Array:
     """Place ``value`` replicated across every device in the mesh (the
-    VRF-scan carry lives like this between sharded batches)."""
-    return jax.device_put(jnp.asarray(value), NamedSharding(mesh, P()))
+    VRF-scan carry lives like this between sharded batches). A no-op
+    when ``value`` is already resident with this layout — donated
+    carries stay on device across a whole pass instead of paying a
+    fresh ``device_put`` per batch (topology.MeshLayouts.replicate)."""
+    return _layouts(mesh).replicate(value)
 
 
 def labels_with_min_sharded(mesh: Mesh, commitment_words, idx_lo, idx_hi,
@@ -64,21 +82,21 @@ def labels_with_min_sharded(mesh: Mesh, commitment_words, idx_lo, idx_hi,
     can fetch and stripe each device's shard to disk independently.
 
     Kernel choice: ``impl`` carries the autotuned mesh winner's layout
-    (ops/autotune.py races both XLA layouts per device count); when None,
+    (ops/autotune.py races both mesh shapes per device count); when None,
     multi-device shardings pin the ROMix dispatch to the plain word-major
     XLA kernel (a sequential lane-chunk would fight GSPMD's batch
     partitioning — ops/scrypt.py ``_tunable``). The SPACEMESH_ROMIX /
     SPACEMESH_ROMIX_CHUNK overrides still win for operators who have
     measured their mesh (docs/ROMIX_KERNEL.md).
     """
-    bs = _batch_sharding(mesh)
-    idx_lo = jax.device_put(jnp.asarray(idx_lo), bs)
-    idx_hi = jax.device_put(jnp.asarray(idx_hi), bs)
+    lay = _layouts(mesh)
+    idx_lo = lay.put_batch(idx_lo)
+    idx_hi = lay.put_batch(idx_hi)
     cw = jnp.asarray(commitment_words)
     if cw.ndim == 2:
-        cw = jax.device_put(cw, lane_sharding(mesh))
+        cw = lay.put_lane(cw)
     return scrypt.scrypt_labels_with_min(cw, idx_lo, idx_hi,
-                                         replicate(mesh, carry), n=n,
+                                         lay.replicate(carry), n=n,
                                          impl=impl)
 
 
@@ -90,13 +108,24 @@ def scrypt_labels_sharded(mesh: Mesh, commitment_words, idx_lo, idx_hi,
     Returns (4, B) u32 BE words with the lane axis sharded. ``impl`` as
     in :func:`labels_with_min_sharded`.
     """
-    bs = _batch_sharding(mesh)
-    idx_lo = jax.device_put(jnp.asarray(idx_lo), bs)
-    idx_hi = jax.device_put(jnp.asarray(idx_hi), bs)
+    lay = _layouts(mesh)
+    idx_lo = lay.put_batch(idx_lo)
+    idx_hi = lay.put_batch(idx_hi)
     cw = jnp.asarray(commitment_words)
     if cw.ndim == 2:
-        cw = jax.device_put(cw, lane_sharding(mesh))
+        cw = lay.put_lane(cw)
     return scrypt.scrypt_labels_jit(cw, idx_lo, idx_hi, n=n, impl=impl)
+
+
+@jax.jit
+def words_to_le(words):
+    """(4, B) BE label words -> LE proving-hash words, on device.
+
+    The device-side twin of the host ``labels_to_bytes`` ->
+    ``labels_to_words`` round trip: sharded verify feeds label words
+    straight into the proving hash without a host bytes detour, so the
+    endianness flip the host path performs for free must happen here."""
+    return byteswap32(words)
 
 
 def prove_step_sharded(mesh: Mesh, challenge_words, nonce_base, idx_lo,
@@ -115,10 +144,10 @@ def prove_step_sharded(mesh: Mesh, challenge_words, nonce_base, idx_lo,
     divide by the mesh size — the prover's pad-and-trim already makes
     every batch the full ``batch_labels``.
     """
-    bs = _batch_sharding(mesh)
-    idx_lo = jax.device_put(jnp.asarray(idx_lo), bs)
-    idx_hi = jax.device_put(jnp.asarray(idx_hi), bs)
-    lw = jax.device_put(jnp.asarray(label_words), _lane_sharding(mesh))
+    lay = _layouts(mesh)
+    idx_lo = lay.put_batch(idx_lo)
+    idx_hi = lay.put_batch(idx_hi)
+    lw = lay.put_lane(label_words)
     return proving.prove_scan_step_jit(
         jnp.asarray(challenge_words), nonce_base, idx_lo, idx_hi, lw,
         threshold, hit_counts, hit_carry, valid, start_lo, start_hi,
@@ -149,10 +178,10 @@ def init_step_sharded(mesh: Mesh, commitment_words, idx_lo, idx_hi,
     The label computation is embarrassingly parallel over lanes; the three
     scalar stats are cross-device reductions XLA lowers to ICI all-reduces.
     """
-    bs = _batch_sharding(mesh)
-    idx_lo = jax.device_put(jnp.asarray(idx_lo), bs)
-    idx_hi = jax.device_put(jnp.asarray(idx_hi), bs)
+    lay = _layouts(mesh)
+    idx_lo = lay.put_batch(idx_lo)
+    idx_hi = lay.put_batch(idx_hi)
     cw = jnp.asarray(commitment_words)
     if cw.ndim == 2:
-        cw = jax.device_put(cw, _lane_sharding(mesh))
+        cw = lay.put_lane(cw)
     return _init_step(cw, idx_lo, idx_hi, jnp.uint32(threshold), n=n)

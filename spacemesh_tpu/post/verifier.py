@@ -19,6 +19,7 @@ import hashlib
 
 import numpy as np
 
+from ..ops import autotune
 from ..ops import pow as k2pow
 from ..ops import proving, scrypt
 from .prover import Proof, ProofParams
@@ -106,34 +107,61 @@ def verify_many(items: list[VerifyItem], params: ProofParams | None = None,
     values = np.empty(len(idx), dtype=np.uint32)
     for n in sorted({items[o].scrypt_n for o in flat_owner}):
         sel = np.array([items[o].scrypt_n == n for o in flat_owner])
-        labels = scrypt.scrypt_labels_multi(commits[sel], idx[sel], n=n)
-        lo, hi = scrypt.split_indices(idx[sel])
-        lw = scrypt.labels_to_words(labels)
         # pad the flat batch to its power-of-two shape bucket (repeat
-        # lane 0, trim after): the label recompute above already
-        # buckets inside scrypt_labels_jit, but an unbucketed
-        # proving-hash pass would compile one executable per DISTINCT
-        # spot-check count — farm batches at varying occupancy turned
-        # every new flat count into a fresh XLA compile
+        # lane 0, trim after): an unbucketed pass would compile one
+        # executable per DISTINCT spot-check count — farm batches at
+        # varying occupancy turned every new flat count into a fresh
+        # XLA compile
         b = int(sel.sum())
         bb = scrypt.shape_bucket(b)
-        if bb > b:
-            pad = bb - b
+        pad = bb - b
 
-            def _pad(a, axis=0):
-                reps = np.take(a, [0], axis=axis)
-                return np.concatenate(
-                    [a, np.repeat(reps, pad, axis=axis)], axis=axis)
+        def _pad(a, axis=0):
+            reps = np.take(a, [0], axis=axis)
+            return np.concatenate(
+                [a, np.repeat(reps, pad, axis=axis)], axis=axis)
 
-            chal_b = _pad(chals[:, sel], axis=1)
-            nonce_b = _pad(nonces[sel])
-            lo, hi = _pad(lo), _pad(hi)
-            lw = _pad(lw, axis=1)
-        else:
+        lo, hi = scrypt.split_indices(idx[sel])
+        # the shared tuned mesh routing (SPACEMESH_MESH forces; CPU
+        # consults the raced winner) — the verify farm's batch recompute
+        # is a label batch like any other, so it shards like one
+        devs, d = autotune.resolve_auto_mesh(n, bb)
+        if devs is not None and len(devs) > 1 and bb % len(devs) == 0:
+            from ..parallel import mesh as pmesh
+
+            # mesh callers pre-bucket on host (ops/scrypt.py _tunable):
+            # pad BEFORE the label recompute so one sharded executable
+            # serves every occupancy at this bucket
+            cw8 = commits[sel].view(">u4").astype(np.uint32).T  # (8, b)
             chal_b, nonce_b = chals[:, sel], nonces[sel]
-        vals = np.asarray(proving.proving_hash_jit(
-            jnp.asarray(chal_b), jnp.asarray(nonce_b),
-            jnp.asarray(lo), jnp.asarray(hi), jnp.asarray(lw)))[:b]
+            if pad:
+                cw8, chal_b = _pad(cw8, axis=1), _pad(chal_b, axis=1)
+                nonce_b, lo, hi = _pad(nonce_b), _pad(lo), _pad(hi)
+            mesh = pmesh.data_mesh(devs)
+            # sharded label words feed the sharded proving hash directly
+            # — no host bytes round-trip between the two programs. The
+            # label pipeline emits BE word groups; the proving hash eats
+            # LE (what labels_to_bytes->labels_to_words round-trips on
+            # the single-device path), so swap on device.
+            lw_dev = pmesh.words_to_le(pmesh.scrypt_labels_sharded(
+                mesh, cw8, lo, hi, n=n, impl=d.impl))
+            lay = pmesh.topology.get().layouts_for(mesh)
+            vals = np.asarray(proving.proving_hash_jit(
+                lay.put_lane(chal_b), lay.put_batch(nonce_b),
+                lay.put_batch(lo), lay.put_batch(hi), lw_dev))[:b]
+        else:
+            labels = scrypt.scrypt_labels_multi(commits[sel], idx[sel], n=n)
+            lw = scrypt.labels_to_words(labels)
+            if pad:
+                chal_b = _pad(chals[:, sel], axis=1)
+                nonce_b = _pad(nonces[sel])
+                lo, hi = _pad(lo), _pad(hi)
+                lw = _pad(lw, axis=1)
+            else:
+                chal_b, nonce_b = chals[:, sel], nonces[sel]
+            vals = np.asarray(proving.proving_hash_jit(
+                jnp.asarray(chal_b), jnp.asarray(nonce_b),
+                jnp.asarray(lo), jnp.asarray(hi), jnp.asarray(lw)))[:b]
         values[sel] = vals
 
     # 3) threshold check per item

@@ -777,7 +777,7 @@ class TenantScheduler:
         import jax.numpy as jnp
         import numpy as np
 
-        from ..ops import scrypt
+        from ..ops import autotune, scrypt
 
         segments, n = pack
         lanes = sum(s.count for s in segments)
@@ -787,14 +787,38 @@ class TenantScheduler:
             cw[:, s.lane0:s.lane0 + s.count] = s.job.cw[:, None]
             idx[s.lane0:s.lane0 + s.count] = np.arange(
                 s.start, s.start + s.count, dtype=np.uint64)
-        lo, hi = scrypt.split_indices(idx)
         metrics.runtime_pack_occupancy.observe(lanes)
         metrics.runtime_pack_tenants.observe(
             len({s.job.tenant.id for s in segments}))
-        # scrypt_labels_jit pads ragged packs to their shape bucket
-        # (per-lane cw padded too) — one executable per (n, bucket)
-        words = scrypt.scrypt_labels_jit(
-            jnp.asarray(cw), jnp.asarray(lo), jnp.asarray(hi), n=n)
+        # the tuned mesh routing every mesh-aware entry point shares
+        # (SPACEMESH_MESH forces; CPU consults the raced winner). Packs
+        # dispatch at their shape bucket either way — one executable per
+        # (n, bucket) — so the bucket is what the mesh must divide.
+        bucket = scrypt.shape_bucket(lanes)
+        devs, d = autotune.resolve_auto_mesh(n, bucket)
+        if devs is not None and len(devs) > 1 and bucket % len(devs) == 0:
+            from ..parallel import mesh as pmesh
+
+            # mesh callers pre-bucket on host (ops/scrypt.py _tunable
+            # skips padding for sharded inputs): repeat the last lane —
+            # a real commitment/index, so padding lanes recompute a real
+            # label and stay branch-free; _retire_pack slices only the
+            # segment-addressed lanes
+            if bucket != lanes:
+                cw = np.concatenate(
+                    [cw, np.repeat(cw[:, -1:], bucket - lanes, axis=1)],
+                    axis=1)
+                idx = np.concatenate(
+                    [idx, np.repeat(idx[-1:], bucket - lanes)])
+            lo, hi = scrypt.split_indices(idx)
+            words = pmesh.scrypt_labels_sharded(
+                pmesh.data_mesh(devs), cw, lo, hi, n=n, impl=d.impl)
+        else:
+            lo, hi = scrypt.split_indices(idx)
+            # scrypt_labels_jit pads ragged packs to their shape bucket
+            # (per-lane cw padded too) — one executable per (n, bucket)
+            words = scrypt.scrypt_labels_jit(
+                jnp.asarray(cw), jnp.asarray(lo), jnp.asarray(hi), n=n)
         return words, segments, time.perf_counter()
 
     def _retire_pack(self, ticket) -> None:

@@ -123,6 +123,20 @@ def _warm_init_pack(n: int, batch: int) -> dict:
     _timed(doc, "labels_fused_perlane",
            lambda: scrypt.scrypt_labels_jit(
                jnp.asarray(cw), jnp.asarray(lo), jnp.asarray(hi), n=n))
+    # when the tuned routing shards packs at this bucket, the sharded
+    # twin is a DIFFERENT executable (GSPMD-partitioned) — warm it too,
+    # or the first real pack dispatch pays the compile
+    from ..ops import autotune
+
+    devs, d = autotune.resolve_auto_mesh(n, batch)
+    if devs is not None and len(devs) > 1 and batch % len(devs) == 0:
+        from ..parallel import mesh as pmesh
+
+        mesh = pmesh.data_mesh(devs)
+        _timed(doc, f"labels_fused_perlane_mesh{len(devs)}",
+               lambda: pmesh.scrypt_labels_sharded(
+                   mesh, cw, lo, hi, n=n, impl=d.impl))
+        doc["pack_devices"] = len(devs)
     return doc
 
 
@@ -161,12 +175,30 @@ def _warm_verify(n: int, batch: int) -> dict:
     doc = _warm_init_pack(n, batch)
     cw = jnp.asarray(proving.challenge_words(bytes(32)))
     idx = np.arange(batch, dtype=np.uint64)
-    lo, hi = (jnp.asarray(a) for a in
-              ((idx & 0xFFFFFFFF).astype(np.uint32),
-               (idx >> 32).astype(np.uint32)))
+    lo_h = (idx & 0xFFFFFFFF).astype(np.uint32)
+    hi_h = (idx >> 32).astype(np.uint32)
+    lo, hi = jnp.asarray(lo_h), jnp.asarray(hi_h)
     lw = jnp.zeros((4, batch), jnp.uint32)
     _timed(doc, "proving_hash",
            lambda: proving.proving_hash_jit(cw, jnp.uint32(7), lo, hi, lw))
+    if doc.get("pack_devices", 1) > 1:
+        # the verify farm's sharded batch: per-lane challenges/nonces,
+        # GSPMD-partitioned proving hash (post/verifier.py mesh path)
+        from ..ops import autotune
+        from ..parallel import mesh as pmesh
+
+        devs, _ = autotune.resolve_auto_mesh(n, batch)
+        lay = pmesh.topology.get().layouts_for_devices(devs)
+        chal_b = np.broadcast_to(
+            np.asarray(proving.challenge_words(bytes(32)))[:, None],
+            (8, batch)).copy()
+        _timed(doc, f"proving_hash_mesh{len(devs)}",
+               lambda: proving.proving_hash_jit(
+                   lay.put_lane(chal_b),
+                   lay.put_batch(np.full(batch, 7, np.uint32)),
+                   lay.put_batch(lo_h), lay.put_batch(hi_h),
+                   pmesh.words_to_le(
+                       lay.put_lane(np.zeros((4, batch), np.uint32)))))
     return doc
 
 

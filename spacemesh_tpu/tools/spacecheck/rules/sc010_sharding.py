@@ -1,0 +1,78 @@
+"""SC010 sharding: no per-call Mesh/NamedSharding construction in hot paths.
+
+Originating defect: ISSUE 16's data-plane audit — parallel/mesh.py
+re-derived ``NamedSharding(mesh, P(...))`` on EVERY sharded dispatch
+(and re-``device_put`` loop-invariant replicated carries per batch,
+evicting donated buffers that were already resident).  Each sharding
+object is cheap alone, but jit caches key on them and steady-state
+dispatch should allocate none; worse, a hand-built ``Mesh`` per call
+defeats executable reuse outright — two meshes over the same devices
+are different cache keys, so every dispatch site that minted its own
+paid its own GSPMD compile.  parallel/topology.py now owns the ONE
+process-wide mesh and its persistent layout catalog; every entry point
+consumes it.
+
+Flags, inside function bodies of the hot-path packages
+(``spacemesh_tpu/{ops,runtime,post,verify,parallel}/``): calls whose
+callee's last dotted segment is ``Mesh`` or ``NamedSharding`` — the
+per-call construction idiom this rule exists to keep deleted.
+Module-level constants are not flagged (construction at import time is
+once-per-process by definition).  The topology module itself is the
+exemption — its catalog constructors carry
+``# spacecheck: ok=SC010 <why>`` pragmas, which keeps the exemption
+visible at the construction site instead of buried in a config list.
+
+Fix: take layouts from ``parallel.topology.get()`` (``layouts()``,
+``layouts_for_devices()``, ``layouts_for(mesh)``) or go through the
+``parallel/mesh.py`` entry points, which already do.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..engine import FileContext, Finding, ProjectInfo, dotted_name
+
+RULE = "SC010"
+
+_HOT = tuple(f"spacemesh_tpu/{p}/"
+             for p in ("ops", "runtime", "post", "verify", "parallel"))
+_CONSTRUCTORS = ("Mesh", "NamedSharding")
+
+
+class _Visitor(ast.NodeVisitor):
+    def __init__(self, ctx: FileContext) -> None:
+        self.ctx = ctx
+        self.findings: list[Finding] = []
+        self._fn_depth = 0
+
+    def _visit_fn(self, node) -> None:
+        self._fn_depth += 1
+        self.generic_visit(node)
+        self._fn_depth -= 1
+
+    visit_FunctionDef = _visit_fn
+    visit_AsyncFunctionDef = _visit_fn
+    visit_Lambda = _visit_fn
+
+    def visit_Call(self, node: ast.Call) -> None:
+        name = dotted_name(node.func)
+        if (self._fn_depth > 0 and name is not None
+                and name.rsplit(".", 1)[-1] in _CONSTRUCTORS):
+            self.findings.append(self.ctx.finding(
+                RULE, node,
+                f"per-call {name.rsplit('.', 1)[-1]}(...) construction "
+                "in a hot-path module: jit caches key on sharding "
+                "objects, so a fresh one per dispatch defeats "
+                "executable/layout reuse. Consume the persistent "
+                "catalog (parallel/topology.py get().layouts*()) or "
+                "the parallel/mesh.py entry points instead"))
+        self.generic_visit(node)
+
+
+def check(ctx: FileContext, project: ProjectInfo) -> list[Finding]:
+    if not ctx.rel.startswith(_HOT):
+        return []
+    v = _Visitor(ctx)
+    v.visit(ctx.tree)
+    return v.findings

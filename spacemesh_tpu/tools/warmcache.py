@@ -123,6 +123,23 @@ def _warm_shape(n: int, batch: int, mesh_ok: bool) -> dict:
           lambda: pmesh.labels_with_min_sharded(
               mesh, cw, lo, hi, scrypt.vrf_carry_init(), n=n,
               impl=dm.impl)[0])
+    # BOTH persisted mesh-shape winners (lane-sharded and V-sharded),
+    # not just the routed one: a later re-race or SPACEMESH_ROMIX flip
+    # that lands on the other layout must hit the compile cache, not pay
+    # a cold GSPMD compile mid-session
+    doc["mesh_shapes"] = {}
+    for shape in autotune.MESH_SHAPES:
+        sw = autotune.shape_winner(n, batch, shape, max_devices=None)
+        if sw is None or sw.devices <= 1 or batch % sw.devices:
+            continue
+        doc["mesh_shapes"][shape] = {"impl": sw.impl,
+                                     "devices": sw.devices}
+        if (sw.impl, sw.devices) == (dm.impl, dm.devices):
+            continue  # the routed winner above already compiled it
+        smesh = pmesh.data_mesh(jax.devices()[:sw.devices])
+        timed(f"labels_sharded_{shape}_d{sw.devices}",
+              lambda sm=smesh, si=sw.impl: pmesh.scrypt_labels_sharded(
+                  sm, cw, lo, hi, n=n, impl=si))
     return doc
 
 

@@ -1,0 +1,240 @@
+"""The GSPMD data plane (ISSUE 16): one process-wide topology, persistent
+layout catalog, mesh-sharded pack/verify twins bit-identical to the
+single-device paths, and mesh-shape autotune winners that persist."""
+
+import hashlib
+import json
+
+import jax
+import numpy as np
+import pytest
+
+from spacemesh_tpu.ops import autotune, scrypt
+from spacemesh_tpu.parallel import data_mesh, topology
+from spacemesh_tpu.parallel import mesh as pmesh
+
+N = 4
+
+
+@pytest.fixture
+def tuner(tmp_path, monkeypatch):
+    """Fresh autotune world: private winners file, no overrides, no
+    memoized decisions (racing stays OFF via conftest)."""
+    path = tmp_path / "romix_autotune.json"
+    monkeypatch.setenv(autotune.ENV_CACHE, str(path))
+    monkeypatch.delenv(autotune.ENV_IMPL, raising=False)
+    monkeypatch.delenv(autotune.ENV_CHUNK, raising=False)
+    monkeypatch.delenv(autotune.ENV_MESH, raising=False)
+    autotune.reset_memo()
+    yield path
+    autotune.reset_memo()
+
+
+def _seed_mesh_winner(path, n, batch, devices, impl="xla"):
+    key = autotune._key("cpu", n, scrypt.shape_bucket(batch),
+                        autotune._device_cap(None))
+    doc = json.loads(path.read_text()) if path.exists() else {}
+    doc[key] = {"impl": impl, "chunk": None, "devices": devices,
+                "labels_per_sec": 9999.0}
+    path.write_text(json.dumps(doc))
+    autotune.reset_memo()
+
+
+# --- the topology singleton + persistent catalog --------------------------
+
+
+def test_one_mesh_object_per_process():
+    """Every entry point consumes the SAME Mesh/NamedSharding objects —
+    the acceptance criterion that makes jit executable reuse structural
+    rather than accidental."""
+    t = topology.get()
+    assert t is topology.get()
+    lay = t.layouts()
+    assert lay is t.layouts()
+    assert lay.mesh is data_mesh()
+    assert lay.mesh.shape == {"data": 8, "model": 1}
+    # submesh catalogs are cached per count, prefix selections included
+    sub = t.layouts(4)
+    assert sub is t.layouts_for_devices(jax.devices()[:4])
+    assert sub.mesh is data_mesh(jax.devices()[:4])
+    # the sharding objects themselves are persistent (not per-call)
+    assert lay.batch is t.layouts().batch
+    assert lay.lane is t.layouts().lane
+    assert pmesh.lane_sharding(lay.mesh) is lay.lane
+
+
+def test_layouts_for_foreign_mesh_resolves_by_devices():
+    lay = topology.get().layouts(2)
+    resolved = topology.get().layouts_for(lay.mesh)
+    assert resolved is lay
+
+
+def test_replicate_is_noop_for_resident_carry():
+    """The satellite fix: a carry already replicated on the mesh is
+    returned as-is (same object), so donated carries stay resident
+    across a pass instead of paying a device_put per batch."""
+    lay = topology.get().layouts()
+    carry = scrypt.vrf_carry_init()
+    placed = lay.replicate(carry)
+    assert lay.replicate(placed) is placed
+    # and via the mesh.py entry point wrapper too
+    assert pmesh.replicate(lay.mesh, placed) is placed
+
+
+# --- sharded packed multi-tenant init: ragged totals ----------------------
+
+
+@pytest.mark.parametrize("totals", [(1,), (7,), (7, 1039)],
+                         ids=["1", "7", "7+1039"])
+def test_packed_init_sharded_bit_identity(tuner, tmp_path, totals):
+    """The TenantScheduler's pack dispatch routed over a 4-device mesh
+    produces byte-identical label files and VRF nonces to the host
+    reference at ragged totals (host pre-bucket pad + segment slicing)."""
+    from spacemesh_tpu.post.data import LabelStore
+    from spacemesh_tpu.runtime import TenantScheduler
+
+    pack = 256
+    _seed_mesh_winner(tuner, N, pack, devices=4)
+    ids = [(f"t{i}", hashlib.sha256(b"tnode%d" % i).digest(),
+            hashlib.sha256(b"tcommit%d" % i).digest(), total)
+           for i, total in enumerate(totals)]
+    with TenantScheduler(workers=2, pack_lanes=pack) as sched:
+        handles = []
+        for tid, node, commit, total in ids:
+            sched.register_tenant(tid)
+            handles.append((tid, commit, total, sched.submit_init(
+                tid, tmp_path / tid, node_id=node, commitment=commit,
+                num_units=1, labels_per_unit=total, scrypt_n=N,
+                max_file_size=1 << 20)))
+        for tid, commit, total, h in handles:
+            meta = h.result(timeout=600)
+            store = LabelStore(tmp_path / tid, meta)
+            got = np.frombuffer(store.read_labels(0, total),
+                                dtype=np.uint8).reshape(-1, 16)
+            store.close()
+            want = scrypt.scrypt_labels(
+                commit, np.arange(total, dtype=np.uint64), n=N)
+            assert np.array_equal(got, want), f"{tid} labels diverged"
+            lo = want[:, :8].copy().view("<u8").ravel()
+            hi = want[:, 8:].copy().view("<u8").ravel()
+            assert meta.vrf_nonce == int(np.lexsort((lo, hi))[0]), tid
+    # the routing the packer consulted really was the sharded one
+    devs, _ = autotune.resolve_auto_mesh(N, scrypt.shape_bucket(pack))
+    assert devs is not None and len(devs) == 4
+
+
+def test_packed_init_steady_state_zero_new_compiles(tuner, tmp_path):
+    """A warm process dispatches sharded packs with ZERO new compiles:
+    after the first pack at a bucket, compiled_shape_count() stays flat
+    for every later pack at that bucket (acceptance criterion)."""
+    from spacemesh_tpu.runtime import TenantScheduler
+
+    pack = 128
+    _seed_mesh_winner(tuner, N, pack, devices=4)
+
+    def run(tag, totals):
+        with TenantScheduler(workers=2, pack_lanes=pack) as sched:
+            hs = []
+            for i, total in enumerate(totals):
+                tid = f"{tag}{i}"
+                sched.register_tenant(tid)
+                hs.append(sched.submit_init(
+                    tid, tmp_path / tid, node_id=hashlib.sha256(
+                        b"zn%d" % i).digest(),
+                    commitment=hashlib.sha256(b"zc%d" % i).digest(),
+                    num_units=1, labels_per_unit=total, scrypt_n=N,
+                    max_file_size=1 << 20))
+            for h in hs:
+                h.result(timeout=600)
+
+    run("warm", (64, 64))           # compile the (n, bucket) executables
+    warm = scrypt.compiled_shape_count()
+    run("steady", (33, 95, 128))    # ragged lanes, same pack bucket
+    assert scrypt.compiled_shape_count() == warm, \
+        "steady-state sharded dispatch minted a new executable"
+
+
+# --- sharded farm verify: ragged flat batches -----------------------------
+
+
+@pytest.mark.parametrize("count", [1, 7, 1039])
+def test_farm_verify_sharded_matches_single_device(tuner, count):
+    """verify_many over a mesh-routed batch returns the same verdicts as
+    the single-device pass at ragged spot-check totals."""
+    from spacemesh_tpu.post import verifier
+    from spacemesh_tpu.post.prover import Proof, ProofParams
+
+    total_labels = 64
+    p = ProofParams(k1=8, k2=1, k3=1, pow_difficulty=bytes([255] * 32))
+    items = []
+    for i in range(count):
+        items.append(verifier.VerifyItem(
+            Proof(nonce=0, indices=[i % total_labels], pow_nonce=0, k2=1),
+            hashlib.sha256(b"vch%d" % i).digest(),
+            hashlib.sha256(b"vnode%d" % i).digest(),
+            hashlib.sha256(b"vcommit%d" % i).digest(),
+            N, total_labels))
+    seed = b"topology-seed".ljust(32, b"\0")
+
+    autotune.reset_memo()
+    single = verifier.verify_many(items, p, seed)
+    _seed_mesh_winner(tuner, N, scrypt.shape_bucket(count), devices=4)
+    sharded = verifier.verify_many(items, p, seed)
+    assert sharded == single
+    devs, _ = autotune.resolve_auto_mesh(N, scrypt.shape_bucket(count))
+    if scrypt.shape_bucket(count) % 4 == 0:
+        assert devs is not None and len(devs) == 4
+
+
+# --- mesh-shape autotune winners ------------------------------------------
+
+
+def _fake_rows(platform, n, combos):
+    """Synthetic race: V-sharded (xla-rows) wins at 4 devices, the best
+    lane-sharded row is xla at 2; single-device rows stay slow."""
+    rates = {("xla-rows", 4): 4000.0, ("xla-rows", 2): 2500.0,
+             ("xla", 2): 3000.0, ("xla", 4): 2900.0, ("xla", 8): 2800.0,
+             ("xla-rows", 8): 2600.0}
+    return [{"impl": impl, "chunk": chunk, "devices": d,
+             "shape": autotune.shape_of(impl),
+             "labels_per_sec": rates.get((impl, d), 100.0)}
+            for impl, chunk, d in combos]
+
+
+def test_mesh_shape_winner_persist_and_reread(tuner, monkeypatch):
+    """race() persists a winner PER mesh shape; shape_winner() re-reads
+    both from disk in a fresh memo world (the round-trip criterion)."""
+    monkeypatch.setenv(autotune.ENV_AUTOTUNE, "on")
+    monkeypatch.setattr(autotune, "_race_rows", _fake_rows)
+    d = autotune.decide(N, 512, platform="cpu", max_devices=None)
+    assert (d.impl, d.devices, d.mesh_shape) == ("xla-rows", 4, "vshard")
+
+    # fresh process: memos dropped, everything comes off the disk file
+    autotune.reset_memo()
+    monkeypatch.setenv(autotune.ENV_AUTOTUNE, "off")
+    lane = autotune.shape_winner(N, 512, "lane", platform="cpu",
+                                 max_devices=None)
+    vshard = autotune.shape_winner(N, 512, "vshard", platform="cpu",
+                                   max_devices=None)
+    assert (lane.impl, lane.devices, lane.mesh_shape) == ("xla", 2, "lane")
+    assert (vshard.impl, vshard.devices, vshard.mesh_shape) \
+        == ("xla-rows", 4, "vshard")
+    # and the overall cached winner still resolves (source=cache)
+    d2 = autotune.decide(N, 512, platform="cpu", max_devices=None)
+    assert (d2.impl, d2.devices, d2.source) == ("xla-rows", 4, "cache")
+    assert d2.mesh_shape == "vshard"
+
+
+def test_legacy_winner_entries_default_their_shape(tuner):
+    """Pre-shape winners files (written before ISSUE 16) resolve with
+    the shape implied by their impl — no re-race, no schema bump."""
+    _seed_mesh_winner(tuner, N, 512, devices=4, impl="xla-rows")
+    d = autotune.decide(N, 512, platform="cpu", max_devices=None)
+    assert (d.devices, d.mesh_shape) == (4, "vshard")
+    assert autotune.shape_winner(N, 512, "lane", platform="cpu",
+                                 max_devices=None) is None
+
+
+def test_shape_winner_rejects_unknown_shape(tuner):
+    with pytest.raises(ValueError):
+        autotune.shape_winner(N, 512, "diagonal", platform="cpu")
