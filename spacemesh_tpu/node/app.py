@@ -183,30 +183,48 @@ class App:
         # ROADMAP #3's failover residual: SPACEMESH_VERIFYD_URL routes
         # this node's verification through a remote verifyd service,
         # with breaker-guarded transparent fallback to the local farm
-        # (verifyd/failover.py). Unset = exactly the local farm.
+        # (verifyd/failover.py). SPACEMESH_VERIFYD_URLS (comma-
+        # separated) generalizes that to a FLEET: consistent-hash
+        # placement across the listed replicas, remote→remote failover
+        # down the ring, local farm last (verifyd/fleet.py). Both
+        # unset = exactly the local farm.
         self.failover_verifier = None
+        self.fleet_verifier = None
         verify_router = self.verify_farm
+        # the deadline bounds a BLACK-HOLED service (drop-everything
+        # partition): without it each remote attempt would ride
+        # aiohttp's default multi-minute timeout while BLOCK-lane
+        # handlers wait, which is exactly the availability the
+        # failover exists to protect.
+        verifyd_deadline_s = float(os.environ.get(
+            "SPACEMESH_VERIFYD_DEADLINE_S", "5.0"))
+        verifyd_urls = os.environ.get("SPACEMESH_VERIFYD_URLS")
         verifyd_url = os.environ.get("SPACEMESH_VERIFYD_URL")
-        if verifyd_url:
+        if verifyd_urls:
+            from ..verifyd.fleet import fleet_from_urls
+
+            self.fleet_verifier = fleet_from_urls(
+                [u.strip() for u in verifyd_urls.split(",")
+                 if u.strip()],
+                farm=self.verify_farm,
+                client_id=self.signer.node_id.hex()[:16],
+                deadline_s=verifyd_deadline_s, bus=self.events,
+                **({"time_source": self.time_source}
+                   if self._time_injected else {}))
+            verify_router = self.fleet_verifier
+        elif verifyd_url:
             from ..verifyd.client import VerifydClient
             from ..verifyd.failover import FailoverVerifier
 
             # retry=None: the breaker owns retry policy here — the
             # client's own shed-retry sleeps would stack a second
-            # backoff layer in front of it and delay failover. The
-            # deadline bounds a BLACK-HOLED service (drop-everything
-            # partition): without it each remote attempt would ride
-            # aiohttp's default multi-minute timeout while BLOCK-lane
-            # handlers wait, which is exactly the availability the
-            # failover exists to protect.
-            deadline_s = float(os.environ.get(
-                "SPACEMESH_VERIFYD_DEADLINE_S", "5.0"))
+            # backoff layer in front of it and delay failover.
             self.failover_verifier = FailoverVerifier(
                 remote=VerifydClient(verifyd_url,
                                      self.signer.node_id.hex()[:16],
                                      retry=None),
                 farm=self.verify_farm, own_remote=True, bus=self.events,
-                deadline_s=deadline_s,
+                deadline_s=verifyd_deadline_s,
                 **({"time_source": self.time_source}
                    if self._time_injected else {}))
             verify_router = self.failover_verifier
@@ -969,12 +987,14 @@ class App:
             lambda t: self._tasks.remove(t) if t in self._tasks else None)
 
     async def stop_network(self) -> None:
-        # the failover verifier's owned remote client holds an aiohttp
-        # session and a server-side registration — both need a live
-        # loop to release (the sync App.close() can only drop the
-        # breaker registration), so the async teardown path owns them
+        # the failover/fleet verifiers' owned remote clients hold
+        # aiohttp sessions and server-side registrations — both need a
+        # live loop to release (the sync App.close() can only drop the
+        # breaker registrations), so the async teardown path owns them
         if self.failover_verifier is not None:
             await self.failover_verifier.aclose()
+        if self.fleet_verifier is not None:
+            await self.fleet_verifier.aclose()
         if getattr(self, "host", None) is not None:
             from ..obs import health as health_mod
             from ..obs import remediate as remediate_mod
@@ -1241,6 +1261,8 @@ class App:
         self.remediation.start()
         if self.failover_verifier is not None:
             self.failover_verifier.start()
+        if self.fleet_verifier is not None:
+            self.fleet_verifier.start()
         return await self.api.start()
 
     async def start_grpc_api(self) -> int:
@@ -1291,6 +1313,8 @@ class App:
         self.remediation.start()
         if self.failover_verifier is not None:
             self.failover_verifier.start()
+        if self.fleet_verifier is not None:
+            self.fleet_verifier.start()
         seen_epochs = {0}
         async for layer in self.clock.ticks():
             if layer <= layerstore.processed(self.state):
@@ -1361,6 +1385,8 @@ class App:
         self.remediation.close()
         if self.failover_verifier is not None:
             self.failover_verifier.shutdown()
+        if self.fleet_verifier is not None:
+            self.fleet_verifier.shutdown()
         self.health_engine.close()
         self.verify_farm.shutdown()
         if self.post_supervisor is not None:

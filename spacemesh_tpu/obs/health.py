@@ -254,6 +254,22 @@ def verifyd_slos() -> list[Slo]:
     ]
 
 
+def fleet_slos() -> list[Slo]:
+    """The verifyd fleet's SLO set (verifyd/fleet.py): what the NODE
+    experienced end-to-end, whichever replica (or the local farm)
+    served it.  The BLOCK-lane p99 mirrors the failover scenario's
+    acceptance bar — a replica kill mid-load must NOT show up here
+    (the sim's fleet scenario asserts this SLO green on the virtual
+    clock); the aggregate p99 keeps a looser ceiling on the gossip and
+    sync lanes' tail."""
+    return [
+        Slo(name="fleet_block_latency", sli="fleet_block_p99",
+            target=0.25, window_s=60.0, budget=0.1),
+        Slo(name="fleet_verify_latency", sli="fleet_verify_p99",
+            target=2.0, window_s=120.0, budget=0.2),
+    ]
+
+
 class _SloState:
     __slots__ = ("marks", "breached", "burn")
 

@@ -453,12 +453,28 @@ class RecoveryRule:
 def default_policy() -> list[RecoveryRule]:
     """The node's rule set (docs/SELF_HEALING.md documents each): wedged
     farm lanes reset, verifyd's drain path resets its farm lanes, a
-    stalled syncer restarts, stalled POST pipelines restart, and any
-    SLO breach sheds-and-alerts (flight bundle + event, no mutation)."""
+    fleet replica that keeps tripping its breaker restarts then lands
+    in quarantine (the router stops routing to it), a stalled syncer
+    restarts, stalled POST pipelines restart, and any SLO breach
+    sheds-and-alerts (flight bundle + event, no mutation).  Rule order
+    matters (first match wins): ``verifyd.replica.*`` must precede the
+    ``verifyd.*`` shard rule it would otherwise fall through to."""
     return [
         RecoveryRule(component="verify.farm", action=RESET_FARM_LANES,
                      budget=3, window_s=600.0, cooldown_s=60.0),
+        # a fleet replica breaker (verifyd/fleet.py registers one per
+        # replica as verifyd.replica.<name>): restart it; a flapper
+        # that exhausts the budget gets quarantined, which the fleet
+        # router treats as "never route here" until an operator acts
+        RecoveryRule(component="verifyd.replica.*",
+                     action=RESTART_COMPONENT, budget=3,
+                     window_s=600.0, cooldown_s=60.0,
+                     escalation=QUARANTINE_COMPONENT),
         RecoveryRule(component="verifyd", action=RESET_FARM_LANES,
+                     budget=3, window_s=600.0, cooldown_s=60.0),
+        # sharded in-process services (verifyd.<shard> — the fleet sim
+        # and multi-replica single-host layouts) heal like verifyd
+        RecoveryRule(component="verifyd.*", action=RESET_FARM_LANES,
                      budget=3, window_s=600.0, cooldown_s=60.0),
         RecoveryRule(component="sync", action=RESTART_COMPONENT,
                      budget=3, window_s=900.0, cooldown_s=120.0),

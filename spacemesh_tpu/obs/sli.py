@@ -182,6 +182,41 @@ def failover_slis() -> list[SliSpec]:
     return specs
 
 
+def fleet_slis(replicas=()) -> list[SliSpec]:
+    """The verifyd fleet's indicator set (verifyd/fleet.py): the
+    latency the NODE saw whatever replica (or local path) served it —
+    the BLOCK-lane p99 is the fleet sim's acceptance SLO — plus the
+    per-replica load signals FleetRouter.update_signals() turns into
+    work-steal decisions and the ``fleet_desired_replicas`` autoscaling
+    gauge: each replica's queue-wait p99 and shed rate, named exactly
+    ``fleet_replica_{name}_queue_p99`` / ``fleet_replica_{name}_
+    shed_per_sec`` (the router looks them up by that contract)."""
+    specs: list[SliSpec] = []
+    specs += quantile_slis("fleet_verify_seconds", "fleet_verify")
+    for lane in ("block", "gossip", "sync"):
+        specs.append(SliSpec(name=f"fleet_{lane}_p99",
+                             metric="fleet_verify_seconds",
+                             kind="quantile", q=0.99,
+                             labels=(("lane", lane),)))
+    for path in ("remote", "local", "local_fastfail"):
+        specs.append(SliSpec(name=f"fleet_{path}_per_sec",
+                             metric="fleet_requests_total",
+                             kind="rate", labels=(("path", path),)))
+    for name in replicas:
+        key = (("replica", str(name)),)
+        specs.append(SliSpec(
+            name=f"fleet_replica_{name}_queue_p99",
+            metric="fleet_replica_verify_seconds",
+            kind="quantile", q=0.99, labels=key))
+        specs.append(SliSpec(
+            name=f"fleet_replica_{name}_shed_per_sec",
+            metric="fleet_replica_sheds_total",
+            kind="rate", labels=key))
+    specs.append(SliSpec(name="fleet_desired_replicas",
+                         metric="fleet_desired_replicas", kind="gauge"))
+    return specs
+
+
 def verifyd_client_slis(clients) -> list[SliSpec]:
     """Per-client indicators for the given client ids — each spec's
     labelset filter aggregates every series carrying that ``client``

@@ -395,6 +395,14 @@ class TenantScheduler:
             self._shared.touch(write=False)
             return sorted(self._tenants)
 
+    def namespaced(self, shard: str) -> "ShardScheduler":
+        """A tenant-id-namespacing view for one fleet shard: every
+        tenant registered through it lives as ``<shard>/<tid>``, so N
+        verifyd replicas can share ONE device runtime without their
+        client identities (fair-share vtime, quotas, per-tenant metric
+        series) colliding (verifyd/fleet.py)."""
+        return ShardScheduler(self, shard)
+
     # -- submission ----------------------------------------------------
 
     # guarded by: self._lock — every submit_* caller enters with the scheduler lock held
@@ -977,3 +985,48 @@ class TenantScheduler:
                 j.error = j.error or exc
                 if j.outstanding == 0:
                     self._finalize_init(j)
+
+
+class ShardScheduler:
+    """One shard's view of a shared :class:`TenantScheduler`.
+
+    Prefixes every tenant id with ``<shard>/`` on the way in and strips
+    it on the way out, so per-shard client registries (verifyd fleet
+    replicas) scale past one registry's identity space while sharing
+    the device runtime.  ``close``/``drain``/``start`` pass through to
+    the underlying scheduler — the OWNER decides lifetime; a view held
+    by a non-owning service simply never calls close (the same
+    ownership rule VerifydService already applies to an injected
+    scheduler).
+    """
+
+    def __init__(self, inner: TenantScheduler, shard: str):
+        self.inner = inner
+        self.shard = str(shard)
+        self._prefix = f"{self.shard}/"
+
+    def _tid(self, tid: str) -> str:
+        return self._prefix + str(tid)
+
+    def register_tenant(self, tid: str, **kwargs) -> str:
+        self.inner.register_tenant(self._tid(tid), **kwargs)
+        return str(tid)
+
+    def unregister_tenant(self, tid: str) -> None:
+        self.inner.unregister_tenant(self._tid(tid))
+
+    def submit_call(self, tid: str, fn, **kwargs) -> JobHandle:
+        return self.inner.submit_call(self._tid(tid), fn, **kwargs)
+
+    def tenants(self) -> list[str]:
+        return [t[len(self._prefix):] for t in self.inner.tenants()
+                if t.startswith(self._prefix)]
+
+    def start(self) -> None:
+        self.inner.start()
+
+    def drain(self, timeout: float | None = None) -> bool:
+        return self.inner.drain(timeout)
+
+    def close(self) -> None:
+        self.inner.close()

@@ -69,14 +69,17 @@ def main(argv=None) -> int:
 
     # script "engine" selects the runner: the network scenario engine
     # (default), the verifyd service-load engine (sim/verifyd_load.py),
-    # the POST crash-recovery engine (sim/crash_recovery.py), or the
-    # self-healing failover engine (sim/failover.py)
+    # the POST crash-recovery engine (sim/crash_recovery.py), the
+    # self-healing failover engine (sim/failover.py), or the verifyd
+    # fleet engine (sim/fleet.py)
     if script.get("engine") == "verifyd":
         from .verifyd_load import run_scenario as run_fn
     elif script.get("engine") == "crashrec":
         from .crash_recovery import run_scenario as run_fn
     elif script.get("engine") == "failover":
         from .failover import run_scenario as run_fn
+    elif script.get("engine") == "fleet":
+        from .fleet import run_scenario as run_fn
     else:
         run_fn = run_scenario
 

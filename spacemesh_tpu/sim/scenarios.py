@@ -293,6 +293,68 @@ def verifyd_outage(seed: int = 7) -> dict:
     }
 
 
+def fleet(seed: int = 7) -> dict:
+    """Three sharded verifyd replicas behind one FleetVerifier: 2,400
+    placed client identities fill the fleet-wide admission bound (the
+    overflow client hears a typed ``registry_full``), a hot replica's
+    registry pressure drives re-routes and work steals, a replica kill
+    mid-load is absorbed by the survivors with zero verdict divergence,
+    a full blackout lands every request on the local farm, and the
+    fleet probes its way back to remote serving — BLOCK-lane p99 green
+    throughout, byte-identical digest across ``--repeat`` runs."""
+    return {
+        "name": "fleet", "engine": "fleet", "seed": seed,
+        "waves": 18, "wave_interval_s": 0.5,
+        "replicas": [
+            # r0's own registry is tiny: registry_full sheds re-route
+            # its placed clients and heat it up into a steal source
+            {"name": "r0", "router_max_clients": 800,
+             "service": {"max_clients": 6, "max_pending_items": 4096,
+                         "workers": 2}},
+            {"name": "r1", "router_max_clients": 800,
+             "service": {"max_clients": 512, "max_pending_items": 4096,
+                         "workers": 2}},
+            {"name": "r2", "router_max_clients": 800,
+             "service": {"max_clients": 512, "max_pending_items": 4096,
+                         "workers": 2}},
+        ],
+        "clients": {"active_per_wave": 14, "pinned_hot": 3,
+                    "overflow": 2, "items": [2, 4], "hot_replica": "r0",
+                    "mix": {"sig": 6, "vrf": 1, "membership": 1,
+                            "pow": 2}},
+        "breaker": {"failure_budget": 2, "window_s": 60.0,
+                    "cooldown_s": 1.0, "cooldown_cap_s": 2.0},
+        "faults": {"kill": {"replica": "r1", "wave": 3,
+                            "restore_wave": 7},
+                   "blackout": {"wave": 11, "restore_wave": 13}},
+        "workload": {"sigs": 48, "vrfs": 6, "posts": 2,
+                     "memberships": 8, "pows": 10},
+        "asserts": [
+            {"kind": "no_wrong_verdicts"},
+            {"kind": "typed_sheds_only", "reasons": ["registry_full"]},
+            {"kind": "fleet_bound", "clients": 2400},
+            {"kind": "shed", "client": "over-", "reason":
+             "registry_full", "min": 18},
+            {"kind": "reroutes", "min": 3},
+            {"kind": "steals", "min": 3},
+            {"kind": "path_served", "path": "remote", "min": 100},
+            {"kind": "path_served", "path": "local", "min": 10},
+            {"kind": "path_served", "replica": "r2", "min": 20},
+            {"kind": "blackout_local"},
+            {"kind": "dead_replica_attempts_bounded", "replica": "r1",
+             "max": 8},
+            {"kind": "breaker_sequence", "replica": "r1"},
+            {"kind": "failback"},
+            {"kind": "autoscale", "min_desired": 3},
+            {"kind": "sli_present", "name": "fleet_block_p99"},
+            {"kind": "sli_present",
+             "name": "fleet_replica_r0_shed_per_sec"},
+            {"kind": "slo_green", "name": "fleet_block_p99",
+             "target": 0.25},
+        ],
+    }
+
+
 def runtime_degrade(seed: int = 3) -> dict:
     """Seeded device-dispatch fault plan through the runtime engine's
     breaker: open after the failure budget, host fallback carries the
@@ -324,6 +386,7 @@ _BUILTINS = {
     "timeskew-kill": timeskew_kill,
     "verifyd-outage": verifyd_outage,
     "runtime-degrade": runtime_degrade,
+    "fleet": fleet,
 }
 
 

@@ -67,6 +67,15 @@ teardown runs through fixtures):
   unpaired breaker pins its ``remediation_breaker_*`` series forever;
   an unpaired hook lets a dead component keep receiving recovery
   actions.
+* **fleet lifecycles (ISSUE 17)** — ``FleetRouter(...)`` and
+  ``FleetVerifier(...)`` locals that are ``start()``ed follow the
+  started-must-close rule too (a leaked router pins every replica's
+  breaker and ``fleet_replica_*`` series); and
+  ``<router>.register_replica(...)`` pairs with
+  ``unregister_replica`` exactly like tenants/clients — a replica
+  that left the fleet without unregistering keeps its breaker on the
+  global registry, its per-replica series in the exposition, and its
+  clients pinned to a ghost.
 
 Suppress a deliberate unpaired site with ``# spacecheck: ok=SC004 <why>``.
 """
@@ -181,6 +190,8 @@ def check(ctx: FileContext, project: ProjectInfo) -> list[Finding]:
         c_unregisters: list[ast.Call] = []
         r_registers: list[ast.Call] = []
         r_unregisters: list[ast.Call] = []
+        f_registers: list[ast.Call] = []
+        f_unregisters: list[ast.Call] = []
         enters: dict[str, ast.Call] = {}
         exits: dict[str, list[int]] = {}
         for call in calls:
@@ -205,6 +216,10 @@ def check(ctx: FileContext, project: ProjectInfo) -> list[Finding]:
                 c_registers.append(call)
             elif func.attr == "unregister_client":
                 c_unregisters.append(call)
+            elif func.attr == "register_replica":
+                f_registers.append(call)
+            elif func.attr == "unregister_replica":
+                f_unregisters.append(call)
             elif func.attr == "__enter__" and recv and not cm_method:
                 enters[recv] = call
             elif func.attr == "__exit__" and recv:
@@ -309,6 +324,30 @@ def check(ctx: FileContext, project: ProjectInfo) -> list[Finding]:
                     "this function or its class: a disconnected client "
                     "pins its per-client series and admission state "
                     "forever"))
+        for call in f_registers:
+            if any(_in_finally(spans, u.lineno) for u in f_unregisters):
+                continue
+            if f_unregisters:
+                findings.append(ctx.finding(
+                    RULE, call,
+                    "register_replica here but the unregister_replica "
+                    "in this function is not under finally: the "
+                    "exception path pins the replica's breaker and "
+                    "per-replica fleet series"))
+                continue
+            sib = siblings.get(id(fn), [])
+            paired = any(
+                isinstance(c.func, ast.Attribute)
+                and c.func.attr == "unregister_replica"
+                for m in sib for c in _calls_in(m) if m is not fn)
+            if not paired:
+                findings.append(ctx.finding(
+                    RULE, call,
+                    "register_replica without any unregister_replica "
+                    "in this function or its class: a replica that "
+                    "left the fleet pins its breaker registration and "
+                    "fleet_replica_* series, and its clients stay "
+                    "routed to a ghost"))
         for recv, call in enters.items():
             ok = any(_in_finally(spans, ln) and ln > call.lineno
                      for ln in exits.get(recv, []))
@@ -336,7 +375,8 @@ def check(ctx: FileContext, project: ProjectInfo) -> list[Finding]:
                 cname = dotted_name(node.value.func)
                 if cname and cname.rsplit(".", 1)[-1] in (
                         "VerifydServer", "VerifydService",
-                        "RemediationEngine", "FailoverVerifier"):
+                        "RemediationEngine", "FailoverVerifier",
+                        "FleetRouter", "FleetVerifier"):
                     owners[node.targets[0].id] = node
         if not owners:
             return

@@ -605,6 +605,46 @@ failover_verify_seconds = REGISTRY.histogram(
     "(labels: path, lane)",
     buckets=(0.001, 0.005, 0.02, 0.1, 0.5, 2.0, 10.0, float("inf")))
 
+# verifyd fleet (verifyd/fleet.py + routing.py): the replica-sharded
+# service plane.  Per-replica series are REMOVED when the replica
+# unregisters from the router (remove/remove_matching — the PR-12
+# cardinality pattern), so fleet membership churn cannot grow the
+# registry without bound.
+fleet_replicas = REGISTRY.gauge(
+    "fleet_replicas", "verifyd replicas registered on the router")
+fleet_desired_replicas = REGISTRY.gauge(
+    "fleet_desired_replicas",
+    "autoscaling signal: replicas the fleet's windowed load wants")
+fleet_replica_load = REGISTRY.gauge(
+    "fleet_replica_load_score",
+    "windowed load score per replica, ~1.0 = at target (label: replica)")
+fleet_clients = REGISTRY.gauge(
+    "fleet_clients", "clients placed by the fleet router")
+fleet_requests = REGISTRY.counter(
+    "fleet_requests_total",
+    "fleet verifier batches by serving path "
+    "(labels: path=<replica>|local|local_fastfail, lane)")
+fleet_verify_seconds = REGISTRY.histogram(
+    "fleet_verify_seconds",
+    "fleet verifier batch latency by origin "
+    "(labels: path=remote|local|local_fastfail, lane)",
+    buckets=(0.001, 0.005, 0.02, 0.1, 0.5, 2.0, 10.0, float("inf")))
+fleet_replica_verify_seconds = REGISTRY.histogram(
+    "fleet_replica_verify_seconds",
+    "per-replica remote verify latency — the steal/autoscale queue-wait "
+    "signal (labels: replica, lane)",
+    buckets=(0.001, 0.005, 0.02, 0.1, 0.5, 2.0, 10.0, float("inf")))
+fleet_replica_sheds = REGISTRY.counter(
+    "fleet_replica_sheds_total",
+    "typed sheds seen per replica — the steal/autoscale pressure "
+    "signal (labels: replica, reason)")
+fleet_reroutes = REGISTRY.counter(
+    "fleet_reroutes_total",
+    "clients moved between replicas (labels: reason)")
+fleet_steals = REGISTRY.counter(
+    "fleet_steals_total",
+    "batches stolen from a hot replica (labels: src, dst)")
+
 # runtime sanitizers (utils/sanitize.py, SPACEMESH_SANITIZE=1): each
 # recorded violation — a slow event-loop callback, an off-thread
 # instrument creation, an off-bucket jit dispatch — counts here so a

@@ -12,19 +12,28 @@ batch sizing (batchtune.py) into the farm's device batchers.
 
 Layout: service.py (admission core), server.py (sockets), client.py
 (cookbook client), batchtune.py (measured batch-size model),
-protocol.py (wire codec).
+protocol.py (wire codec), routing.py (consistent-hash placement),
+fleet.py (multi-replica router/verifier), failover.py (remote→local).
 """
 
 from .batchtune import BatchTuner
 from .client import RetryPolicy, VerifydClient
 from .failover import FailoverVerifier
+from .fleet import (FleetRouter, FleetVerifier, HttpReplicaEndpoint,
+                    fleet_from_urls)
 from .protocol import ProtocolError, request_from_doc, request_to_doc
+from .routing import HashRing, Placement
 from .server import VerifydServer
 from .service import Shed, VerifydClosed, VerifydService
 
 __all__ = [
     "BatchTuner",
     "FailoverVerifier",
+    "FleetRouter",
+    "FleetVerifier",
+    "HashRing",
+    "HttpReplicaEndpoint",
+    "Placement",
     "ProtocolError",
     "RetryPolicy",
     "Shed",
@@ -32,6 +41,7 @@ __all__ = [
     "VerifydClosed",
     "VerifydServer",
     "VerifydService",
+    "fleet_from_urls",
     "request_from_doc",
     "request_to_doc",
 ]

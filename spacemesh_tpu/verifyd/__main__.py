@@ -48,7 +48,9 @@ async def serve(args) -> int:
         max_pending_items=args.max_pending,
         default_rate=args.rate, default_burst=args.burst,
         workers=args.workers, max_batch=args.max_batch,
-        post_params=_post_params(args))
+        post_params=_post_params(args),
+        genesis_id=(bytes.fromhex(args.genesis_id)
+                    if args.genesis_id is not None else None))
     stop = asyncio.Event()
     loop = asyncio.get_running_loop()
     for sig in (signal.SIGINT, signal.SIGTERM):
@@ -95,6 +97,11 @@ def main(argv=None) -> int:
     ap.add_argument("--post-pow-difficulty", default=None,
                     help="POST k2pow difficulty, 64 hex chars "
                          "(default: mainnet)")
+    ap.add_argument("--genesis-id", default=None,
+                    help="network genesis id, hex: signatures are made "
+                         "over genesis_id||domain||msg, so a replica "
+                         "must verify under its clients' network "
+                         "prefix (default: empty prefix)")
     args = ap.parse_args(argv)
     try:
         return asyncio.run(serve(args))

@@ -30,6 +30,13 @@ RETRYABLE_SHEDS = frozenset({protocol.SHED_RATE, protocol.SHED_OVERLOAD,
                              protocol.SHED_QUEUE_FULL,
                              protocol.SHED_DEADLINE})
 
+# lifecycle sheds that never clear by waiting on THIS replica but may
+# clear instantly on ANOTHER: the server attaches a ``replica_hint``
+# (Retry-After in space — docs/VERIFYD.md) and a fleet-aware client
+# hops to it instead of backing off against the dead replica.
+HOP_SHEDS = frozenset({protocol.SHED_REGISTRY_FULL,
+                       protocol.SHED_SHUTTING_DOWN})
+
 
 @dataclasses.dataclass(frozen=True)
 class RetryPolicy:
@@ -84,7 +91,7 @@ class VerifydClient:
     def __init__(self, base_url: str, client_id: str, *,
                  session=None, unregister_on_close: bool = True,
                  retry: RetryPolicy | None = RetryPolicy(),
-                 sleep=asyncio.sleep):
+                 fallback_urls=(), sleep=asyncio.sleep):
         self.base_url = base_url.rstrip("/")
         self.client_id = str(client_id)
         self._session = session
@@ -92,6 +99,8 @@ class VerifydClient:
         self._unregister_on_close = unregister_on_close
         self._registered = False
         self.retry = retry
+        self.fallback_urls = tuple(u.rstrip("/") for u in fallback_urls)
+        self._register_kwargs: dict = {}
         self._sleep = sleep
 
     async def _sess(self):
@@ -113,13 +122,15 @@ class VerifydClient:
     def _raise_typed(doc: dict) -> None:
         if doc.get("status") == "SHED":
             raise Shed(doc.get("reason", "unknown"),
-                       doc.get("detail", ""), doc.get("retry_after_s"))
+                       doc.get("detail", ""), doc.get("retry_after_s"),
+                       replica_hint=doc.get("replica_hint"))
         if doc.get("status") == "ERROR":
             raise protocol.ProtocolError(doc.get("error", "bad request"))
 
     async def register(self, **kwargs) -> dict:
         """Register this client id (weight/rate/burst/max_queued/
         max_inflight keywords forward to the server)."""
+        self._register_kwargs = dict(kwargs)
         status, doc = await self._post(
             "/v1/client/register", {"client": self.client_id, **kwargs})
         self._raise_typed(doc)
@@ -133,21 +144,64 @@ class VerifydClient:
         await self._post("/v1/client/unregister",
                          {"client": self.client_id})
 
+    def _next_replica(self, hint: str | None,
+                      tried: set[str]) -> str | None:
+        """Next untried replica URL: the server's hint first, then this
+        client's configured ring of fallbacks, each at most once."""
+        candidates = ([hint] if hint else []) + list(self.fallback_urls)
+        for url in candidates:
+            url = str(url).rstrip("/")
+            if url and url not in tried:
+                return url
+        return None
+
+    async def _hop(self, url: str) -> None:
+        """Re-home to ``url``: re-register there (same knobs as the
+        original registration) so the next verify lands registered."""
+        self.base_url = url
+        self._registered = False
+        await self.register(**self._register_kwargs)
+
     async def verify(self, reqs: list, *, lane: str = "gossip",
                      deadline_s: float | None = None) -> list[bool]:
         """Verify a batch of farm request objects; raises the server's
         typed Shed on rejection (after the retry policy's budget of
-        ``retry_after_s``-honoring backoff waits, when one is set)."""
+        ``retry_after_s``-honoring backoff waits, when one is set).
+
+        A ``registry_full``/``shutting_down`` shed carrying a
+        ``replica_hint`` (or arriving when ``fallback_urls`` names other
+        fleet replicas) does NOT back off: the client re-registers on
+        the hinted/next replica and retries immediately — waiting out a
+        replica that is full or dying is time spent toward a foregone
+        conclusion.  Each replica is hopped to at most once per call.
+        """
         attempt = 0
+        tried = {self.base_url}
         while True:
             try:
                 return await self._verify_once(reqs, lane=lane,
                                                deadline_s=deadline_s)
             except Shed as e:
+                exc = e
+                if exc.reason in HOP_SHEDS:
+                    hopped = False
+                    nxt = self._next_replica(exc.replica_hint, tried)
+                    while nxt is not None:
+                        tried.add(nxt)
+                        try:
+                            await self._hop(nxt)
+                            hopped = True
+                            break
+                        except Shed as e2:  # hop target shed us too:
+                            exc = e2        # chase ITS hint next
+                            nxt = self._next_replica(
+                                e2.replica_hint, tried)
+                    if hopped:
+                        continue    # no sleep, no attempt consumed
                 if self.retry is None \
-                        or not self.retry.should_retry(e, attempt):
-                    raise
-                await self._sleep(self.retry.delay(e, attempt))
+                        or not self.retry.should_retry(exc, attempt):
+                    raise exc
+                await self._sleep(self.retry.delay(exc, attempt))
                 attempt += 1
 
     async def _verify_once(self, reqs: list, *, lane: str,

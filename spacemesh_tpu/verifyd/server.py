@@ -230,7 +230,8 @@ class VerifydServer:
             return _shed_response(e)
         except VerifydClosed as e:
             return web.json_response(
-                Shed(protocol.SHED_SHUTTING_DOWN, str(e)).to_doc(),
+                Shed(protocol.SHED_SHUTTING_DOWN, str(e),
+                     replica_hint=self.service.replica_hint).to_doc(),
                 status=503)
 
     async def client_unregister(self, req) -> web.Response:
@@ -251,7 +252,8 @@ class VerifydServer:
             return _shed_response(e)
         except VerifydClosed as e:
             return web.json_response(
-                Shed(protocol.SHED_SHUTTING_DOWN, str(e)).to_doc(),
+                Shed(protocol.SHED_SHUTTING_DOWN, str(e),
+                     replica_hint=self.service.replica_hint).to_doc(),
                 status=503)
 
     async def stats(self, req) -> web.Response:
@@ -307,7 +309,8 @@ class VerifydServer:
         except Shed as e:
             return e.to_doc()
         except VerifydClosed as e:
-            return Shed(protocol.SHED_SHUTTING_DOWN, str(e)).to_doc()
+            return Shed(protocol.SHED_SHUTTING_DOWN, str(e),
+                        replica_hint=self.service.replica_hint).to_doc()
 
     async def _grpc_unregister(self, doc: dict) -> dict:
         cid = doc.get("client")
@@ -324,7 +327,8 @@ class VerifydServer:
         except Shed as e:
             return e.to_doc()
         except VerifydClosed as e:
-            return Shed(protocol.SHED_SHUTTING_DOWN, str(e)).to_doc()
+            return Shed(protocol.SHED_SHUTTING_DOWN, str(e),
+                        replica_hint=self.service.replica_hint).to_doc()
 
     async def _grpc_stats(self, doc: dict) -> dict:
         del doc

@@ -43,6 +43,7 @@ from __future__ import annotations
 import asyncio
 import time
 
+from ..core.signing import EdVerifier
 from ..runtime.scheduler import (
     QuotaExceeded,
     SchedulerClosed,
@@ -72,23 +73,33 @@ class Shed(Exception):
     """Typed admission rejection (protocol.SHED_* reasons).
 
     Carries everything a well-behaved client needs to react: the
-    ``reason``, a human ``detail``, and ``retry_after_s`` when the
-    condition is known to clear (token refill).  The server surfaces it
-    as a structured response body, the client library raises it — a
-    shed is an ANSWER, never a dropped connection.
+    ``reason``, a human ``detail``, ``retry_after_s`` when the
+    condition is known to clear (token refill), and — for the
+    lifecycle sheds a WAIT cannot clear (``registry_full``,
+    ``shutting_down``) — an optional ``replica_hint``: the Retry-After
+    analog in SPACE instead of time, naming a fleet peer worth trying
+    instead of backing off against a full or draining replica.  The
+    server surfaces it as a structured response body, the client
+    library raises it — a shed is an ANSWER, never a dropped
+    connection.
     """
 
     def __init__(self, reason: str, detail: str = "",
-                 retry_after_s: float | None = None):
+                 retry_after_s: float | None = None,
+                 replica_hint: str | None = None):
         super().__init__(f"{reason}: {detail}" if detail else reason)
         self.reason = reason
         self.detail = detail
         self.retry_after_s = retry_after_s
+        self.replica_hint = replica_hint
 
     def to_doc(self) -> dict:
-        return {"status": "SHED", "reason": self.reason,
-                "detail": self.detail,
-                "retry_after_s": self.retry_after_s}
+        doc = {"status": "SHED", "reason": self.reason,
+               "detail": self.detail,
+               "retry_after_s": self.retry_after_s}
+        if self.replica_hint is not None:
+            doc["replica_hint"] = self.replica_hint
+        return doc
 
 
 class _TokenBucket:
@@ -151,10 +162,23 @@ class VerifydService:
                  default_max_inflight: int = 4,
                  max_batch: int = 256,
                  post_params=None, post_seed: bytes | None = None,
+                 genesis_id: bytes | None = None,
                  stall_deadline_s: float = 30.0,
                  drain_timeout_s: float = 60.0,
+                 shard: str = "",
+                 replica_hint: str | None = None,
                  time_source=time.monotonic):
         self._now = time_source
+        # fleet shard name (verifyd/fleet.py): namespaces this
+        # replica's tenant ids, per-client metric series, watchdog and
+        # remediation hook, so N replicas can share one process — and
+        # one registry, and one device scheduler — without colliding
+        self.shard = str(shard)
+        self._component = f"verifyd.{self.shard}" if self.shard \
+            else "verifyd"
+        # a fleet peer worth trying when THIS replica is full or
+        # draining; rides in registry_full/shutting_down shed docs
+        self.replica_hint = replica_hint
         self.max_clients = max(int(max_clients), 1)
         self.max_pending_items = max(int(max_pending_items), 1)
         self._default_rate = float(default_rate)
@@ -163,7 +187,16 @@ class VerifydService:
         self.tuner = tuner if tuner is not None else batchtune.BatchTuner(
             max_batch=max_batch)
         self._own_farm = farm is None
+        # genesis_id is a CONSENSUS parameter like the POST params: the
+        # node signs ``genesis_id || domain || msg``, so a replica that
+        # verifies with a different prefix fails every honest signature
+        if genesis_id is not None and farm is not None:
+            raise ValueError("genesis_id only configures the service's "
+                             "own farm; set ed_verifier on the injected "
+                             "farm instead")
         self.farm = farm if farm is not None else VerificationFarm(
+            ed_verifier=(None if genesis_id is None
+                         else EdVerifier(prefix=bytes(genesis_id))),
             post_params=post_params, post_seed=post_seed,
             max_batch=max_batch, stall_deadline_s=stall_deadline_s,
             tuner=self.tuner)
@@ -177,6 +210,11 @@ class VerifydService:
                             default_max_queued=default_max_queued,
                             default_max_inflight=default_max_inflight,
                             time_source=time_source)
+        if self.shard:
+            # shard-namespaced tenant ids (runtime/scheduler.py
+            # ShardScheduler): fleet replicas sharing one device
+            # runtime must not collide on client identity
+            self.scheduler = self.scheduler.namespaced(self.shard)
         # client table + pending counters are LOOP-ONLY by contract:
         # admission runs on the event loop, scheduler quanta only touch
         # the farm (no lock needed; the sim scenario and tests drive one
@@ -196,7 +234,7 @@ class VerifydService:
         # resolved counter must advance within the deadline — a wedged
         # farm backend or dead scheduler worker shows on /readyz
         self._watchdog = health_mod.Watchdog(
-            "verifyd",
+            self._component,
             progress=lambda: self.stats["resolved_items"],
             active=lambda: self._pending_items > 0,
             deadline_s=stall_deadline_s)
@@ -210,12 +248,13 @@ class VerifydService:
         from ..obs import health as health_mod
         from ..obs import remediate as remediate_mod
 
-        health_mod.HEALTH.register("verifyd", self._watchdog.check)
+        health_mod.HEALTH.register(self._component, self._watchdog.check)
         # recovery hook beside the watchdog (obs/remediate.py): a
         # wedged-drain verdict resets the farm's lanes — stuck client
         # requests fail typed and re-submit instead of pinning the
         # service until an operator restart
-        remediate_mod.ACTIONS.register("verifyd", "reset_farm_lanes",
+        remediate_mod.ACTIONS.register(self._component,
+                                       "reset_farm_lanes",
                                        self.farm.reset_lanes)
         await asyncio.to_thread(self.tuner.ensure_raced)
 
@@ -239,11 +278,38 @@ class VerifydService:
             from ..obs import health as health_mod
             from ..obs import remediate as remediate_mod
 
-            health_mod.HEALTH.unregister("verifyd", self._watchdog.check)
+            health_mod.HEALTH.unregister(self._component,
+                                         self._watchdog.check)
             remediate_mod.ACTIONS.unregister(
-                "verifyd", "reset_farm_lanes", self.farm.reset_lanes)
+                self._component, "reset_farm_lanes",
+                self.farm.reset_lanes)
+            if self.shard:
+                # this shard's service-level gauge series go with it
+                metrics.verifyd_clients.remove(shard=self.shard)
+                metrics.verifyd_pending.remove(shard=self.shard)
 
     # -- clients --------------------------------------------------------
+
+    def _mcid(self, cid: str) -> str:
+        """The client's metric-label identity: shard-namespaced so a
+        client re-routed between fleet replicas in one process never
+        shares (or clobbers) series across shards — and the OLD shard's
+        unregister_client drops exactly its own series."""
+        return f"{self.shard}/{cid}" if self.shard else cid
+
+    def _gauge_clients(self) -> None:
+        if self.shard:
+            metrics.verifyd_clients.set(len(self.clients),
+                                        shard=self.shard)
+        else:
+            metrics.verifyd_clients.set(len(self.clients))
+
+    def _gauge_pending(self) -> None:
+        if self.shard:
+            metrics.verifyd_pending.set(self._pending_items,
+                                        shard=self.shard)
+        else:
+            metrics.verifyd_pending.set(self._pending_items)
 
     def register_client(self, cid: str, *, weight: float | None = None,
                         rate: float | None = None,
@@ -262,11 +328,12 @@ class VerifydService:
         now = self._now()
         if c is None:
             if len(self.clients) >= self.max_clients:
-                metrics.verifyd_shed.inc(client="-",
+                metrics.verifyd_shed.inc(client=self._mcid("-"),
                                          reason=protocol.SHED_REGISTRY_FULL)
                 raise Shed(protocol.SHED_REGISTRY_FULL,
                            f"{len(self.clients)} clients registered "
-                           f">= max_clients {self.max_clients}")
+                           f">= max_clients {self.max_clients}",
+                           replica_hint=self.replica_hint)
             self.scheduler.register_tenant(
                 cid, weight=weight if weight is not None else 1.0,
                 max_queued=max_queued, max_inflight=max_inflight)
@@ -276,7 +343,7 @@ class VerifydService:
                              else self._default_rate,
                              burst if burst is not None
                              else self._default_burst, now), now)
-            metrics.verifyd_clients.set(len(self.clients))
+            self._gauge_clients()
             self.stats["clients_peak"] = max(self.stats["clients_peak"],
                                              len(self.clients))
         else:
@@ -306,11 +373,12 @@ class VerifydService:
         if c is None:
             return False
         self.scheduler.unregister_tenant(c.id)
-        metrics.verifyd_clients.set(len(self.clients))
-        metrics.verifyd_client_pending.remove(client=c.id)
+        self._gauge_clients()
+        mcid = self._mcid(c.id)
+        metrics.verifyd_client_pending.remove(client=mcid)
         for inst in (metrics.verifyd_requests, metrics.verifyd_items,
                      metrics.verifyd_shed):
-            inst.remove_matching(client=c.id)
+            inst.remove_matching(client=mcid)
         return True
 
     # -- admission ------------------------------------------------------
@@ -321,11 +389,13 @@ class VerifydService:
         if c is not None:
             c.shed += 1
         self.stats["shed"][reason] = self.stats["shed"].get(reason, 0) + 1
-        metrics.verifyd_shed.inc(client=cid if c is not None else "-",
-                                 reason=reason)
-        metrics.verifyd_requests.inc(client=cid if c is not None else "-",
-                                     outcome="shed")
-        raise Shed(reason, detail, retry_after_s)
+        mcid = self._mcid(cid if c is not None else "-")
+        metrics.verifyd_shed.inc(client=mcid, reason=reason)
+        metrics.verifyd_requests.inc(client=mcid, outcome="shed")
+        hint = self.replica_hint if reason in (
+            protocol.SHED_SHUTTING_DOWN,
+            protocol.SHED_REGISTRY_FULL) else None
+        raise Shed(reason, detail, retry_after_s, replica_hint=hint)
 
     def estimated_wait_s(self) -> float:
         """Predicted queue wait for a newly admitted item: the pending
@@ -352,7 +422,8 @@ class VerifydService:
             self._shed(None, cid, protocol.SHED_UNREGISTERED,
                        f"client {cid!r} is not registered")
         if not reqs:
-            metrics.verifyd_requests.inc(client=cid, outcome="ok")
+            metrics.verifyd_requests.inc(client=self._mcid(cid),
+                                         outcome="ok")
             return []
         lane = Lane(lane)
         n = len(reqs)
@@ -424,8 +495,9 @@ class VerifydService:
             self.stats["admitted_items"] += n
             self.stats["pending_peak"] = max(self.stats["pending_peak"],
                                              self._pending_items)
-            metrics.verifyd_pending.set(self._pending_items)
-            metrics.verifyd_client_pending.set(c.pending, client=cid)
+            self._gauge_pending()
+            metrics.verifyd_client_pending.set(c.pending,
+                                               client=self._mcid(cid))
             t0 = self._now()
             settled = False
 
@@ -447,12 +519,12 @@ class VerifydService:
                     rate = n / dt
                     self._rate_ewma = rate if self._rate_ewma <= 0 else (
                         0.2 * rate + 0.8 * self._rate_ewma)
-                metrics.verifyd_pending.set(self._pending_items)
+                self._gauge_pending()
                 live = self.clients.get(cid)
                 if live is c:
                     c.pending -= n
-                    metrics.verifyd_client_pending.set(c.pending,
-                                                       client=cid)
+                    metrics.verifyd_client_pending.set(
+                        c.pending, client=self._mcid(cid))
 
             try:
                 verdicts = await asyncio.wrap_future(handle.future)
@@ -476,12 +548,14 @@ class VerifydService:
             settle()
             metrics.verifyd_request_seconds.observe(
                 max(self._now() - t0, 0.0), lane=lane.name.lower())
-            metrics.verifyd_requests.inc(client=cid, outcome="ok")
+            metrics.verifyd_requests.inc(client=self._mcid(cid),
+                                         outcome="ok")
             kinds: dict[str, int] = {}
             for r in reqs:
                 kinds[r.kind] = kinds.get(r.kind, 0) + 1
             for kind, count in kinds.items():
-                metrics.verifyd_items.inc(count, client=cid, kind=kind)
+                metrics.verifyd_items.inc(count, client=self._mcid(cid),
+                                          kind=kind)
             return verdicts
 
     async def _drain_into_farm(self, reqs: list, lane: Lane,
@@ -502,6 +576,7 @@ class VerifydService:
 
     def stats_doc(self) -> dict:
         return {
+            "shard": self.shard,
             "clients": len(self.clients),
             "max_clients": self.max_clients,
             "pending_items": self._pending_items,

@@ -129,3 +129,33 @@ def test_runtime_degrade_bounds_device_attempts():
         "breaker never stopped the per-batch re-pay"
     assert rt["fallbacks"] >= fault_span
     assert rt["breaker"]["state"] == "closed"
+
+
+# --- fleet scenario (ISSUE 17, sim/fleet.py) -----------------------------
+
+
+def test_fleet_drill_survives_chaos_and_replays_identically():
+    """The fleet acceptance drill: sharded admission to the fleet-wide
+    bound, registry_full re-routing, work stealing off the hot replica,
+    a replica kill with bounded corpse attempts and survivor serving,
+    a full blackout served locally with zero verdict divergence, remote
+    failback, the autoscaling signal reacting — twice, byte-identical
+    digests."""
+    from spacemesh_tpu.sim.fleet import run_scenario as run_fleet
+
+    a = run_fleet(builtin("fleet"))
+    b = run_fleet(builtin("fleet"))
+    assert a.ok, [x for x in a.asserts if not x["ok"]]
+    assert b.ok
+    assert a.digest == b.digest
+    kinds = {x["kind"]: x for x in a.asserts}
+    for k in ("no_wrong_verdicts", "typed_sheds_only", "fleet_bound",
+              "reroutes", "steals", "blackout_local",
+              "dead_replica_attempts_bounded", "breaker_sequence",
+              "failback", "autoscale", "slo_green"):
+        assert kinds[k]["ok"], kinds[k]
+    # the kill, the blackout and both breaker edges are digest-recorded
+    assert any(e.get("fault") == "kill_replica" for e in a.events)
+    assert any(e.get("fault") == "blackout" for e in a.events)
+    assert any(e.get("breaker") == "open" for e in a.events)
+    assert any(e.get("breaker") == "closed" for e in a.events)

@@ -576,6 +576,73 @@ def test_sc004_remediation_engine_start_close_pairing(tmp_path):
     assert fs[0].line == 7  # anchored at the start() call
 
 
+def test_sc004_fleet_started_must_close(tmp_path):
+    """ISSUE 17 fleet lifecycles: a started FleetRouter/FleetVerifier
+    needs a finally-paired close (or must escape) — a leaked router
+    pins every replica's breaker and fleet_replica_* series."""
+    fs = run_fixture(tmp_path, "spacemesh_tpu/tools/fleet_cli.py", """
+        from ..verifyd.fleet import FleetRouter, FleetVerifier
+
+        async def bad(farm):
+            router = FleetRouter(seed=1)
+            router.start()
+            await router.serve_forever()
+
+        async def good(farm):
+            fv = FleetVerifier(router=make_router(), farm=farm,
+                               own_router=True)
+            try:
+                fv.start()
+                await fv.serve_forever()
+            finally:
+                await fv.aclose()
+
+        async def escapes(farm):
+            router = FleetRouter(seed=1)
+            router.start()
+            return router   # caller owns the lifecycle now
+    """, select="SC004")
+    assert len(fs) == 1 and "finally-paired close" in fs[0].message
+    assert fs[0].line == 6  # anchored at bad()'s start() call
+
+
+def test_sc004_register_replica_pairing(tmp_path):
+    """register_replica pairs with unregister_replica (finally or the
+    class split), exactly like tenants and clients: a replica that left
+    the fleet must not pin its breaker and per-replica series."""
+    fs = run_fixture(tmp_path, "spacemesh_tpu/verifyd/fleet_ops.py", """
+        def bad(router, endpoint):
+            router.register_replica("r9", endpoint)
+            drive(router)
+
+        def good_finally(router, endpoint):
+            router.register_replica("r9", endpoint)
+            try:
+                drive(router)
+            finally:
+                router.unregister_replica("r9")
+
+        class Pool:
+            def attach(self, name, endpoint):
+                self.router.register_replica(name, endpoint)
+
+            def detach(self, name):
+                self.router.unregister_replica(name)
+    """, select="SC004")
+    assert len(fs) == 1 and "register_replica" in fs[0].message
+    assert fs[0].line == 3  # the bad() register call
+
+
+def test_sc004_register_replica_unpaired_off_finally(tmp_path):
+    fs = run_fixture(tmp_path, "spacemesh_tpu/verifyd/fleet_leak.py", """
+        def run(router, endpoint):
+            router.register_replica("r9", endpoint)
+            drive(router)   # raises -> unregister skipped
+            router.unregister_replica("r9")
+    """, select="SC004")
+    assert len(fs) == 1 and "not under finally" in fs[0].message
+
+
 # --- SC005 metrics hygiene ----------------------------------------------
 
 

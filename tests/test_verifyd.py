@@ -21,7 +21,8 @@ from spacemesh_tpu.obs import health as health_mod
 from spacemesh_tpu.obs import sli as sli_mod
 from spacemesh_tpu.utils import metrics, tracing
 from spacemesh_tpu.verify import workload
-from spacemesh_tpu.verify.farm import Lane, PowRequest, SigRequest
+from spacemesh_tpu.verify.farm import (
+    Lane, PowRequest, SigRequest, VerificationFarm)
 from spacemesh_tpu.verifyd import (
     Shed,
     VerifydClient,
@@ -129,6 +130,32 @@ def test_empty_and_unregistered(wl):
             await svc.aclose()
 
     _run(go())
+
+
+def test_genesis_id_prefixes_the_service_verifier():
+    """genesis_id is a consensus parameter: nodes sign
+    genesis_id||domain||msg, so a replica verifying under a different
+    prefix fails every honest signature (the --genesis-id CLI flag)."""
+    from spacemesh_tpu.core.signing import Domain, EdSigner
+
+    gid = b"e2e-genesis-id"
+    signer = EdSigner(seed=b"\x07" * 32, prefix=gid)
+    msg = b"prefixed"
+    req = SigRequest(int(Domain.HARE), signer.public_key, msg,
+                     signer.sign(Domain.HARE, msg))
+
+    async def go(svc):
+        try:
+            await svc.start()
+            svc.register_client("a")
+            return (await svc.verify("a", [req]))[0]
+        finally:
+            await svc.aclose()
+
+    assert _run(go(VerifydService(workers=1, genesis_id=gid))) is True
+    assert _run(go(VerifydService(workers=1))) is False
+    with pytest.raises(ValueError):
+        VerifydService(farm=VerificationFarm(), genesis_id=gid)
 
 
 # --- typed admission -----------------------------------------------------
