@@ -84,6 +84,13 @@ identical to inline verification before any rate is reported:
   {"metric": "verifyd_proofs_per_sec", "value": N, "unit": "items/s",
    "p99_ms": N, "serial": N, "vs_serial": N, "bit_identical": true}
 
+Last, the SIM FABRIC headline (ISSUE 18): the 514-node pure-fabric
+``storm-512-bench`` scenario on the event-wheel hub (twice, replay
+determinism) and on the legacy task-per-node hub, scenario digests
+asserted identical across all three runs before any rate is reported:
+  {"metric": "sim_fabric_events_per_sec", "value": N, "unit": "events/s",
+   "legacy": N, "vs_legacy": N, "bit_identical": true}
+
 Env knobs: BENCH_BATCH (label lanes per program), BENCH_N (scrypt N),
 BENCH_REPS, BENCH_CPU_LABELS, BENCH_VERIFY_ITEMS (0 disables the verify
 bench), BENCH_PROVE_LABELS (store size; 0 disables the prove bench),
@@ -100,6 +107,8 @@ slices when the host has one per replica, and MIN_SPEEDUP enforces the
 BENCH_MESH (0 disables the mesh line AND pins the
 multi-tenant bench in-process single-device), BENCH_MESH_TIMEOUT /
 BENCH_MT_TIMEOUT (probe subprocess seconds, default 1800),
+BENCH_SIM_FABRIC (0/off disables the sim fabric line) /
+BENCH_SIM_FABRIC_TIMEOUT (per-run subprocess seconds, default 600),
 SPACEMESH_JAX_CACHE (cache dir, `off` to disable), plus the kernel
 overrides SPACEMESH_ROMIX / SPACEMESH_ROMIX_CHUNK /
 SPACEMESH_ROMIX_AUTOTUNE / SPACEMESH_MESH (docs/ROMIX_KERNEL.md).
@@ -948,6 +957,118 @@ def fleet_bench(total_items: int) -> None:
         sys.exit(1)
 
 
+# Child body for one fabric measurement. A subprocess per run because
+# the fabric is chosen at hub-construction time from the environment and
+# because each run must start from a cold loop/registry — measuring both
+# fabrics in one process would let the first run's compiled/warmed state
+# (and its metric registry) bleed into the second.
+_SIM_FABRIC_SRC = """\
+import json, pathlib, sys, tempfile, time
+
+from spacemesh_tpu.sim import builtin
+from spacemesh_tpu.sim.scenario import run_scenario
+
+with tempfile.TemporaryDirectory() as d:
+    t0 = time.perf_counter()
+    r = run_scenario(builtin("storm-512-bench"), tmp=pathlib.Path(d))
+    wall = time.perf_counter() - t0
+hub = r.stats["hub"]
+print(json.dumps({
+    "ok": r.ok, "digest": r.digest, "sim_wall": round(wall, 3),
+    "delivered": hub["delivered"], "relayed": hub["relayed"]}))
+"""
+
+
+def sim_fabric_bench() -> None:
+    """Event-wheel scenario fabric vs the legacy task-per-node hub.
+
+    Runs the 514-node ``storm-512-bench`` scenario (sim/scenarios.py: a
+    pure-fabric shape — smeshing and tracing off, sparse heartbeats, a
+    long quiet tail — so the measurement is the hub's idle+relay cost,
+    not the shared consensus/crypto floor) once per fabric in fresh
+    subprocesses: the event fabric twice (replay determinism) and the
+    legacy hub once.  The scenario digest — the full consensus/coverage
+    event record — must be IDENTICAL across all three runs before any
+    rate is reported; a divergent world means the fabrics delivered
+    different messages and the ratio would be fiction:
+      {"metric": "sim_fabric_events_per_sec", "value": N,
+       "unit": "events/s", "vs_legacy": N, "bit_identical": true}
+    The rate counts useful deliveries (frames handed to a subscriber)
+    per wall second; both fabrics deliver the same world, so vs_legacy
+    is a pure cost ratio, not a throughput-shape artifact.
+    """
+    timeout = int(os.environ.get("BENCH_SIM_FABRIC_TIMEOUT", 600))
+    log(f"sim fabric: storm-512-bench on both fabrics "
+        f"(subprocess runs, <= {timeout}s each) ...")
+
+    def run_one(fabric: str, tag: str) -> dict | None:
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   SPACEMESH_ROMIX_AUTOTUNE="off",
+                   SPACEMESH_SIM_FABRIC=fabric)
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c", _SIM_FABRIC_SRC], env=env,
+                timeout=timeout, capture_output=True, text=True)
+        except subprocess.TimeoutExpired:
+            log(f"sim fabric: {tag} timed out (> {timeout}s)")
+            return None
+        if r.returncode != 0:
+            log(f"sim fabric: {tag} failed (rc={r.returncode})")
+            sys.stderr.write(r.stderr)
+            return None
+        doc = None
+        for line in reversed(r.stdout.strip().splitlines()):
+            try:
+                doc = json.loads(line)
+                break
+            except ValueError:
+                continue
+        if not isinstance(doc, dict) or not doc.get("ok"):
+            log(f"sim fabric: {tag} scenario asserts failed")
+            return None
+        log(f"sim fabric: {tag}: {doc['sim_wall']:.2f}s, "
+            f"{doc['delivered']} delivered, {doc['relayed']} relayed, "
+            f"digest {doc['digest'][:16]}")
+        return doc
+
+    new1 = run_one("", "event #1")
+    new2 = run_one("", "event #2")
+    leg = run_one("legacy", "legacy")
+    if new1 is None or new2 is None or leg is None:
+        log("sim fabric: FAILED — a measurement run did not complete")
+        sys.exit(1)
+    if new1["digest"] != new2["digest"]:
+        log(f"sim fabric: FAILED — event fabric replay diverged "
+            f"({new1['digest'][:16]} vs {new2['digest'][:16]})")
+        sys.exit(1)
+    if new1["digest"] != leg["digest"]:
+        log(f"sim fabric: FAILED — event vs legacy digests diverged "
+            f"({new1['digest'][:16]} vs {leg['digest'][:16]})")
+        sys.exit(1)
+
+    wall_new = min(new1["sim_wall"], new2["sim_wall"])
+    rate_new = new1["delivered"] / wall_new
+    rate_leg = leg["delivered"] / leg["sim_wall"]
+    ratio = rate_new / rate_leg
+    log(f"sim fabric: event {wall_new:.2f}s ({rate_new:,.0f} events/s), "
+        f"legacy {leg['sim_wall']:.2f}s ({rate_leg:,.0f} events/s, "
+        f"{ratio:.2f}x)")
+    print(json.dumps({
+        "metric": "sim_fabric_events_per_sec",
+        "value": round(rate_new, 1),
+        "unit": "events/s",
+        "legacy": round(rate_leg, 1),
+        "vs_legacy": round(ratio, 2),
+        "delivered": new1["delivered"],
+        "relayed": new1["relayed"],
+        "event_wall_s": round(wall_new, 2),
+        "legacy_wall_s": round(leg["sim_wall"], 2),
+        "bit_identical": True,  # all three digests checked identical
+        #                         above; a mismatch exits non-zero
+        #                         before this line
+    }))
+
+
 def main() -> None:
     n = int(os.environ.get("BENCH_N", 8192))
     reps = int(os.environ.get("BENCH_REPS", 3))
@@ -1153,6 +1274,9 @@ def main() -> None:
     fleet_items = int(os.environ.get("BENCH_FLEET_ITEMS", 384))
     if fleet_items > 0:
         fleet_bench(fleet_items)
+
+    if os.environ.get("BENCH_SIM_FABRIC", "1") not in ("0", "off"):
+        sim_fabric_bench()
 
 
 if __name__ == "__main__":

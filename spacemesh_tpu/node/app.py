@@ -1325,7 +1325,14 @@ class App:
             epoch = cfg.epoch_of(layer)
             if epoch not in seen_epochs:
                 seen_epochs.add(epoch)
-                asyncio.ensure_future(self._epoch_start(epoch))
+                # tracked so close()/kill cancels it — an untracked epoch
+                # task outliving state.close() would block forever on the
+                # drained read pool
+                et = asyncio.ensure_future(self._epoch_start(epoch))
+                self._tasks.append(et)
+                et.add_done_callback(
+                    lambda t: self._tasks.remove(t) if t in self._tasks
+                    else None)
             # hare sessions run CONCURRENTLY with the layer loop — the
             # graded protocol's 8-round iterations legitimately outlive a
             # layer (reference runs per-layer sessions the same way);
@@ -1382,6 +1389,12 @@ class App:
         for t in self._hare_tasks.values():
             t.cancel()
         self._hare_tasks.clear()
+        # epoch-start/background futures must die WITH the stores: one
+        # surviving get_beacon() against a closed Database blocks its
+        # caller forever on the drained reader pool
+        for t in self._tasks:
+            t.cancel()
+        self._tasks.clear()
         self.remediation.close()
         if self.failover_verifier is not None:
             self.failover_verifier.shutdown()

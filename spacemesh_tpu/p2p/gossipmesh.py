@@ -20,6 +20,7 @@ wire format of data frames is unchanged.
 
 from __future__ import annotations
 
+import hashlib
 import random
 import struct
 
@@ -42,6 +43,18 @@ def mark_seen(seen: dict, msg_id: bytes, cap: int = SEEN_CAP) -> bool:
         for key in list(seen)[:cap // 4]:
             del seen[key]
     return True
+
+
+def relay_sample(topic: str, name: bytes, peers, k: int) -> tuple:
+    """Deterministic sparse relay set for a light relay: the first ``k``
+    of ``peers`` ranked by sha256(topic || name || peer). Every (topic,
+    node) pair gets a different but cross-process-stable subset, so the
+    union of relay edges forms a connected expander over the topology
+    without any node running the gossipsub control plane."""
+    tb = topic.encode()
+    ranked = sorted(peers,
+                    key=lambda p: hashlib.sha256(tb + name + p).digest())
+    return tuple(ranked[:k])
 
 
 def encode_ctrl(subtype: int, topic: str, ids: list[bytes] = ()) -> bytes:
@@ -88,6 +101,12 @@ class MessageCache:
     def get(self, msg_id: bytes) -> bytes | None:
         entry = self._frames.get(msg_id)
         return entry[1] if entry else None
+
+    def empty(self) -> bool:
+        """True once every frame AND every window round has aged out —
+        the hub's dirty-set heartbeat uses this to retire quiet nodes
+        (an empty cache has no IHAVE left to advertise)."""
+        return not self._frames and not any(self._window)
 
     def shift(self) -> None:
         """One heartbeat passed: rotate the IHAVE window."""

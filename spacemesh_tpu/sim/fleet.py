@@ -99,6 +99,7 @@ class _ReplicaTransport:
     def __init__(self, service: VerifydService):
         self.service = service
         self.down = False
+        self.byzantine = False   # answer with flipped (wrong) verdicts
         self.calls = 0
         self.calls_down = 0
 
@@ -125,9 +126,15 @@ class _ReplicaTransport:
                      lane: str = "gossip",
                      deadline_s: float | None = None) -> list[bool]:
         self._gate()
-        return await self.service.verify(
+        verdicts = await self.service.verify(
             str(client), reqs, lane=protocol.parse_lane(lane),
             deadline_s=deadline_s)
+        if self.byzantine:
+            # a stale/hostile replica: transport healthy, admission
+            # healthy, every verdict wrong — only a verdict-level audit
+            # can catch this failure mode
+            return [not v for v in verdicts]
+        return verdicts
 
     async def aclose(self) -> None:
         return None
@@ -146,6 +153,7 @@ async def _run(script: dict, pools: dict, clock: _VClock, events: list,
     faults = dict(script.get("faults") or {})
     kill = dict(faults.get("kill") or {})
     blackout = dict(faults.get("blackout") or {})
+    byzantine = dict(faults.get("byzantine") or {})
     ccfg = dict(script.get("clients") or {})
 
     services: dict[str, VerifydService] = {}
@@ -191,10 +199,16 @@ async def _run(script: dict, pools: dict, clock: _VClock, events: list,
     def observer(kind: str, **kw) -> None:
         if kind == "served":
             holder.update(kw)
+        elif kind == "audit_divergence":
+            events.append({"audit_divergence": str(kw.get("replica")),
+                           "index": int(kw.get("index", 0)),
+                           "t": round(clock.now(), 6)})
 
+    audit = dict(script.get("audit") or {})
     fv = FleetVerifier(router=router, farm=local_farm,
                        own_router=True, observer=observer,
-                       time_source=clock.now)
+                       time_source=clock.now,
+                       audit_k=int(audit.get("items", 0)))
     sampler = sli_mod.SliSampler(metrics.REGISTRY, window_s=3600.0)
     replica_names = sorted(services)
     sli_specs = sli_mod.fleet_slis(replica_names)
@@ -240,6 +254,16 @@ async def _run(script: dict, pools: dict, clock: _VClock, events: list,
                 for name, t in transports.items():
                     t.down = False
                 events.append({"fault": "restore_all", "wave": wave})
+            if wave == int(byzantine.get("wave", -1)):
+                transports[str(byzantine["replica"])].byzantine = True
+                events.append({"fault": "byzantine_replica",
+                               "replica": str(byzantine["replica"]),
+                               "wave": wave})
+            if wave == int(byzantine.get("restore_wave", -1)):
+                transports[str(byzantine["replica"])].byzantine = False
+                events.append({"fault": "restore_byzantine",
+                               "replica": str(byzantine["replica"]),
+                               "wave": wave})
 
             active = list(pinned)
             for cid in rng.sample(placed, active_n):
@@ -376,6 +400,17 @@ def _evaluate(script: dict, events: list, stats: dict,
                                            for e in tail)
             ent["detail"] = (f"wave {last_wave}: "
                              f"{sorted({e['path'] for e in tail})}")
+        elif kind == "byzantine_detected":
+            byz = dict(faults.get("byzantine") or {})
+            name = str(spec.get("replica", byz.get("replica")))
+            n = sum(1 for e in events
+                    if e.get("audit_divergence") == name)
+            stray = sum(1 for e in events
+                        if "audit_divergence" in e
+                        and e["audit_divergence"] != name)
+            ent["ok"] = n >= int(spec.get("min", 1)) and stray == 0
+            ent["detail"] = (f"{n} divergences on {name}, "
+                             f"{stray} on honest replicas")
         elif kind == "breaker_sequence":
             name = str(spec.get("replica", kill.get("replica")))
             seq = [t for r, t in transitions if r == name]

@@ -58,11 +58,14 @@ class LightNode:
             return True
 
         self.pubsub.register(STORM_TOPIC, on_storm)
-        hub.join(self.pubsub)
+        # light=True: on the event fabric the node runs no gossipsub
+        # control plane, just the sparse relay set (legacy hub ignores it)
+        hub.join(self.pubsub, light=True)
 
 
 def _full_config(data_dir: pathlib.Path, *, layer_sec: float, lpe: int,
-                 num_identities: int, hdist: int = 4):
+                 num_identities: int, hdist: int = 4,
+                 smeshing: bool = True):
     return load("standalone", overrides={
         "data_dir": str(data_dir),
         "layer_duration": layer_sec,
@@ -72,7 +75,7 @@ def _full_config(data_dir: pathlib.Path, *, layer_sec: float, lpe: int,
         "post": {"labels_per_unit": 256, "scrypt_n": 2, "k1": 64, "k2": 8,
                  "k3": 4, "min_num_units": 1,
                  "pow_difficulty": "20" + "ff" * 31},
-        "smeshing": {"start": True, "num_units": 1, "init_batch": 128,
+        "smeshing": {"start": smeshing, "num_units": 1, "init_batch": 128,
                      "num_identities": num_identities},
         "hare": {"committee_size": 20, "round_duration": 0.2,
                  "preround_delay": 0.5, "iteration_limit": 2},
@@ -87,7 +90,8 @@ class FullNode:
     def __init__(self, seed: int, index: int, *, tmp: pathlib.Path,
                  hub: MeshHub, simnet: SimNet,
                  loop_time: Callable[[], float],
-                 layer_sec: float, lpe: int, num_identities: int = 1):
+                 layer_sec: float, lpe: int, num_identities: int = 1,
+                 smeshing: bool = True):
         self.index = index
         self.seed = seed
         self.layer_sec = layer_sec
@@ -95,7 +99,8 @@ class FullNode:
         self._loop_time = loop_time
         self.alive = True
         cfg = _full_config(tmp / f"full{index:03d}", layer_sec=layer_sec,
-                           lpe=lpe, num_identities=num_identities)
+                           lpe=lpe, num_identities=num_identities,
+                           smeshing=smeshing)
         # deterministic identities (the reference pins test keys the
         # same way): every VRF roll — eligibility, leaders, weak coins —
         # replays identically from the scenario seed
@@ -111,6 +116,7 @@ class FullNode:
             signers.append(s)
         self.signer = signers[0]
         self.name = self.signer.node_id
+        self._cfg = cfg
         self.pubsub = PubSub(node_name=self.name)
         hub.join(self.pubsub)
         self.hub = hub
@@ -162,6 +168,28 @@ class FullNode:
         for t in self.app._tasks:
             t.cancel()
         self.close()
+
+    async def restart(self, until_layer: int, *,
+                      sync_interval: float = 2.0) -> None:
+        """Crash recovery: rebuild the App over the surviving on-disk
+        stores (the PR-13 faultfs recovery path), rejoin the fabric,
+        and resume consensus. A FRESH PubSub is built — register()
+        appends, so reusing the crashed App's handler table would
+        double-deliver every topic."""
+        assert not self.alive, "restart() follows kill()"
+        self._closed = False
+        self.pubsub = PubSub(node_name=self.name)
+        self.hub.join(self.pubsub)
+        self.app = App(self._cfg, signer=self.signer, pubsub=self.pubsub,
+                       time_source=self._time)
+        self.app.health_engine.close()
+        self.app.connect_network(self.simnet)
+        await self.app.prepare()
+        self.app.clock = clock_mod.LayerClock(
+            self.genesis, self.layer_sec, time_source=self._time)
+        self.alive = True
+        self.hub.resume(self.name)
+        self.start(until_layer, sync_interval=sync_interval)
 
     async def stop(self) -> None:
         """Graceful stop: cancel the run loop, close the app."""

@@ -181,7 +181,8 @@ class ScenarioEngine:
                 self.seed, i, tmp=self.tmp, hub=self.hub,
                 simnet=self.simnet, loop_time=self.loop.time,
                 layer_sec=self.layer_sec, lpe=self.lpe,
-                num_identities=int(identities[i])))
+                num_identities=int(identities[i]),
+                smeshing=bool(nodes.get("smeshing", True))))
         for i in range(n_light):
             self.lights.append(LightNode(self.seed, i, self.hub))
         self.network.build_topology()
@@ -222,6 +223,8 @@ class ScenarioEngine:
         for fault in phase.get("faults", ()):
             if fault.get("kind") == "adversary":
                 line = self._start_adversary(fault)
+            elif fault.get("kind") == "restart":
+                line = await self._restart_full(fault)
             else:
                 line = faults_mod.apply_fault(self, fault)
             self.record("fault phase=%s %s" % (pname, line))
@@ -336,6 +339,20 @@ class ScenarioEngine:
             await fn.pubsub.publish(TOPIC_TX, tx.raw)
             # spacecheck: ok=SC001 virtual pacing: 0.1 VIRTUAL seconds between publishes, zero wall cost
             await asyncio.sleep(0.1)
+
+    async def _restart_full(self, spec: dict) -> str:
+        """Crash recovery fault: bring a killed full node back over its
+        surviving on-disk stores (needs an await for prepare(), so it
+        lives here rather than in the sync fault vocabulary)."""
+        fn = self.fulls[int(spec["full"])]
+        if fn.alive:
+            raise faults_mod.FaultError(
+                f"restart full={fn.index}: node is alive (kill it first)")
+        await fn.restart(self.until_layer)
+        # the final phase gathers _run_tasks before judging convergence;
+        # the reborn node's run loop must be part of that barrier
+        self._run_tasks.append(fn.run_task)
+        return "restart full=%d id=%s" % (fn.index, fn.name.hex()[:16])
 
     def _start_adversary(self, spec: dict) -> str:
         what = spec["what"]

@@ -43,6 +43,25 @@ reads wall time.
   its failure budget (N device attempts for an M≫N-batch outage, not
   M), the host fallback carries the load bit-identically, and device
   recovery re-closes the breaker.
+* ``storm-1024`` — the thousand-node acceptance drill on the event
+  fabric (sim/net.py EventMeshHub): 1024 nodes, mostly light relays,
+  through storm, a 3-way partition, churn, three concurrent
+  adversaries, and heal — converged with a byte-identical replay
+  digest inside the tier-1 wall budget (the storm-smoke CI job).
+* ``storm-512-bench`` — the pure-fabric bench shape behind
+  ``sim_fabric_events_per_sec`` (bench.py): smeshing and tracing off,
+  sparse heartbeats, a long quiet tail, so the wall clock measures hub
+  idle+relay cost — the axis the event fabric rebuilt — instead of
+  the consensus/crypto floor both fabrics share. Digest-identical
+  across fabrics (clean links draw nothing from the net RNG).
+* ``crash-store`` — composed crash + netsplit: a full node is
+  partitioned into its own island, SIGKILLed, and after heal restarts
+  over its surviving on-disk stores (the ``restart`` fault), re-syncing
+  into byte-identical consensus with the majority.
+* ``byzantine-verifyd`` — one fleet replica keeps a healthy transport
+  but flips every verdict (``"engine": "fleet"``): the FleetVerifier's
+  verdict audit must detect it, trip only that replica's breaker, and
+  let zero wrong verdicts reach a caller.
 """
 
 from __future__ import annotations
@@ -169,6 +188,194 @@ def storm_256(seed: int = 11, light: int = 252) -> dict:
                   "min": 8},
                  {"kind": "span", "name": "gossip.deliver", "min": 32},
              ]},
+        ],
+    }
+
+
+def storm_1024(seed: int = 17, light: int = 1020) -> dict:
+    """The thousand-node acceptance scenario, only reachable on the
+    event fabric: 1024 nodes (mostly light relays running NO gossipsub
+    control plane), gossip storm, 3-way partition with link degradation
+    and heavy light churn, the full adversarial payload set, heal,
+    Tortoise re-convergence, zero consensus divergence. Same geometry
+    as storm-256 so a fabric regression shows up as wall time, not as
+    a different consensus question."""
+    churned = list(range(16, 64))
+    return {
+        "name": "storm-1024", "seed": seed,
+        "nodes": {"full": 4, "light": light,
+                  "identities": [3, 1, 1, 1]},
+        "layer_sec": 2.0, "lpe": 8, "until_layer": 20,
+        "digest_frontier": 12,
+        # 4x the node count floods ~10x the gossip spans of storm-256;
+        # the default 64Ki ring would evict every mesh.process_layer
+        # span before the heal-phase span asserts read them
+        "trace_capacity": 1 << 19,
+        "topology": {"degree": 6, "gossip_degree": 4},
+        "phases": [
+            {"name": "storm", "until_layer": 10,
+             "traffic": {"storm": {"publishers": 24, "messages": 40,
+                                   "interval": 0.12},
+                         "tx_spawn": {}},
+             "asserts": [
+                 {"kind": "storm_coverage", "min_fraction": 0.9},
+             ]},
+            {"name": "partition", "until_layer": 13,
+             "faults": [
+                 {"kind": "partition", "islands": [[0, 1], [2], [3]]},
+                 {"kind": "link_policy", "loss": 0.05, "delay": 0.02,
+                  "jitter": 0.05, "dup": 0.02, "reorder": 0.02},
+                 {"kind": "churn", "light": churned},
+                 {"kind": "adversary", "what": "malformed_atx",
+                  "count": 6, "via": 80},
+                 {"kind": "adversary", "what": "torsion_sig",
+                  "count": 4, "via": 81},
+                 {"kind": "adversary", "what": "dup_flood",
+                  "count": 12, "via": 82, "interval": 0.1},
+             ],
+             "traffic": {"storm": {"publishers": 12, "messages": 10,
+                                   "interval": 0.3}}},
+            {"name": "heal",
+             "faults": [
+                 {"kind": "link_policy"},   # back to clean links
+                 {"kind": "heal"},
+                 {"kind": "resume", "light": churned},
+             ],
+             "converge": {"frontier": 12, "deadline": 240.0},
+             "asserts": [
+                 {"kind": "converged", "frontier": 12},
+                 {"kind": "progress", "min_layer": 12},
+                 {"kind": "sli_present", "name": "layer_apply_p99"},
+                 {"kind": "sli_present", "name": "gossip_handler_p99"},
+                 {"kind": "slo_green"},
+                 {"kind": "span", "name": "mesh.process_layer",
+                  "min": 8},
+                 {"kind": "span", "name": "gossip.deliver", "min": 32},
+             ]},
+        ],
+    }
+
+
+def storm_512_bench(seed: int = 23, light: int = 510) -> dict:
+    """The bench workload behind ``sim_fabric_events_per_sec``: a clean
+    512-node gossip storm (no faults, no link policies — the data-plane
+    RNG is never drawn, so BOTH fabrics replay the identical world and
+    must land the identical digest; bench.py asserts that before
+    reporting any rate). The scenario isolates the FABRIC: smeshing and
+    tracing are off (no PoST init, no ATX/proposal crypto competing for
+    the wall clock), and the storm burst is followed by a long quiet
+    tail — the regime where per-node consumer tasks and an always-on
+    control plane keep burning beats while the event wheel and the
+    dirty-set heartbeat cost nothing."""
+    return {
+        "name": "storm-512-bench", "seed": seed,
+        "nodes": {"full": 2, "light": light, "smeshing": False},
+        "trace": False,
+        # layer_sec 2.0 compresses time ~150x vs mainnet, so the default
+        # 1.0-virtual-s beat is 150x SPARSER than gossipsub's real 1 s
+        # heartbeat; 0.1 is still 15x sparser, and per-beat cost is the
+        # O(nodes)-vs-O(dirty) axis the fabric rewrite targets
+        "heartbeat": 0.1,
+        "layer_sec": 2.0, "lpe": 8, "until_layer": 40,
+        "digest_frontier": 6,
+        "topology": {"degree": 6, "gossip_degree": 4},
+        "phases": [
+            {"name": "storm", "until_layer": 6,
+             "traffic": {"storm": {"publishers": 24, "messages": 60,
+                                   "interval": 0.1}}},
+            {"name": "quiet-tail", "until_layer": 38},
+            {"name": "end",
+             "converge": {"frontier": 6, "deadline": 180.0},
+             "asserts": [
+                 {"kind": "converged", "frontier": 6},
+                 {"kind": "storm_coverage", "min_fraction": 0.9},
+             ]},
+        ],
+    }
+
+
+def crash_store(seed: int = 13, light: int = 24) -> dict:
+    """Composed crash-store-mid-partition drill: full node 2 is cut off
+    in its own island and then SIGKILLed (storage left on disk), the
+    majority island keeps certifying; after heal the node RESTARTS over
+    its surviving stores (the PR-13 recovery path through App.prepare)
+    and must re-sync into byte-identical consensus with the majority —
+    the fault every production operator actually fears, crash + netsplit
+    at once."""
+    return {
+        "name": "crash-store", "seed": seed,
+        "nodes": {"full": 3, "light": light, "identities": [2, 1, 1]},
+        "layer_sec": 2.0, "lpe": 3, "until_layer": 16,
+        "digest_frontier": 11,
+        "phases": [
+            {"name": "warmup", "until_layer": 6,
+             "traffic": {"storm": {"publishers": 4, "messages": 10,
+                                   "interval": 0.25}}},
+            {"name": "partition-crash", "until_layer": 9,
+             "faults": [
+                 {"kind": "partition", "islands": [[0, 1], [2]]},
+                 {"kind": "kill", "full": 2},
+             ]},
+            {"name": "heal-restart", "until_layer": 12,
+             "faults": [
+                 {"kind": "heal"},
+                 {"kind": "restart", "full": 2},
+             ]},
+            {"name": "end",
+             "converge": {"frontier": 11, "deadline": 240.0},
+             "asserts": [
+                 {"kind": "converged", "frontier": 11},
+                 {"kind": "progress", "min_layer": 11},
+                 {"kind": "slo_green"},
+             ]},
+        ],
+    }
+
+
+def byzantine_verifyd(seed: int = 9) -> dict:
+    """One fleet replica turns byzantine mid-load: transport healthy,
+    admission healthy, every verdict flipped. The FleetVerifier's
+    verdict audit (``audit.items`` spot-checks per successful remote
+    batch against the bit-identical local farm) must detect the
+    divergence, trip THAT replica's breaker, and keep serving correct
+    verdicts from the survivors; after the replica is restored the
+    probe path re-closes the breaker. Zero wrong verdicts may reach a
+    caller at any point."""
+    return {
+        "name": "byzantine-verifyd", "engine": "fleet", "seed": seed,
+        "waves": 14, "wave_interval_s": 0.5,
+        "replicas": [
+            {"name": "r0", "router_max_clients": 64,
+             "service": {"max_clients": 512, "max_pending_items": 4096,
+                         "workers": 2}},
+            {"name": "r1", "router_max_clients": 64,
+             "service": {"max_clients": 512, "max_pending_items": 4096,
+                         "workers": 2}},
+            {"name": "r2", "router_max_clients": 64,
+             "service": {"max_clients": 512, "max_pending_items": 4096,
+                         "workers": 2}},
+        ],
+        "clients": {"active_per_wave": 10, "overflow": 0,
+                    "pinned_hot": 0, "items": [2, 4],
+                    "mix": {"sig": 6, "vrf": 1, "membership": 1,
+                            "pow": 2}},
+        "breaker": {"failure_budget": 2, "window_s": 60.0,
+                    "cooldown_s": 1.0, "cooldown_cap_s": 2.0},
+        "audit": {"items": 2},
+        "faults": {"byzantine": {"replica": "r1", "wave": 3,
+                                 "restore_wave": 9}},
+        "workload": {"sigs": 48, "vrfs": 6, "posts": 2,
+                     "memberships": 8, "pows": 10},
+        "asserts": [
+            {"kind": "no_wrong_verdicts"},
+            {"kind": "typed_sheds_only", "reasons": []},
+            {"kind": "byzantine_detected", "replica": "r1", "min": 1},
+            {"kind": "breaker_sequence", "replica": "r1"},
+            {"kind": "path_served", "path": "remote", "min": 60},
+            {"kind": "failback"},
+            {"kind": "sli_present", "name": "fleet_block_p99"},
+            {"kind": "slo_green", "name": "fleet_block_p99",
+             "target": 0.25},
         ],
     }
 
@@ -383,6 +590,10 @@ _BUILTINS = {
     "crash-recovery": crash_recovery,
     "partition-heal": partition_heal,
     "storm-256": storm_256,
+    "storm-1024": storm_1024,
+    "storm-512-bench": storm_512_bench,
+    "crash-store": crash_store,
+    "byzantine-verifyd": byzantine_verifyd,
     "timeskew-kill": timeskew_kill,
     "verifyd-outage": verifyd_outage,
     "runtime-degrade": runtime_degrade,

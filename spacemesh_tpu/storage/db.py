@@ -157,6 +157,11 @@ class Database:
             with self._lock:
                 yield self._conn
             return
+        if self._pool_closed:
+            # close() drained the pool — blocking on get() here would
+            # hang the caller forever; fail the way sqlite3 does
+            raise sqlite3.ProgrammingError(
+                f"{self.name}: cannot read from a closed database")
         rc = self._readers.get()
         try:
             yield rc

@@ -49,7 +49,7 @@ import re
 import sys
 
 RATIO_FIELDS = ("vs_sequential", "vs_single", "vs_serial", "vs_baseline",
-                "speedup")
+                "vs_legacy", "speedup")
 GATE_FLAGS = ("bit_identical", "verified")
 
 _SUFFIX = re.compile(r"(_n\d+)?(_b\d+)?(_cpufallback)?$")

@@ -427,7 +427,8 @@ class FleetVerifier:
                  own_router: bool = False,
                  bus=None,
                  observer: Optional[Callable[..., None]] = None,
-                 time_source: Callable[[], float] = time.monotonic):
+                 time_source: Callable[[], float] = time.monotonic,
+                 audit_k: int = 0):
         self.router = router
         self.farm = farm
         self.client_id = str(client_id)
@@ -436,9 +437,13 @@ class FleetVerifier:
         self.bus = bus
         self.observer = observer
         self._now = time_source
+        # audit_k > 0: spot-check that many items of every successful
+        # remote batch against the local farm (byzantine detection)
+        self.audit_k = int(audit_k)
         self.stats = {"remote_ok": 0, "remote_failed": 0,
                       "local": 0, "local_fastfail": 0,
-                      "remote_attempts": 0, "failbacks": 0}
+                      "remote_attempts": 0, "failbacks": 0,
+                      "audits": 0, "audit_divergence": 0}
 
     # -- lifecycle -------------------------------------------------------
 
@@ -546,6 +551,9 @@ class FleetVerifier:
                 rep.breaker.abort_probe()
                 raise
             else:
+                if self.audit_k and reqs and \
+                        not await self._audit(rep, reqs, lane, verdicts):
+                    return None  # byzantine: tripped, chain moves on
                 rep.ok += 1
                 self.stats["remote_ok"] += 1
                 if was_probe:
@@ -555,6 +563,32 @@ class FleetVerifier:
                 rep.breaker.record_success()
                 return verdicts
         return None
+
+    async def _audit(self, rep: _Replica, reqs: list, lane: Lane,
+                     verdicts: list[bool]) -> bool:
+        """Spot-check a deterministic sample (first/last items) of a
+        successful remote batch against the local farm — verdicts are
+        bit-identical by construction, so ANY divergence means the
+        replica is answering from a stale or hostile state.  The
+        replica is tripped as byzantine and its whole batch discarded:
+        a wrong verdict must never reach the caller even when the
+        transport and the admission plane look perfectly healthy."""
+        idxs = sorted({0, len(reqs) - 1})[:max(self.audit_k, 1)]
+        self.stats["audits"] += 1
+        for i in idxs:
+            local = await self.farm.submit(reqs[i], lane)
+            if bool(local) != bool(verdicts[i]):
+                self.stats["audit_divergence"] += 1
+                metrics.fleet_audit_divergence.inc(replica=rep.name)
+                _log.warning("replica %s verdict diverges from local "
+                             "farm on item %d: tripping as byzantine",
+                             rep.name, i)
+                if self.observer is not None:
+                    self.observer("audit_divergence", replica=rep.name,
+                                  index=i)
+                self._trip(rep, "byzantine:audit_divergence")
+                return False
+        return True
 
     def _on_shed(self, rep: _Replica, cid: str, e: Shed,
                  kinds: list) -> None:

@@ -1,0 +1,250 @@
+"""Event-fabric units (sim/net.py EventMeshHub): wheel ordering and
+generation checks, light-relay control-plane elision + deterministic
+relay sets, fault-epoch cache invalidation, dirty-set heartbeat
+retirement, and fabric selection. Cross-fabric behavior parity runs at
+hub level here; the digest-level cross-fabric check is bench.py's
+sim_fabric_events_per_sec gate (storm-512-bench on both fabrics)."""
+
+import asyncio
+
+from spacemesh_tpu.core.hashing import sum256
+from spacemesh_tpu.p2p.gossipmesh import relay_sample
+from spacemesh_tpu.p2p.pubsub import PubSub
+from spacemesh_tpu.sim.net import (
+    EventMeshHub,
+    LegacyMeshHub,
+    LinkPolicy,
+    MeshHub,
+    SimNetwork,
+)
+from spacemesh_tpu.utils.vclock import run_virtual
+
+N = [b"%02d" % i + bytes(30) for i in range(16)]
+
+
+def _network(n=8, seed=3, degree=4):
+    net = SimNetwork(seed, degree=degree)
+    for name in N[:n]:
+        net.add_node(name)
+    net.build_topology()
+    return net
+
+
+def _join(hub, names, *, light=False):
+    """PubSub endpoints with a counting accept-all handler on t1."""
+    counts = {}
+    for name in names:
+        ps = PubSub(node_name=name, deliver_self=False)
+        counts[name] = []
+
+        async def h(peer, data, _n=name):
+            counts[_n].append(data)
+            return True
+
+        ps.register("t1", h)
+        hub.join(ps, light=light)
+    return counts
+
+
+def _frame(tag: bytes):
+    data = b"payload-" + tag
+    return ("msg", N[0], ("t1", sum256(b"t1", data), data))
+
+
+# --- the event wheel --------------------------------------------------
+
+
+def test_wheel_fires_by_instant_then_seq():
+    """Frames pop in (delivery instant, schedule seq) order: an earlier
+    instant wins regardless of schedule order, and ties replay in
+    schedule order — the determinism the digest contract rides on."""
+
+    async def go():
+        net = _network(4)
+        hub = EventMeshHub(net)
+        counts = _join(hub, N[:4])
+        dst = N[1]
+        hub._schedule(5.0, dst, _frame(b"a"))   # seq 0 @ t+5
+        hub._schedule(3.0, dst, _frame(b"b"))   # seq 1 @ t+3
+        hub._schedule(5.0, dst, _frame(b"c"))   # seq 2 @ t+5 (ties a)
+        assert hub.stats["events_scheduled"] == 3
+        await asyncio.sleep(6.0)                # virtual: instant wall
+        await hub.drain()
+        assert counts[dst] == [b"payload-b", b"payload-a", b"payload-c"]
+        assert hub.stats["events_fired"] == 3
+
+    run_virtual(go(), timeout=60)
+
+
+def test_wheel_drops_frames_for_churned_incarnation():
+    """Churn while a frame is in flight: suspend bumps the node's
+    generation, so the wheel pop discards the stale frame — a resumed
+    node must never see pre-crash traffic."""
+
+    async def go():
+        net = _network(4)
+        hub = EventMeshHub(net)
+        counts = _join(hub, N[:4])
+        dst = N[2]
+        hub._schedule(2.0, dst, _frame(b"pre-crash"))
+        hub.suspend(dst)
+        hub.resume(dst)
+        dropped0 = hub.stats["dropped"]
+        await asyncio.sleep(3.0)
+        await hub.drain()
+        assert counts[dst] == []
+        assert hub.stats["dropped"] == dropped0 + 1
+        # the resumed incarnation still receives fresh traffic
+        hub._schedule(1.0, dst, _frame(b"post-restart"))
+        await asyncio.sleep(2.0)
+        await hub.drain()
+        assert counts[dst] == [b"payload-post-restart"]
+
+    run_virtual(go(), timeout=60)
+
+
+def test_delayed_delivery_waits_for_the_instant():
+    """A policy delay holds frames in the wheel until their virtual
+    instant — they must not leak early through the zero-delay path."""
+
+    async def go():
+        net = _network(4)
+        hub = EventMeshHub(net)
+        counts = _join(hub, N[:4])
+        net.set_link_policy(LinkPolicy(delay=5.0))
+        pub = hub._nodes[N[0]]
+        await pub.publish("t1", b"late")
+        await asyncio.sleep(0.1)
+        assert all(not counts[n] for n in N[1:4]), "must not arrive early"
+        # multi-hop flood: each relay hop adds 5s; bound is hops * delay
+        await asyncio.sleep(30.0)
+        await hub.drain()
+        assert all(counts[n] == [b"late"] for n in N[1:4])
+
+    run_virtual(go(), timeout=120)
+
+
+# --- light relays -----------------------------------------------------
+
+
+def test_light_relays_run_no_control_plane():
+    async def go():
+        net = _network(8)
+        hub = EventMeshHub(net)
+        _join(hub, N[:2])                       # 2 mesh nodes
+        counts = _join(hub, N[2:8], light=True)  # 6 light relays
+        assert all(n not in hub._gossip for n in N[2:8])
+        assert all(n in hub._gossip for n in N[:2])
+        pub = hub._nodes[N[0]]
+        await pub.publish("t1", b"m")
+        await hub.drain()
+        assert all(counts[n] == [b"m"] for n in N[2:8])
+        # heartbeats only ever visit mesh nodes
+        for _ in range(3):
+            hub.heartbeat()
+        assert hub.stats["hb_visits"] <= 3 * len(hub._gossip)
+
+    asyncio.run(go())
+
+
+def test_relay_sets_deterministic_and_epoch_cached():
+    net = _network(8)
+    hub = EventMeshHub(net)
+    _join(hub, N[:8], light=True)
+    name = N[3]
+    got = hub._relay_targets(name, "t1")
+    # sha256-ranked sample of the CURRENT neighbor set — cross-process
+    # stable, so both ends of a replayed scenario pick the same edges
+    assert got == relay_sample("t1", name, net.neighbors(name),
+                               hub.gossip_degree)
+    assert hub._relay_targets(name, "t1") is got, "cached within an epoch"
+    net.partition([[name]])
+    after = hub._relay_targets(name, "t1")
+    assert after is not got, "fault epoch bump must invalidate the cache"
+    assert after == (), "a one-node island has no relay targets"
+    assert hub._relay_targets(name, "t1", exclude=N[0]) == []
+
+
+# --- fault-epoch memoization ------------------------------------------
+
+
+def test_network_caches_invalidate_on_fault_epoch():
+    net = _network(6)
+    a, b = N[0], N[1]
+    assert net.reachable(a, b)
+    miss0 = net.cache_stats["miss"]
+    assert net.reachable(a, b) and net.reachable(b, a)
+    assert net.cache_stats["miss"] == miss0, "repeat lookups must hit"
+    assert net.cache_stats["hit"] >= 2
+    e0 = net.epoch
+    net.partition([[a], [b]])
+    assert net.epoch > e0
+    assert not net.reachable(a, b), "stale True would mask the partition"
+    assert b not in net.neighbors(a)
+    net.set_link_policy(LinkPolicy(loss=0.5), a, b)
+    assert net.policy(a, b).loss == 0.5, "policy memo must refresh too"
+    net.heal()
+    assert net.reachable(a, b)
+
+
+# --- dirty-set heartbeats ---------------------------------------------
+
+
+def test_heartbeat_retires_quiet_nodes_and_redirties_on_fault():
+    async def go():
+        net = _network(6)
+        hub = EventMeshHub(net)
+        _join(hub, N[:6])
+        pub = hub._nodes[N[0]]
+        await pub.publish("t1", b"m")
+        await hub.drain()
+        assert hub._dirty, "traffic must dirty the mesh nodes"
+        # beats retire nodes once control work and the message cache age
+        # out; afterwards a quiet network costs zero visits per beat
+        for _ in range(20):
+            hub.heartbeat()
+            await hub.drain()
+        assert not hub._dirty
+        visits = hub.stats["hb_visits"]
+        hub.heartbeat()
+        assert hub.stats["hb_visits"] == visits, "quiet beat visits nobody"
+        # a fault moves every live mesh node's neighbor set: re-dirty
+        net.partition([[N[0], N[1]]])
+        hub.heartbeat()
+        assert hub.stats["hb_visits"] > visits
+
+    asyncio.run(go())
+
+
+# --- fabric selection / parity ----------------------------------------
+
+
+def test_fabric_selector_env(monkeypatch):
+    monkeypatch.delenv("SPACEMESH_SIM_FABRIC", raising=False)
+    assert isinstance(MeshHub(_network(4)), EventMeshHub)
+    monkeypatch.setenv("SPACEMESH_SIM_FABRIC", "legacy")
+    assert isinstance(MeshHub(_network(4)), LegacyMeshHub)
+
+
+def test_fabrics_agree_on_clean_world_delivery():
+    """Same seed, same publishes, clean links: both fabrics deliver the
+    same messages to the same nodes exactly once (the hub-level core of
+    the bench's digest-equality gate)."""
+
+    def run(cls):
+        async def go():
+            net = _network(8, seed=11)
+            hub = cls(net)
+            counts = _join(hub, N[:8])
+            for i in range(3):
+                await hub._nodes[N[i]].publish("t1", b"m%d" % i)
+                await hub.drain()
+            return {n: sorted(v) for n, v in counts.items()}
+
+        return asyncio.run(go())
+
+    event, legacy = run(EventMeshHub), run(LegacyMeshHub)
+    expect = {n: sorted(b"m%d" % i for i in range(3) if N[i] != n)
+              for n in N[:8]}
+    assert event == expect
+    assert legacy == expect
