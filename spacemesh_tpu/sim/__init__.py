@@ -11,15 +11,45 @@ Layout:
   net.py        SimNetwork (topology + fault state) + MeshHub (gossip
                 over p2p/gossipmesh.py meshes) + SimNet (req/resp)
   node.py       LightNode / FullNode factories (shared event loop)
+  shard.py      multi-process fabric: light nodes partitioned over W
+                worker processes with conservative virtual-time windows
   scenario.py   the declarative engine: phases, traffic, faults,
                 SLI/trace assertions, event digest
   scenarios.py  built-in scripts (partition-heal, storm-256,
                 timeskew-kill, ...)
   __main__.py   CLI: python -m spacemesh_tpu.sim --scenario ... --seed N
 
+Exports resolve lazily (PEP 562): shard WORKER processes import
+`spacemesh_tpu.sim.shard` only, and must not pay for (or depend on)
+the jax-heavy scenario/node stack that `scenario.py` pulls in.
+
 See docs/SCENARIOS.md for the script format and the replay workflow.
 """
 
-from .net import LinkPolicy, MeshHub, SimNet, SimNetwork  # noqa: F401
-from .scenario import ScenarioResult, run_scenario  # noqa: F401
-from .scenarios import builtin, builtin_names  # noqa: F401
+_EXPORTS = {
+    "LinkPolicy": "net",
+    "MeshHub": "net",
+    "SimNet": "net",
+    "SimNetwork": "net",
+    "ScenarioResult": "scenario",
+    "run_scenario": "scenario",
+    "builtin": "scenarios",
+    "builtin_names": "scenarios",
+}
+
+__all__ = sorted(_EXPORTS)
+
+
+def __getattr__(name):
+    mod = _EXPORTS.get(name)
+    if mod is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    value = getattr(importlib.import_module(f".{mod}", __name__), name)
+    globals()[name] = value
+    return value
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_EXPORTS))

@@ -46,6 +46,10 @@ def main(argv=None) -> int:
     ap.add_argument("--seed", type=int, default=None)
     ap.add_argument("--light", type=int, default=None,
                     help="light-node count override")
+    ap.add_argument("--shards", default=None,
+                    help="worker-process count for the sharded fabric: "
+                         "an integer, or 'auto' for min(cores, light//64) "
+                         "(SPACEMESH_SIM_SHARDS overrides)")
     ap.add_argument("--repeat", type=int, default=1,
                     help="run N times; digests must be byte-identical")
     ap.add_argument("--json", dest="json_out",
@@ -66,6 +70,8 @@ def main(argv=None) -> int:
         script = _load_script(args.script)
         if args.seed is not None:
             script["seed"] = args.seed
+    if args.shards is not None:
+        script["shards"] = args.shards
 
     # script "engine" selects the runner: the network scenario engine
     # (default), the verifyd service-load engine (sim/verifyd_load.py),
@@ -96,7 +102,7 @@ def main(argv=None) -> int:
         if not args.quiet:
             for k, v in sorted(result.slis.items()):
                 print(f"  sli {k}={v:.6f}")
-            for k, v in sorted(result.stats["hub"].items()):
+            for k, v in sorted(result.stats.get("hub", {}).items()):
                 print(f"  hub {k}={v}")
     if args.json_out and result is not None:
         Path(args.json_out).write_text(result.to_json())

@@ -103,12 +103,30 @@ class SimNetwork:
         self._reach_cache: dict[tuple[bytes, bytes], bool] = {}
         self._nbr_cache: dict[bytes, frozenset] = {}
         self._pol_cache: dict[tuple[bytes, bytes], LinkPolicy] = {}
+        # Optional fault-mutation listener (sim/shard.py): called as
+        # listener(method_name, args_tuple) AFTER each mutator applies,
+        # so shard workers can replay the mutation on their replica
+        # SimNetwork at the same virtual instant.
+        self.listener = None
 
     def _bump_epoch(self) -> None:
         self.epoch += 1
         self._reach_cache.clear()
         self._nbr_cache.clear()
         self._pol_cache.clear()
+
+    def _notify(self, method: str, args: tuple) -> None:
+        if self.listener is not None:
+            self.listener(method, args)
+
+    def min_delay_floor(self) -> float:
+        """Conservative cross-shard lookahead: the smallest delay any
+        link policy currently in force could apply to a frame. Jitter
+        and reorder only ADD delay, so ``delay`` itself is the floor."""
+        floor = self.default_policy.delay
+        for pol in self.link_policy.values():
+            floor = min(floor, pol.delay)
+        return max(0.0, floor)
 
     # --- membership / topology ---------------------------------------
 
@@ -214,12 +232,14 @@ class SimNetwork:
         """Split the net: listed groups get ids 1..n, everyone else
         stays in group 0 (so an unlisted bulk forms its own island
         exactly when some nodes ARE listed)."""
+        groups = [list(members) for members in groups]
         for name in self.group:
             self.group[name] = 0
         for gid, members in enumerate(groups, start=1):
             for name in members:
                 self.group[name] = gid
         self._bump_epoch()
+        self._notify("partition", (groups,))
 
     def heal(self) -> None:
         """Clear partitions, eclipses, and blocked links (downed nodes
@@ -229,23 +249,28 @@ class SimNetwork:
         self.eclipsed.clear()
         self.blocked.clear()
         self._bump_epoch()
+        self._notify("heal", ())
 
     def eclipse(self, victim: bytes, allowed: Iterable[bytes]) -> None:
         """The victim may only talk to ``allowed`` (its attackers)."""
         self.eclipsed[victim] = frozenset(allowed)
         self._bump_epoch()
+        self._notify("eclipse", (victim, sorted(self.eclipsed[victim])))
 
     def clear_eclipse(self, victim: bytes) -> None:
         self.eclipsed.pop(victim, None)
         self._bump_epoch()
+        self._notify("clear_eclipse", (victim,))
 
     def block_link(self, a: bytes, b: bytes) -> None:
         self.blocked.add(frozenset((a, b)))
         self._bump_epoch()
+        self._notify("block_link", (a, b))
 
     def unblock_link(self, a: bytes, b: bytes) -> None:
         self.blocked.discard(frozenset((a, b)))
         self._bump_epoch()
+        self._notify("unblock_link", (a, b))
 
     def set_down(self, name: bytes, is_down: bool = True) -> None:
         if is_down:
@@ -253,6 +278,7 @@ class SimNetwork:
         else:
             self.down.discard(name)
         self._bump_epoch()
+        self._notify("set_down", (name, is_down))
 
     def set_link_policy(self, policy: LinkPolicy,
                         a: bytes | None = None,
@@ -263,6 +289,7 @@ class SimNetwork:
         else:
             self.link_policy[frozenset((a, b))] = policy
         self._bump_epoch()
+        self._notify("set_link_policy", (dataclasses.asdict(policy), a, b))
 
 
 class EventMeshHub:
@@ -477,6 +504,17 @@ class EventMeshHub:
         # 1024 nodes, dwarfing the actual delivery work.
         if self._timer is None or due < self._timer_due:
             self._arm(loop, due)
+
+    def _schedule_at(self, instant: float, dst: bytes, item: tuple) -> None:
+        """Wheel insert at an ABSOLUTE virtual instant (cross-shard
+        frames arrive tagged with their delivery instant; re-deriving a
+        relative delay would lose determinism to float round-trips)."""
+        loop = asyncio.get_running_loop()
+        heapq.heappush(self._wheel, (instant, next(self._seq), dst,
+                                     self._gen.get(dst, 0), item))
+        self.stats["events_scheduled"] += 1
+        if self._timer is None or instant < self._timer_due:
+            self._arm(loop, instant)
 
     def _arm(self, loop, due: float) -> None:
         if self._timer is not None:
@@ -865,13 +903,21 @@ class LegacyMeshHub:
         await asyncio.gather(*(q.join() for q in self._inboxes.values()))
 
 
-def MeshHub(network: SimNetwork, *, gossip_degree: int = 4):
+def MeshHub(network: SimNetwork, *, gossip_degree: int = 4,
+            shards: int = 1):
     """Fabric selector: the event wheel by default, the legacy
     task-per-node hub under ``SPACEMESH_SIM_FABRIC=legacy`` (the bench
-    baseline)."""
+    baseline), or the multi-process sharded wheel when ``shards > 1``
+    (sim/shard.py; forced back to 1 under the legacy fabric)."""
     fabric = os.environ.get("SPACEMESH_SIM_FABRIC", "").strip().lower()
-    cls = LegacyMeshHub if fabric == "legacy" else EventMeshHub
-    return cls(network, gossip_degree=gossip_degree)
+    if fabric == "legacy":
+        return LegacyMeshHub(network, gossip_degree=gossip_degree)
+    if shards and int(shards) > 1:
+        from .shard import ShardedMeshHub
+
+        return ShardedMeshHub(network, gossip_degree=gossip_degree,
+                              shards=int(shards))
+    return EventMeshHub(network, gossip_degree=gossip_degree)
 
 
 class _NetView:

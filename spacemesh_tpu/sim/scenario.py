@@ -58,6 +58,7 @@ from ..utils.vclock import ChaosClockLoop, VirtualClockLoop, cancel_all_tasks
 from . import faults as faults_mod
 from .net import MeshHub, SimNet, SimNetwork
 from .node import STORM_TOPIC, FullNode, LightNode, storm_payload
+from .shard import ShardWorkerCrash, resolve_shards
 
 # generous-by-design CI targets: the quantiles measure REAL compute
 # seconds while hundreds of coroutines share one GIL, so these catch
@@ -128,10 +129,23 @@ class ScenarioEngine:
         try:
             self.loop.run_until_complete(
                 asyncio.wait_for(self._go(), self.vtimeout))
+        except ShardWorkerCrash as e:
+            # typed scenario failure, never a hang: detach the governor
+            # so teardown below runs clean, record, and judge failed
+            self.loop.time_governor = None
+            self.record("fault shard-worker-crash shard=%d" % e.shard,
+                        digest=False)
+            self.asserts.append({"phase": "fabric", "kind": "shard_worker",
+                                 "ok": False, "detail": str(e)})
+            self._crash_result()
         finally:
+            self.loop.time_governor = None
             try:
                 self.loop.run_until_complete(cancel_all_tasks())
             finally:
+                hub = getattr(self, "hub", None)
+                if hub is not None and hasattr(hub, "close"):
+                    hub.close()
                 for fn in self.fulls:
                     fn.close()
                 if tracing.is_enabled():
@@ -148,6 +162,15 @@ class ScenarioEngine:
                     self._own_tmp.cleanup()
         return self.result
 
+    def _crash_result(self) -> None:
+        digest = hashlib.sha256(
+            "\n".join(self._digest_lines).encode()).hexdigest()
+        self.result = ScenarioResult(
+            name=self.name, seed=self.seed, digest=digest, ok=False,
+            asserts=self.asserts,
+            events=[f"{t:.3f} {line}" for t, line in self.events],
+            slis={}, stats={})
+
     async def _go(self) -> None:
         s = self.script
         nodes = s.get("nodes", {})
@@ -163,8 +186,17 @@ class ScenarioEngine:
             tracing.start(capacity=int(s.get("trace_capacity", 65536)))
         self.network = SimNetwork(self.seed,
                                   degree=int(topo.get("degree", 6)))
+        shards = resolve_shards(s.get("shards"), n_light)
         self.hub = MeshHub(self.network,
-                           gossip_degree=int(topo.get("gossip_degree", 4)))
+                           gossip_degree=int(topo.get("gossip_degree", 4)),
+                           shards=shards)
+        self.shard_count = getattr(self.hub, "shards", 1)
+        if self.shard_count > 1:
+            # conservative-window barriers ride the clock's idle jumps;
+            # the shard count must NOT enter the digest (assertions are
+            # W-invariant, the byte-identical contract is per (seed, W))
+            self.loop.time_governor = self.hub.governor
+        self.record("fabric shards=%d" % self.shard_count, digest=False)
         self.simnet = SimNet(self.network)
         self.sampler = sli_mod.SliSampler(
             metrics.REGISTRY, window_s=float(s.get("sli_window", 300.0)))
@@ -263,6 +295,28 @@ class ScenarioEngine:
                 fn.index,
                 ";".join("%d:%s" % (lyr, b.hex()[:16]) for lyr, b in rec),
                 (root or b"").hex()[:16]))
+        # merged light event record: per-shard delivery counts merged in
+        # deterministic (name-sorted) order — shard-structure invariant,
+        # so W=1 and W=k agree on loss-free links.  A sharded fabric must
+        # quiesce first: the tail of a flood can still be bouncing
+        # light -> full -> light between the parent wheel and the worker
+        # wheels, and those hops only progress while the loop runs.
+        # Events-only (digest=False): the digest must stay FABRIC
+        # invariant — event and legacy fabrics relay along different
+        # edges, so raw delivery counts differ even though consensus
+        # (the digested content) is identical, and the bench's
+        # event-vs-legacy digest gate depends on that equality.
+        # Cross-W delivery equivalence is still enforced through the
+        # storm_coverage assertion, which reads these merged counts.
+        if self.shard_count > 1 and hasattr(self.hub, "drain"):
+            await self.hub.drain()
+        if hasattr(self.hub, "finalize"):
+            self.hub.finalize()
+        merged = sorted((ln.name.hex()[:16], c)
+                        for ln, c in self._light_storm_counts())
+        self.record("record lights storm=%s n=%d" % (
+            hashlib.sha256(repr(merged).encode()).hexdigest()[:16],
+            len(merged)), digest=False)
         doc = None
         if tracing.is_enabled():
             doc = tracing.export()
@@ -289,6 +343,14 @@ class ScenarioEngine:
             events=[f"{t:.3f} {line}" for t, line in self.events],
             slis={k: v for k, v in slis.items() if v is not None},
             stats=stats)
+
+    def _light_storm_counts(self) -> list:
+        """(light, distinct storm messages seen) — from the node object
+        in-process, from the owning shard's merged counts otherwise."""
+        counts = (self.hub.light_counts(STORM_TOPIC)
+                  if hasattr(self.hub, "light_counts") else {})
+        return [(ln, counts.get(ln.name, ln.storm_seen))
+                for ln in self.lights]
 
     # --- background cadences -------------------------------------------
 
@@ -485,12 +547,34 @@ class ScenarioEngine:
                     and e.get("ph") in ("X", "B", "i"))
             entry.update(ok=n >= int(spec.get("min", 1)), value=n)
         elif kind == "storm_coverage":
+            seen = {ln.name: c for ln, c in self._light_storm_counts()}
             live = [ln for ln in self.lights
                     if self.network.alive(ln.name)]
-            got = sum(1 for ln in live if ln.storm_seen > 0)
+            got = sum(1 for ln in live if seen.get(ln.name, 0) > 0)
             frac = got / len(live) if live else 0.0
             entry.update(ok=frac >= float(spec.get("min_fraction", 0.9)),
                          value=round(frac, 4))
+        elif kind == "hub_stat":
+            value = self.hub.stats.get(spec["name"], 0)
+            ok = value >= int(spec.get("min", 1))
+            if "max" in spec:
+                ok = ok and value <= int(spec["max"])
+            entry.update(ok=ok, value=value)
+        elif kind == "epoch_roots":
+            # state-root equality across live fulls at EVERY epoch
+            # boundary up to the frontier (the multi-epoch soak gate)
+            upto = int(spec.get("upto_layer", self.until_layer - 2))
+            live = self._live_fulls()
+            boundaries, diverged = [], []
+            for lyr in range(self.lpe, upto + 1, self.lpe):
+                roots = {fn.state_root(lyr) for fn in live}
+                boundaries.append(lyr)
+                if len(roots) != 1 or None in roots:
+                    diverged.append(lyr)
+            ok = bool(live) and bool(boundaries) and not diverged
+            entry.update(ok=ok, value={"epoch_layers": boundaries,
+                                       "diverged": diverged})
+            digestable = True
         else:
             entry.update(ok=False, detail=f"unknown assert kind {kind!r}")
         self.asserts.append(entry)

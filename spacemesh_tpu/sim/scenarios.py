@@ -58,6 +58,16 @@ reads wall time.
   partitioned into its own island, SIGKILLed, and after heal restarts
   over its surviving on-disk stores (the ``restart`` fault), re-syncing
   into byte-identical consensus with the majority.
+* ``storm-4096`` — storm-1024's geometry at 4x the relay population,
+  only affordable on the SHARDED fabric (sim/shard.py): the light
+  wheels spread over host cores with conservative virtual-time
+  windows (``"shards": "auto"``).
+* ``eclipse-campaign`` — eclipse a minority full across an epoch
+  boundary while attacker lights feed it malformed ATXs; typed
+  rejections only, victim re-syncs to zero divergence after heal.
+* ``soak-epochs`` — 3.5 epochs of continuous storm + VM tx traffic on
+  the sharded fabric with state-root equality asserted at EVERY epoch
+  boundary (the slow-divergence drift detector).
 * ``byzantine-verifyd`` — one fleet replica keeps a healthy transport
   but flips every verdict (``"engine": "fleet"``): the FleetVerifier's
   verdict audit must detect it, trip only that replica's breaker, and
@@ -207,6 +217,10 @@ def storm_1024(seed: int = 17, light: int = 1020) -> dict:
                   "identities": [3, 1, 1, 1]},
         "layer_sec": 2.0, "lpe": 8, "until_layer": 20,
         "digest_frontier": 12,
+        # shard the light-relay wheel over host cores (sim/shard.py);
+        # resolves to W=1 in-process on small hosts, and every W replays
+        # the identical per-W digest
+        "shards": "auto",
         # 4x the node count floods ~10x the gossip spans of storm-256;
         # the default 64Ki ring would evict every mesh.process_layer
         # span before the heal-phase span asserts read them
@@ -289,6 +303,155 @@ def storm_512_bench(seed: int = 23, light: int = 510) -> dict:
              "asserts": [
                  {"kind": "converged", "frontier": 6},
                  {"kind": "storm_coverage", "min_fraction": 0.9},
+             ]},
+        ],
+    }
+
+
+def storm_4096(seed: int = 29, light: int = 4092) -> dict:
+    """The four-thousand-node tier-2 drill: storm-1024's geometry at 4x
+    the relay population, only reachable with the sharded fabric
+    (sim/shard.py) — ``"shards": "auto"`` spreads the light wheels over
+    the host cores with conservative virtual-time windows. Same
+    consensus question as storm-256/1024, so a fabric scaling
+    regression shows up as wall time."""
+    churned = list(range(64, 192))
+    return {
+        "name": "storm-4096", "seed": seed,
+        "nodes": {"full": 4, "light": light,
+                  "identities": [3, 1, 1, 1]},
+        "layer_sec": 2.0, "lpe": 8, "until_layer": 20,
+        "digest_frontier": 12,
+        "trace_capacity": 1 << 21,
+        "shards": "auto",
+        "topology": {"degree": 6, "gossip_degree": 4},
+        "phases": [
+            {"name": "storm", "until_layer": 10,
+             "traffic": {"storm": {"publishers": 32, "messages": 48,
+                                   "interval": 0.1},
+                         "tx_spawn": {}},
+             "asserts": [
+                 {"kind": "storm_coverage", "min_fraction": 0.9},
+             ]},
+            {"name": "partition", "until_layer": 13,
+             "faults": [
+                 {"kind": "partition", "islands": [[0, 1], [2], [3]]},
+                 {"kind": "link_policy", "loss": 0.05, "delay": 0.02,
+                  "jitter": 0.05, "dup": 0.02, "reorder": 0.02},
+                 {"kind": "churn", "light": churned},
+                 {"kind": "adversary", "what": "malformed_atx",
+                  "count": 6, "via": 300},
+                 {"kind": "adversary", "what": "torsion_sig",
+                  "count": 4, "via": 301},
+                 {"kind": "adversary", "what": "dup_flood",
+                  "count": 12, "via": 302, "interval": 0.1},
+             ],
+             "traffic": {"storm": {"publishers": 16, "messages": 10,
+                                   "interval": 0.3}}},
+            {"name": "heal",
+             "faults": [
+                 {"kind": "link_policy"},   # back to clean links
+                 {"kind": "heal"},
+                 {"kind": "resume", "light": churned},
+             ],
+             "converge": {"frontier": 12, "deadline": 360.0},
+             "asserts": [
+                 {"kind": "converged", "frontier": 12},
+                 {"kind": "progress", "min_layer": 12},
+                 {"kind": "sli_present", "name": "layer_apply_p99"},
+                 {"kind": "slo_green"},
+                 {"kind": "span", "name": "mesh.process_layer",
+                  "min": 8},
+                 {"kind": "span", "name": "gossip.deliver", "min": 32},
+             ]},
+        ],
+    }
+
+
+def eclipse_campaign(seed: int = 31, light: int = 48) -> dict:
+    """Eclipse attack across an epoch boundary: minority full 3 may
+    only talk to a clique of attacker lights, which feed it (and the
+    honest side) malformed ATXs while the epoch turns. The honest
+    majority keeps deciding; every hostile payload dies as a TYPED
+    rejection (hub ``rejected``, never a crash); after the eclipse
+    clears the victim re-syncs into byte-identical consensus — zero
+    divergence. The in-proc analogue of an eclipse campaign against a
+    bootstrapping node."""
+    attackers = [("light", i) for i in (40, 41, 42, 43)]
+    return {
+        "name": "eclipse-campaign", "seed": seed,
+        "nodes": {"full": 4, "light": light,
+                  "identities": [3, 1, 1, 1]},
+        "layer_sec": 2.0, "lpe": 8, "until_layer": 20,
+        "digest_frontier": 12,
+        "shards": "auto",
+        "phases": [
+            {"name": "warmup", "until_layer": 6,
+             "traffic": {"storm": {"publishers": 4, "messages": 12,
+                                   "interval": 0.25},
+                         "tx_spawn": {}},
+             "asserts": [
+                 {"kind": "storm_coverage", "min_fraction": 0.9},
+             ]},
+            # the eclipse holds from layer 6 through 11 — across the
+            # epoch boundary at layer 8, the window where an isolated
+            # node's ATX/beacon view is most poisonable
+            {"name": "eclipse", "until_layer": 11,
+             "faults": [
+                 {"kind": "eclipse", "victim": ["full", 3],
+                  "attackers": attackers},
+                 {"kind": "adversary", "what": "malformed_atx",
+                  "count": 8, "via": 40},
+                 {"kind": "adversary", "what": "torsion_sig",
+                  "count": 4, "via": 41},
+             ],
+             "traffic": {"storm": {"publishers": 4, "messages": 8,
+                                   "interval": 0.4}}},
+            {"name": "heal",
+             "faults": [
+                 {"kind": "clear_eclipse", "victim": ["full", 3]},
+                 {"kind": "heal"},
+             ],
+             "converge": {"frontier": 12, "deadline": 240.0},
+             "asserts": [
+                 {"kind": "converged", "frontier": 12},
+                 {"kind": "progress", "min_layer": 12},
+                 {"kind": "hub_stat", "name": "rejected", "min": 1},
+                 {"kind": "sli_present", "name": "layer_apply_p99"},
+                 {"kind": "slo_green"},
+             ]},
+        ],
+    }
+
+
+def soak_epochs(seed: int = 37, light: int = 252) -> dict:
+    """The multi-epoch soak (tier-2): three and a half epochs of
+    continuous storm + VM transaction traffic on the sharded fabric,
+    with STATE-ROOT EQUALITY asserted at every epoch boundary — the
+    drift detector for slow divergence that single-epoch drills can't
+    see — plus green windowed SLOs over the whole run."""
+    return {
+        "name": "soak-epochs", "seed": seed,
+        "nodes": {"full": 4, "light": light,
+                  "identities": [3, 1, 1, 1]},
+        "layer_sec": 2.0, "lpe": 8, "until_layer": 30,
+        "digest_frontier": 26,
+        "shards": "auto",
+        "topology": {"degree": 6, "gossip_degree": 4},
+        "phases": [
+            {"name": "soak", "until_layer": 28,
+             "traffic": {"storm": {"publishers": 8, "messages": 64,
+                                   "interval": 0.5},
+                         "tx_spawn": {}}},
+            {"name": "end",
+             "converge": {"frontier": 26, "deadline": 360.0},
+             "asserts": [
+                 {"kind": "converged", "frontier": 26},
+                 {"kind": "progress", "min_layer": 26},
+                 {"kind": "epoch_roots", "upto_layer": 26},
+                 {"kind": "storm_coverage", "min_fraction": 0.9},
+                 {"kind": "sli_present", "name": "layer_apply_p99"},
+                 {"kind": "slo_green"},
              ]},
         ],
     }
@@ -591,7 +754,10 @@ _BUILTINS = {
     "partition-heal": partition_heal,
     "storm-256": storm_256,
     "storm-1024": storm_1024,
+    "storm-4096": storm_4096,
     "storm-512-bench": storm_512_bench,
+    "eclipse-campaign": eclipse_campaign,
+    "soak-epochs": soak_epochs,
     "crash-store": crash_store,
     "byzantine-verifyd": byzantine_verifyd,
     "timeskew-kill": timeskew_kill,

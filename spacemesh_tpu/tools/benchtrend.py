@@ -11,8 +11,8 @@ This tool diffs the CURRENT run's metric lines against the LATEST
 committed ``BENCH_*.json`` and exits non-zero on any >10% drop.
 
 What is compared — RATIO fields, not absolute rates, by default:
-``vs_sequential``, ``vs_single``, ``vs_serial``, ``vs_baseline`` and
-``speedup``.  Absolute labels/s are a property of the machine (a CI
+``vs_sequential``, ``vs_single``, ``vs_serial``, ``vs_baseline``,
+``vs_legacy``, ``vs_single_process`` and ``speedup``.  Absolute labels/s are a property of the machine (a CI
 runner generation swap would trip an absolute gate with no code
 change), while the ratios are self-calibrated — both sides of each
 ratio are measured in the same process on the same host, so a drop
@@ -49,7 +49,7 @@ import re
 import sys
 
 RATIO_FIELDS = ("vs_sequential", "vs_single", "vs_serial", "vs_baseline",
-                "vs_legacy", "speedup")
+                "vs_legacy", "vs_single_process", "speedup")
 GATE_FLAGS = ("bit_identical", "verified")
 
 _SUFFIX = re.compile(r"(_n\d+)?(_b\d+)?(_cpufallback)?$")

@@ -665,6 +665,21 @@ sim_fabric_cache = REGISTRY.counter(
     "fault-epoch cache lookups on reachable()/neighbors() "
     "(labels: result=hit|miss)")
 
+# sharded fabric (sim/shard.py): the multi-process event wheel's
+# conservative-window exchange plane
+sim_shard_events = REGISTRY.counter(
+    "sim_shard_events_total",
+    "per-shard event-wheel activity merged at finalize "
+    "(labels: shard, kind=fired)")
+sim_shard_barrier_waits = REGISTRY.counter(
+    "sim_shard_barrier_waits_total",
+    "cross-shard exchange rounds (settlements + window grants) — the "
+    "synchronization cost of the conservative protocol")
+sim_shard_imbalance = REGISTRY.gauge(
+    "sim_shard_imbalance_ratio",
+    "(max - min) / max of events fired across shards at finalize — "
+    "0 is a perfectly balanced partition")
+
 # runtime sanitizers (utils/sanitize.py, SPACEMESH_SANITIZE=1): each
 # recorded violation — a slow event-loop callback, an off-thread
 # instrument creation, an off-bucket jit dispatch — counts here so a

@@ -25,6 +25,13 @@ Two interactions with external reality:
 - No timers at all: the loop is waiting on pure external IO (a
   subprocess pipe, a real socket) — fall back to a short real wait
   instead of spinning.
+- With a `time_governor` attached (sharded scenario fabric), executor
+  completions are additionally SEQUENCED: each future completes at a
+  loop-idle point, in submission order, one per idle. Raw completion
+  order is an OS-scheduling race, and the governor's real-time pipe
+  round-trips make that race actually flip between runs; since virtual
+  time is frozen anyway, picking the deterministic schedule is always
+  legal and makes sharded replay byte-identical.
 
 Components must read time from the loop for this to work: `App`
 accepts `time_source` and wires it through to LayerClock, hare, and
@@ -46,6 +53,27 @@ class VirtualClockLoop(asyncio.SelectorEventLoop):
         self._vtime = start
         self._busy_threads = 0
         self._io_streak = 0
+        # Sequenced executor releases (governor mode only): real threads
+        # finish in OS-scheduling order, and WHICH ready batch their
+        # wake-up lands in is a wall-clock race. Single-process sims are
+        # stable because nothing else perturbs real timing, but a shard
+        # governor blocks the loop on worker pipes for real milliseconds,
+        # so completions bunch and the race starts flipping replay runs.
+        # Under a governor every executor future is therefore completed
+        # at a loop-idle point, in submission order, one per idle — a
+        # deterministic schedule that is always legal because virtual
+        # time freezes while any thread is outstanding.
+        self._exec_seq = 0              # next submission id
+        self._exec_next = 0             # next id allowed to complete
+        self._exec_results: dict[int, tuple] = {}   # id -> (result, exc)
+        self._exec_futs: dict[int, asyncio.Future] = {}
+        # Optional conservative-window governor (sim/shard.py): called as
+        # governor(now, proposed) -> target before any idle time jump.
+        # Returning a target < proposed holds the clock at a barrier (a
+        # cross-shard window edge); returning None falls back to a short
+        # real wait (external IO pending). The hook lives HERE so
+        # ChaosClockLoop's extra select wrapper composes with it.
+        self.time_governor = None
         # CRITICAL: asyncio fires a timer when `when < time() + resolution`.
         # The default resolution (1 ns) is BELOW one float64 ulp at
         # unix-epoch magnitudes (~4.8e-7 at 1.7e9), so `time() + 1e-9`
@@ -59,12 +87,39 @@ class VirtualClockLoop(asyncio.SelectorEventLoop):
             events = orig_select(0)
             if not events:
                 self._io_streak = 0
+                if self._exec_next < self._exec_seq:
+                    # sequenced executor work in flight: time stays
+                    # frozen, and the next completion (in submission
+                    # order) is released only at a true idle point —
+                    # never while ready callbacks are pending
+                    if not self._ready:
+                        entry = self._exec_results.pop(
+                            self._exec_next, None)
+                        if entry is not None:
+                            fut = self._exec_futs.pop(self._exec_next)
+                            self._exec_next += 1
+                            result, exc = entry
+                            if not fut.done():
+                                if exc is not None:
+                                    fut.set_exception(exc)
+                                else:
+                                    fut.set_result(result)
+                        else:
+                            events = orig_select(0.002)
+                    return events
                 if self._busy_threads > 0:
                     # real work in flight: do NOT advance virtual time —
                     # wait for the thread's wake-up on the self-pipe
                     events = orig_select(0.002)
                 elif timeout is None:
-                    # no timers scheduled at all: waiting on external IO
+                    # no timers scheduled at all: waiting on external IO —
+                    # but a governor may install fresh timers (cross-shard
+                    # frames arriving at a window barrier)
+                    if self.time_governor is not None:
+                        target = self.time_governor(self._vtime, None)
+                        if target is not None and target > self._vtime:
+                            self._vtime = target + 1e-6
+                            return events
                     events = orig_select(0.005)
                 elif timeout > 0:
                     # the 1 µs overshoot matters: _run_once fires timers
@@ -72,7 +127,13 @@ class VirtualClockLoop(asyncio.SelectorEventLoop):
                     # at unix-epoch magnitudes (1.7e9) one float64 ulp is
                     # ~4.8e-7 — landing EXACTLY on the deadline rounds the
                     # comparison into a never-firing busy spin
-                    self._vtime += timeout + 1e-6
+                    proposed = self._vtime + timeout + 1e-6
+                    if self.time_governor is not None:
+                        target = self.time_governor(self._vtime, proposed)
+                        if target is not None:
+                            proposed = max(
+                                self._vtime, min(target + 1e-6, proposed))
+                    self._vtime = proposed
             else:
                 # timer-starvation guard: an fd that stays ready without
                 # its callback making progress (e.g. a half-closed
@@ -96,14 +157,36 @@ class VirtualClockLoop(asyncio.SelectorEventLoop):
         self._vtime += dt
 
     def run_in_executor(self, executor, func, *args):
-        fut = super().run_in_executor(executor, func, *args)
-        self._busy_threads += 1
+        if self.time_governor is None:
+            fut = super().run_in_executor(executor, func, *args)
+            self._busy_threads += 1
 
-        def _done(_):
-            self._busy_threads -= 1
+            def _done(_):
+                self._busy_threads -= 1
 
-        fut.add_done_callback(_done)
+            fut.add_done_callback(_done)
+            return fut
+        # governor mode: park the raw completion and let select()
+        # release it at an idle point, in submission order
+        seq = self._exec_seq
+        self._exec_seq += 1
+        fut = self.create_future()
+        self._exec_futs[seq] = fut
+
+        def _job():
+            try:
+                entry = (func(*args), None)
+            except BaseException as exc:   # delivered via the future
+                entry = (None, exc)
+            self._exec_results[seq] = entry
+            self.call_soon_threadsafe(self._exec_wake)
+
+        super().run_in_executor(executor, _job)
         return fut
+
+    def _exec_wake(self) -> None:
+        """No-op loop wake so a parked completion is noticed promptly
+        even while select() is in a real 2 ms poll."""
 
 
 class ChaosClockLoop(VirtualClockLoop):

@@ -107,6 +107,58 @@ def test_crash_store_restart_recovers_surviving_stores(tmp_path):
     assert kinds["progress"]["ok"]
 
 
+def test_eclipse_campaign_rejects_and_reconverges(tmp_path):
+    """Eclipse a minority full across the epoch boundary while attacker
+    lights feed it malformed ATXs: every hostile payload dies as a
+    TYPED rejection, the victim re-syncs to zero divergence after the
+    eclipse clears, and the run replays byte-identically (ISSUE 19)."""
+    a = run_scenario(builtin("eclipse-campaign"), tmp=tmp_path / "a")
+    assert a.ok, [x for x in a.asserts if not x["ok"]]
+    kinds = {x["kind"]: x for x in a.asserts}
+    assert kinds["converged"]["ok"], kinds["converged"]
+    assert kinds["hub_stat"]["ok"], kinds["hub_stat"]
+    assert kinds["hub_stat"]["value"] >= 1, \
+        "no adversarial payload was ever rejected"
+    assert kinds["slo_green"]["ok"], kinds["slo_green"]
+    for needle in ("fault phase=eclipse eclipse victim=",
+                   "adversary what=malformed_atx",
+                   "fault phase=heal clear_eclipse"):
+        assert any(needle in line for line in a.events), needle
+    b = run_scenario(builtin("eclipse-campaign"), tmp=tmp_path / "b")
+    assert b.ok
+    assert a.digest == b.digest
+
+
+@pytest.mark.slow
+def test_soak_epochs_state_roots_agree_at_every_boundary(tmp_path):
+    """The multi-epoch soak (tier-2): 3.5 epochs of storm + VM tx
+    traffic on the sharded fabric; state roots must agree across the
+    live fulls at EVERY epoch boundary and the windowed SLOs stay
+    green — the slow-divergence drift detector (ISSUE 19)."""
+    r = run_scenario(builtin("soak-epochs"), tmp=tmp_path)
+    assert r.ok, [x for x in r.asserts if not x["ok"]]
+    kinds = {x["kind"]: x for x in r.asserts}
+    assert kinds["epoch_roots"]["ok"], kinds["epoch_roots"]
+    assert len(kinds["epoch_roots"]["value"]["epoch_layers"]) >= 3, \
+        "fewer than three epoch boundaries were checked"
+    assert not kinds["epoch_roots"]["value"]["diverged"]
+    assert kinds["slo_green"]["ok"], kinds["slo_green"]
+    assert kinds["converged"]["ok"]
+
+
+@pytest.mark.slow
+def test_storm_4096_runs_on_the_sharded_fabric(tmp_path):
+    """The four-thousand-node drill (tier-2): storm-1024's geometry at
+    4x the relay population, affordable only with the event wheel
+    sharded over host cores (ISSUE 19)."""
+    r = run_scenario(builtin("storm-4096"), tmp=tmp_path)
+    assert r.ok, [x for x in r.asserts if not x["ok"]]
+    kinds = {x["kind"]: x for x in r.asserts}
+    assert kinds["converged"]["ok"], kinds["converged"]
+    assert kinds["slo_green"]["ok"]
+    assert r.stats["hub"]["delivered"] > 400_000
+
+
 @pytest.mark.slow
 def test_storm_256_replay_is_byte_identical(tmp_path):
     """The acceptance determinism clause at full scale (tier-2: two

@@ -1,0 +1,190 @@
+"""Sharded scenario fabric (ISSUE 19, spacemesh_tpu/sim/shard.py).
+
+Barrier math at the unit level (the safe horizon may never release a
+frame before its link-delay floor), the W-invariance contract on a
+clean-link world (W=1 and W=4 land identical assertion outcomes AND
+identical merged digests), and the crash discipline (a worker killed
+mid-window is a TYPED scenario failure, never a hang). The full-size
+sharded drills (storm-1024 --shards 2, storm-4096, soak-epochs) live in
+tests/test_sim_scenarios.py and the storm-smoke CI job.
+"""
+
+import time
+
+import pytest
+
+from spacemesh_tpu.sim import builtin, run_scenario
+from spacemesh_tpu.sim.net import LinkPolicy, SimNetwork
+from spacemesh_tpu.sim import shard as shard_mod
+from spacemesh_tpu.sim.shard import (ShardWorker, ShardedMeshHub,
+                                     resolve_shards)
+
+
+@pytest.fixture(autouse=True)
+def _no_env_override(monkeypatch):
+    monkeypatch.delenv("SPACEMESH_SIM_SHARDS", raising=False)
+
+
+# --- resolve_shards ----------------------------------------------------
+
+
+def test_resolve_shards_w1_collapse_and_auto(monkeypatch):
+    assert resolve_shards(None, 1000) == 1
+    assert resolve_shards("", 1000) == 1
+    assert resolve_shards(1, 1000) == 1
+    assert resolve_shards("0", 1000) == 1
+    # explicit W honored even on a small host (tests force W=4)
+    assert resolve_shards(4, 1000) == 4
+    # "auto" = min(host cores, lights // 64); too few lights -> 1
+    assert resolve_shards("auto", 63) == 1
+    cores = len(__import__("os").sched_getaffinity(0))
+    assert resolve_shards("auto", 64 * (cores + 2)) == cores
+    # env beats the script
+    monkeypatch.setenv("SPACEMESH_SIM_SHARDS", "3")
+    assert resolve_shards(None, 1000) == 3
+    assert resolve_shards("auto", 1000) == 3
+
+
+def test_resolve_shards_clamps_to_light_population():
+    # every worker shard must own at least one light
+    assert resolve_shards(64, 2) == 3
+
+
+# --- barrier math: the delay floor is the lookahead --------------------
+
+
+def test_min_delay_floor_is_min_over_policies():
+    net = SimNetwork(1)
+    a, b = b"a" * 32, b"b" * 32
+    net.add_node(a)
+    net.add_node(b)
+    net.default_policy = LinkPolicy(delay=0.05, jitter=0.3)
+    assert net.min_delay_floor() == pytest.approx(0.05)
+    # a single faster link drags the floor down — jitter never counts
+    net.set_link_policy(LinkPolicy(delay=0.01, jitter=0.5), a, b)
+    assert net.min_delay_floor() == pytest.approx(0.01)
+    net.set_link_policy(LinkPolicy(delay=0.2), a, b)
+    assert net.min_delay_floor() == pytest.approx(0.05)
+
+
+def _two_shard_snap(delay: float) -> dict:
+    """A 2-worker world: lights a,b on shard 1, light c on shard 2."""
+    a, b, c = b"a" * 32, b"b" * 32, b"c" * 32
+    names = [a, b, c]
+    adj = {a: [b, c], b: [a, c], c: [a, b]}
+    return dict(
+        seed=7, degree=6, shards=3, gossip_degree=4, shard=1,
+        names=names, adj=adj, group={}, down=[], eclipsed={},
+        blocked=[], default_policy=dict(
+            loss=0.0, delay=delay, jitter=0.0, dup=0.0, reorder=0.0,
+            reorder_delay=0.0),
+        link_policy=[], shard_of={a: 1, b: 1, c: 2}, owned=[a, b])
+
+
+def test_worker_frames_never_beat_the_delay_floor():
+    """Every frame a worker emits at instant t arrives at >= t + floor —
+    the inequality the safe horizon [N, N+L) leans on."""
+    delay = 0.05
+    w = ShardWorker(_two_shard_snap(delay))
+    t = 1.0
+    nxt, out = w.run(t, True, [("publish", t, b"a" * 32, "storm",
+                                b"payload")], [])
+    # the publish fired and relayed: everything bound for shard 2 is
+    # stamped at or after t + floor, and the worker's own wheel holds
+    # nothing before it either
+    assert w.stats["published"] == 1
+    assert out, "no cross-shard frame left the worker"
+    assert all(arrival >= t + delay - 1e-12
+               for arrival, _, _, _ in out)
+    assert nxt >= t + delay - 1e-12
+
+
+def test_worker_window_is_exclusive_of_the_horizon():
+    """run(horizon, inclusive=False) must NOT fire a frame sitting
+    exactly at the horizon — that instant belongs to the next window."""
+    delay = 0.05
+    w = ShardWorker(_two_shard_snap(delay))
+    t = 1.0
+    frame = ("msg", b"c" * 32, ("storm", b"m" * 32, b"payload"))
+    # a frame addressed to owned light a, arriving exactly at t + delay
+    nxt, out = w.run(t + delay, False, [],
+                     [(t + delay, b"a" * 32, frame)])
+    assert w.stats["delivered"] == 0
+    assert nxt == pytest.approx(t + delay)
+    # granting the instant itself (inclusive settle) delivers it
+    nxt, out = w.run(t + delay, True, [], [])
+    assert w.stats["delivered"] == 1
+
+
+# --- W-invariance on a clean-link world --------------------------------
+
+
+def test_w1_and_w4_agree_on_digest_and_asserts(tmp_path):
+    """The loss-free world draws nothing from any link RNG, so flood
+    coverage is arrival-order invariant: W=1 (plain in-process fabric)
+    and W=4 (three worker subprocesses) must land the IDENTICAL merged
+    digest and identical assertion outcomes."""
+    results = {}
+    for w in (1, 4):
+        script = builtin("smoke", light=6)
+        script["shards"] = w
+        results[w] = run_scenario(script, tmp=tmp_path / f"w{w}")
+    r1, r4 = results[1], results[4]
+    assert r1.ok, [a for a in r1.asserts if not a["ok"]]
+    assert r4.ok, [a for a in r4.asserts if not a["ok"]]
+    assert r1.digest == r4.digest
+    outcomes1 = [(a["phase"], a["kind"], a["ok"]) for a in r1.asserts]
+    outcomes4 = [(a["phase"], a["kind"], a["ok"]) for a in r4.asserts
+                 if a["kind"] != "shard_worker"]
+    assert outcomes1 == outcomes4
+
+
+def test_sharded_replay_is_byte_identical(tmp_path):
+    """Same (seed, W) => byte-identical digest, W > 1 included."""
+    digests = []
+    for run in ("a", "b"):
+        script = builtin("smoke", light=6)
+        script["shards"] = 2
+        digests.append(run_scenario(script, tmp=tmp_path / run).digest)
+    assert digests[0] == digests[1]
+
+
+# --- crash discipline --------------------------------------------------
+
+
+def test_worker_crash_is_typed_failure_not_hang(tmp_path, monkeypatch):
+    """Kill a worker process mid-window: the run must come back quickly
+    with ok=False and a typed shard_worker assertion — the pipe EOF is
+    translated to ShardWorkerCrash, never waited out."""
+    calls = {"n": 0}
+    orig = ShardedMeshHub._flush_and_run
+
+    def killer(self, need, upto, inclusive):
+        calls["n"] += 1
+        if calls["n"] == 5:
+            self._workers[0].proc.kill()
+        return orig(self, need, upto, inclusive)
+
+    monkeypatch.setattr(ShardedMeshHub, "_flush_and_run", killer)
+    script = builtin("smoke", light=6)
+    script["shards"] = 2
+    t0 = time.perf_counter()
+    r = run_scenario(script, tmp=tmp_path)
+    wall = time.perf_counter() - t0
+    assert calls["n"] >= 5, "the fabric never reached the kill window"
+    assert not r.ok
+    crash = [a for a in r.asserts if a["kind"] == "shard_worker"]
+    assert crash and not crash[0]["ok"]
+    assert wall < 120.0, f"crash handling took {wall:.0f}s (hang?)"
+
+
+def test_shard_module_is_importable_without_jax():
+    """Workers import spacemesh_tpu.sim.shard in a bare subprocess; a
+    jax import at module scope would multiply spawn cost by seconds."""
+    import subprocess
+    import sys
+    code = ("import sys; sys.modules['jax'] = None; "
+            "import spacemesh_tpu.sim.shard")
+    r = subprocess.run([sys.executable, "-c", code],
+                       capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stderr
