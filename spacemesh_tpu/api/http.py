@@ -612,7 +612,10 @@ class ApiServer:
             sync_state_gauge.set({SyncState.NOT_SYNCED: 0,
                                   SyncState.GOSSIP: 1,
                                   SyncState.SYNCED: 2}[n.syncer.state])
-        return web.Response(text=REGISTRY.expose(),
+        from ..obs.federate import FEDERATION
+
+        # local registry, then every federated child's proc= series
+        return web.Response(text=REGISTRY.expose() + FEDERATION.expose(),
                             content_type="text/plain")
 
     # --- Events ------------------------------------------------------

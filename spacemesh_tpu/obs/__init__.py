@@ -21,11 +21,17 @@ NOW". This package does:
                    quarantine escalation, and the process-global
                    breaker/action-hook registries behind
                    ``/debug/remediation`` (docs/SELF_HEALING.md).
+* ``federate.py`` — the fleet collection plane: per-process metric
+                   snapshots re-exposed under ``proc=`` labels with
+                   strict cardinality hygiene, trace captures collected
+                   for ``tracing.merge_captures()``, crashed-process
+                   snapshots retained for forensics
+                   (docs/OBSERVABILITY.md § Fleet observability).
 
 docs/OBSERVABILITY.md documents the SLO spec format, the HTTP surface
 and the flight-bundle layout.
 """
 
-from . import flight, health, remediate, sli  # noqa: F401
+from . import federate, flight, health, remediate, sli  # noqa: F401
 
-__all__ = ["sli", "health", "flight", "remediate"]
+__all__ = ["sli", "health", "flight", "remediate", "federate"]

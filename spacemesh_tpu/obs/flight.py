@@ -13,6 +13,8 @@ timestamped bundle directory under the spool dir:
         metrics.prom    Registry.expose() text exposition
         events.json     recent EventBus emissions (bounded ring)
         health.json     the engine's readiness report at dump time
+        procs/<proc>/   per-federated-process trace.json + metrics.prom
+                        (obs/federate.py; only when children federated)
 
 Automatic dumps (engine tick transitions) are rate-limited to one per
 ``min_interval_s`` so a flapping SLO cannot fill the disk; the manual
@@ -48,6 +50,7 @@ TRACE = "trace.json"
 METRICS = "metrics.prom"
 EVENTS = "events.json"
 HEALTH = "health.json"
+PROCS = "procs"
 
 
 def _jsonable(obj):
@@ -135,6 +138,19 @@ class FlightRecorder:
                  for et, etype, ev in (events or [])]))
             (tmp / HEALTH).write_text(
                 json.dumps(_jsonable(health or {}), indent=1))
+            # fleet federation: every child process's last trace +
+            # proc=-labeled metrics land under procs/ so ONE bundle
+            # answers for the whole fleet, crashed workers included
+            from .federate import FEDERATION
+
+            for proc, ent in sorted(FEDERATION.flight_procs().items()):
+                pdir = tmp / PROCS / proc.replace("/", "_")
+                pdir.mkdir(parents=True, exist_ok=True)
+                if ent["trace"] is not None:
+                    (pdir / TRACE).write_text(json.dumps(ent["trace"]))
+                (pdir / METRICS).write_text(ent["metrics"])
+                if ent["crashed"]:
+                    (pdir / "CRASHED").write_text("retained snapshot\n")
             # durable publish (utils/fsio): fsync + atomic rename +
             # parent-dir fsync — the bundle an operator reaches for
             # after a crash must not itself be a casualty of the crash
@@ -185,17 +201,42 @@ def read_bundle(path) -> dict:
         if (p / EVENTS).exists() else []
     health = json.loads((p / HEALTH).read_text()) \
         if (p / HEALTH).exists() else {}
+    procs: dict = {}
+    procs_dir = p / PROCS
+    if procs_dir.is_dir():
+        for pdir in sorted(procs_dir.iterdir()):
+            if not pdir.is_dir():
+                continue
+            ptrace = None
+            if (pdir / TRACE).exists():
+                ptrace = json.loads((pdir / TRACE).read_text())
+                tracing.validate(ptrace)
+            procs[pdir.name] = {
+                "trace": ptrace,
+                "metrics": ((pdir / METRICS).read_text()
+                            if (pdir / METRICS).exists() else ""),
+                "crashed": (pdir / "CRASHED").exists(),
+            }
     return {"path": str(p), "manifest": manifest, "trace": trace,
             "metrics_samples": samples, "events": events,
-            "health": health}
+            "health": health, "procs": procs}
 
 
 def digest(bundle: dict, top: int = 10) -> dict:
-    """A render-ready summary of ``read_bundle()``'s output."""
+    """A render-ready summary of ``read_bundle()``'s output. When the
+    bundle carries federated ``procs/``, the trace summary runs over
+    the MERGED timeline (parent + every child capture) so per-proc
+    self-time and cross-process link counts appear in one table."""
     health = bundle.get("health") or {}
     components = health.get("components", {})
     slos = health.get("slos", {})
-    summary = tracing.summarize(bundle["trace"], top=top)
+    procs = bundle.get("procs") or {}
+    child_traces = [ent["trace"] for _, ent in sorted(procs.items())
+                    if ent.get("trace") is not None]
+    doc = bundle["trace"]
+    if child_traces:
+        doc = tracing.merge_captures([doc] + child_traces)
+    summary = tracing.summarize(doc, top=top)
     return {
         "bundle": bundle["path"],
         "reason": bundle["manifest"].get("reason"),
@@ -213,4 +254,13 @@ def digest(bundle: dict, top: int = 10) -> dict:
         "events": len(bundle["events"]),
         "trace_spans": summary["spans"],
         "trace_top_self_time": summary["top_self_time"][:top],
+        "procs": {name: {"crashed": ent.get("crashed", False),
+                         "spans": (ent["trace"]["otherData"].get(
+                             "captured_spans", 0)
+                             if ent.get("trace") else 0)}
+                  for name, ent in sorted(procs.items())},
+        "proc_self_time": summary.get("procs", []),
+        "cross_proc_links": summary.get("cross_proc_links",
+                                        {"total": 0, "pairs": {}}),
+        "trace_warnings": summary.get("warnings", []),
     }

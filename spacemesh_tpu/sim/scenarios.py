@@ -225,6 +225,10 @@ def storm_1024(seed: int = 17, light: int = 1020) -> dict:
         # the default 64Ki ring would evict every mesh.process_layer
         # span before the heal-phase span asserts read them
         "trace_capacity": 1 << 19,
+        # when sharded, the merged capture must resolve at least one
+        # fabric.publish -> shard.publish cross-process parent edge
+        # (scenario.py appends merged_procs / cross_proc_links asserts)
+        "require_cross_proc_links": 1,
         "topology": {"degree": 6, "gossip_degree": 4},
         "phases": [
             {"name": "storm", "until_layer": 10,
@@ -721,6 +725,7 @@ def fleet(seed: int = 7) -> dict:
              "name": "fleet_replica_r0_shed_per_sec"},
             {"kind": "slo_green", "name": "fleet_block_p99",
              "target": 0.25},
+            {"kind": "merged_capture", "min_spans": 1},
         ],
     }
 

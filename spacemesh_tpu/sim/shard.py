@@ -56,8 +56,12 @@ from pathlib import Path
 
 from ..core.hashing import sum256
 from ..p2p.gossipmesh import SEEN_CAP, mark_seen, relay_sample
-from ..utils import metrics
+from ..utils import metrics, tracing
 from .net import EventMeshHub, LinkPolicy, SimNetwork
+
+# obs.federate is imported lazily inside PARENT-side methods only: the
+# worker subprocess must stay importable without jax (the obs package
+# drags in the health/SLI stack), and workers never touch FEDERATION.
 
 _LEN = struct.Struct("<I")
 _INF = float("inf")
@@ -66,10 +70,19 @@ _MAX_ROUNDS = 100_000  # runaway-exchange backstop, not a tuning knob
 
 
 class ShardWorkerCrash(RuntimeError):
-    """A shard worker process died mid-run (typed scenario failure)."""
+    """A shard worker process died mid-run (typed scenario failure).
 
-    def __init__(self, shard: int, detail: str = ""):
+    Carries the dead worker's last federated snapshot — the metrics
+    sample and trace capture it shipped most recently — so the typed
+    failure itself holds the forensics (docs/OBSERVABILITY.md § Fleet
+    observability). ``None`` when the worker died before its first
+    snapshot."""
+
+    def __init__(self, shard: int, detail: str = "",
+                 last_metrics=None, last_spans=None):
         self.shard = shard
+        self.last_metrics = last_metrics
+        self.last_spans = last_spans
         msg = f"sim shard worker {shard} crashed"
         super().__init__(msg + (f": {detail}" if detail else ""))
 
@@ -168,16 +181,28 @@ class ShardWorker:
         self.now = 0.0
         self._relay_cache: dict[tuple, tuple] = {}
         self.stats = dict.fromkeys(_STATS_KEYS, 0)
+        self.runs = 0
+        self.fed_every = int(snap.get("fed_every", 128))
+        if snap.get("trace"):
+            # worker-side capture: virtual-time spans in this worker's
+            # own ring, shipped to the parent's federation plane.
+            # Identity is set ONLY here — an in-process ShardWorker
+            # (unit tests) must not rename the host process.
+            tracing.set_process_identity(f"shard-{self.shard}",
+                                         clock_domain="virtual")
+            tracing.TRACER.start(capacity=snap.get("trace_capacity"),
+                                 jax_bridge=False)
 
     # -- fault-op replay (parent order == apply order) --
 
     def apply_op(self, op: tuple) -> None:
         kind = op[0]
         if kind == "publish":
-            _, instant, name, topic, data = op
+            instant, name, topic, data = op[1:5]
+            token = op[5] if len(op) > 5 else None
             heapq.heappush(self.wheel, (instant, next(self._seq), name,
                                         self.gen.get(name, 0),
-                                        ("pub", topic, data)))
+                                        ("pub", topic, data, token)))
             self.stats["events_scheduled"] += 1
         elif kind == "churn":
             name = op[1]
@@ -215,30 +240,55 @@ class ShardWorker:
             self.stats["events_scheduled"] += 1
         lim = upto + _EPS if inclusive else upto - _EPS
         wheel = self.wheel
+        fired0 = self.stats["events_fired"]
+        wstart = None
         while wheel and wheel[0][0] <= lim:
             instant, _, dst, gen, item = heapq.heappop(wheel)
             self.stats["events_fired"] += 1
+            if wstart is None:
+                wstart = instant
             self.now = instant
             if self.gen.get(dst) != gen:
                 self.stats["dropped"] += 1   # churned while in flight
                 continue
             kind = item[0]
             if kind == "pub":
-                self._publish(dst, item[1], item[2])
+                self._publish(dst, item[1], item[2],
+                              item[3] if len(item) > 3 else None)
             elif kind == "msg":
                 self._on_msg(dst, item[1], item[2])
             # "ctrl": light relays run no control plane — dropped, same
             # as EventMeshHub._on_ctrl's light short-circuit
+        fired = self.stats["events_fired"] - fired0
+        if fired and tracing.TRACER.enabled:
+            # one span per non-empty granted window, stamped in VIRTUAL
+            # microseconds — all wheels share one virtual clock, so the
+            # merged timeline aligns exactly across shards
+            ts0 = int(wstart * 1e6)
+            tracing.TRACER._record(
+                "shard.window", "sim", ts0,
+                max(int(self.now * 1e6) - ts0, 0),
+                next(tracing.TRACER._ids), None, {"fired": fired}, "X")
         out, self.out = self.out, []
         nxt = wheel[0][0] if wheel else _INF
         return nxt, out
 
     # -- light-relay semantics (mirror of EventMeshHub's light path) --
 
-    def _publish(self, name: bytes, topic: str, data: bytes) -> None:
+    def _publish(self, name: bytes, topic: str, data: bytes,
+                 token: str | None = None) -> None:
         msg_id = sum256(topic.encode(), data)
         mark_seen(self.seen[name], msg_id, SEEN_CAP)
         self.stats["published"] += 1
+        if tracing.TRACER.enabled:
+            attrs: dict = {"topic": topic}
+            if token:
+                # the parent's fabric.publish link token — resolved to a
+                # cross-process parent edge by merge_captures()
+                attrs["link"] = token
+            tracing.TRACER._record(
+                "shard.publish", "sim", int(self.now * 1e6), 0,
+                next(tracing.TRACER._ids), None, attrs, "X")
         frame = (topic, msg_id, data)
         for dst in self._relay_targets(name, topic):
             self._send(name, dst, ("msg", name, frame))
@@ -300,6 +350,29 @@ class ShardWorker:
             else:
                 self.out.append((arrival, next(self._out_seq), dst, item))
 
+    # -- federation snapshots (docs/OBSERVABILITY.md § Fleet obs) --
+
+    def fed_snapshot(self) -> dict:
+        """This worker's full registry sample + trace capture, shipped
+        over the pipe for the parent's ``obs.federate`` plane."""
+        for k, v in self.stats.items():
+            metrics.sim_shard_worker_stats.set(
+                float(v), shard=str(self.shard), stat=k)
+        return {
+            "metrics": metrics.REGISTRY.sample(),
+            "trace": tracing.export() if tracing.TRACER.enabled else None,
+        }
+
+    def maybe_fed(self) -> dict | None:
+        """Periodic snapshot piggybacked on run replies — the FIRST
+        window always ships one, so a worker that crashes early still
+        leaves last-known forensics behind, then every ``fed_every``
+        windows after that."""
+        self.runs += 1
+        if self.runs == 1 or self.runs % self.fed_every == 0:
+            return self.fed_snapshot()
+        return None
+
 
 def worker_main() -> int:   # pragma: no cover — exercised via subprocess
     stdin = sys.stdin.buffer
@@ -316,7 +389,7 @@ def worker_main() -> int:   # pragma: no cover — exercised via subprocess
             if kind == "run":
                 _, upto, inclusive, ops, frames = msg
                 nxt, out = w.run(upto, inclusive, ops, frames)
-                _write_msg(stdout, ("done", nxt, out))
+                _write_msg(stdout, ("done", nxt, out, w.maybe_fed()))
             elif kind == "counts":
                 topic = msg[1]
                 _write_msg(stdout, ("counts", {
@@ -324,7 +397,8 @@ def worker_main() -> int:   # pragma: no cover — exercised via subprocess
                     if t == topic}))
             elif kind == "finalize":
                 _write_msg(stdout, ("final", dict(w.stats),
-                                    dict(w.counts), dict(w.net.stats)))
+                                    dict(w.counts), dict(w.net.stats),
+                                    w.fed_snapshot()))
             elif kind == "exit":
                 return 0
             else:
@@ -337,7 +411,8 @@ def worker_main() -> int:   # pragma: no cover — exercised via subprocess
 
 
 class _Worker:
-    __slots__ = ("shard", "proc", "next", "ops_cursor", "pending")
+    __slots__ = ("shard", "proc", "next", "ops_cursor", "pending",
+                 "last_fed")
 
     def __init__(self, shard: int, proc):
         self.shard = shard
@@ -345,6 +420,7 @@ class _Worker:
         self.next = _INF          # earliest pending instant, as reported
         self.ops_cursor = 0       # index into the hub's fault-op log
         self.pending: list = []   # frames awaiting flush (arrival, seq, dst, item)
+        self.last_fed = None      # last federated snapshot (crash forensics)
 
 
 class ShardedMeshHub(EventMeshHub):
@@ -371,6 +447,8 @@ class ShardedMeshHub(EventMeshHub):
         self._counts: dict[tuple, int] = {}
         self._final: list | None = None
         self.barrier_rounds = 0
+        self.fed_every = 128      # worker snapshot cadence (windows)
+        self.worker_captures: dict[str, dict] = {}
         network.listener = self._on_net_mutation
 
     # -- membership: lights round-robin onto workers by join index --
@@ -412,8 +490,14 @@ class ShardedMeshHub(EventMeshHub):
         if not self.network.alive(name):
             return
         loop = asyncio.get_running_loop()
+        # the publish op carries a link token so the worker's
+        # shard.publish span can parent to this fabric.publish span
+        # across the process boundary (merge_captures resolves it)
+        with tracing.span("fabric.publish", {"topic": topic}, cat="sim"):
+            token = tracing.link_token()
         # spacecheck: ok=SC001 virtual publish instant from the engine's VirtualClockLoop
-        self._ops_log.append(("publish", loop.time(), name, topic, data))
+        self._ops_log.append(("publish", loop.time(), name, topic, data,
+                              token))
 
     def _send(self, src: bytes, dst: bytes, item: tuple) -> None:
         shard = self._shard_of.get(dst, 0)
@@ -477,6 +561,9 @@ class ShardedMeshHub(EventMeshHub):
             link_policy=[(sorted(pair), dataclasses.asdict(pol))
                          for pair, pol in net.link_policy.items()],
             shard_of=dict(self._shard_of),
+            trace=tracing.TRACER.enabled,
+            trace_capacity=tracing.TRACER.capacity,
+            fed_every=self.fed_every,
         )
         # the snapshot covers every NETWORK mutation so far, so those ops
         # must not be applied twice — but publish ops are data, not
@@ -507,9 +594,19 @@ class ShardedMeshHub(EventMeshHub):
             self._prespawn = {}
 
     def close(self) -> None:
-        """Terminate every worker (engine teardown; idempotent)."""
+        """Terminate every worker (engine teardown; idempotent). Clean
+        workers' federated ``proc=`` series are dropped here — the
+        cardinality-hygiene half of the federation contract — while a
+        CRASHED worker's snapshot stays retained and flagged."""
         self.network.listener = None
         workers, self._workers = self._workers, []
+        if workers:
+            from ..obs.federate import FEDERATION
+            crashed = (self._crashed.shard
+                       if self._crashed is not None else None)
+            for w in workers:
+                if w.shard != crashed:
+                    FEDERATION.drop(f"shard-{w.shard}")
         for w in workers:
             try:
                 _write_msg(w.proc.stdin, ("exit",))
@@ -525,19 +622,37 @@ class ShardedMeshHub(EventMeshHub):
 
     # -- pipe helpers with typed crash translation --
 
+    def _crash(self, w: _Worker, detail: str) -> ShardWorkerCrash:
+        """Build the typed crash carrying the dead worker's last
+        federated snapshot, and flag (not drop) its federation entry."""
+        fed = w.last_fed or {}
+        from ..obs.federate import FEDERATION
+        FEDERATION.mark_crashed(f"shard-{w.shard}")
+        self._crashed = ShardWorkerCrash(
+            w.shard, detail,
+            last_metrics=fed.get("metrics"),
+            last_spans=fed.get("trace"))
+        return self._crashed
+
+    def _federate(self, w: _Worker, fed: dict | None) -> None:
+        if fed is None:
+            return
+        w.last_fed = fed
+        from ..obs.federate import FEDERATION
+        FEDERATION.update_from_samples(
+            f"shard-{w.shard}", fed["metrics"], trace=fed.get("trace"))
+
     def _ssend(self, w: _Worker, msg: tuple) -> None:
         try:
             _write_msg(w.proc.stdin, msg)
         except (OSError, ValueError) as e:
-            self._crashed = ShardWorkerCrash(w.shard, repr(e))
-            raise self._crashed from None
+            raise self._crash(w, repr(e)) from None
 
     def _recv(self, w: _Worker):
         try:
             return _read_msg(w.proc.stdout)
         except (EOFError, OSError) as e:
-            self._crashed = ShardWorkerCrash(w.shard, repr(e))
-            raise self._crashed from None
+            raise self._crash(w, repr(e)) from None
 
     # -- the conservative-window exchange plane --
 
@@ -563,9 +678,10 @@ class ShardedMeshHub(EventMeshHub):
             self._ssend(w, ("run", upto, inclusive, ops, frames))
         local_new = False
         for w in need:
-            tag, nxt, out = self._recv(w)
+            tag, nxt, out, fed = self._recv(w)
             if tag != "done":
                 raise ShardWorkerCrash(w.shard, f"bad reply {tag!r}")
+            self._federate(w, fed)
             w.next = nxt
             for arrival, _, dst, item in sorted(out):
                 dshard = self._shard_of.get(dst, 0)
@@ -682,9 +798,12 @@ class ShardedMeshHub(EventMeshHub):
         for w in self._workers:
             self._ssend(w, ("finalize",))
         for w in self._workers:
-            tag, stats, counts, netstats = self._recv(w)
+            tag, stats, counts, netstats, fed = self._recv(w)
             if tag != "final":
                 raise ShardWorkerCrash(w.shard, f"bad reply {tag!r}")
+            self._federate(w, fed)
+            if fed and fed.get("trace") is not None:
+                self.worker_captures[f"shard-{w.shard}"] = fed["trace"]
             self._final.append((w.shard, stats))
             fired.append(stats["events_fired"])
             for k, v in stats.items():

@@ -37,6 +37,14 @@ Usage (CI: .github/workflows/tier1.yml mesh-smoke / runtime-smoke):
 Options: ``--baseline <file>`` (default: latest BENCH_*.json in the
 repo root), ``--drop 0.10``, ``--absolute``, ``--require <family>``
 (fail if the family is absent from the current run; repeatable).
+
+``--history`` renders the FULL committed trajectory instead of gating:
+every BENCH_r01..rNN in round order, one table per metric family with
+the absolute rate and each ratio column, a ``v`` marker on any
+round-over-round drop beyond ``--drop``. The gate only ever compares
+against the latest round, so a slow leak (-5% per round for five
+rounds) is invisible to it — the history view is where that trend
+shows up. Report-only: always exits 0.
 """
 
 from __future__ import annotations
@@ -105,6 +113,72 @@ def baseline_lines(path: str) -> dict[str, dict]:
     return lines
 
 
+def all_baselines(root: str) -> list[tuple[int, str]]:
+    """Every committed BENCH_*.json as (round, path), round order."""
+    rounds = []
+    for path in glob.glob(os.path.join(root, "BENCH_*.json")):
+        try:
+            n = int(json.load(open(path, encoding="utf-8")).get("n", -1))
+        except (OSError, ValueError):
+            continue
+        if n >= 0:
+            rounds.append((n, path))
+    return sorted(rounds)
+
+
+def history(root: str, *, drop: float = 0.10) -> dict:
+    """Per-family trajectory across ALL committed rounds.
+
+    -> {"rounds": [n, ...], "families": {family: [row, ...]}} where each
+    row carries the round, the absolute ``value``, every ratio field the
+    line had, and ``regressed``: the fields that fell more than ``drop``
+    vs the PREVIOUS round the family appeared in."""
+    fams: dict[str, list[dict]] = {}
+    rounds = all_baselines(root)
+    for n, path in rounds:
+        try:
+            lines = baseline_lines(path)
+        except (OSError, ValueError):
+            continue
+        for fam, doc in lines.items():
+            row: dict = {"round": n}
+            for f in ("value",) + RATIO_FIELDS:
+                if isinstance(doc.get(f), (int, float)):
+                    row[f] = float(doc[f])
+            fams.setdefault(fam, []).append(row)
+    for rows in fams.values():
+        prev: dict | None = None
+        for row in rows:
+            row["regressed"] = [
+                f for f, v in row.items()
+                if f != "round" and isinstance(v, float) and prev
+                and isinstance(prev.get(f), float) and prev[f] > 0
+                and v < prev[f] * (1.0 - drop)]
+            prev = row
+    return {"rounds": [n for n, _ in rounds],
+            "families": dict(sorted(fams.items()))}
+
+
+def render_history(doc: dict) -> str:
+    """Text tables (stderr view) for ``history()``'s output."""
+    out = []
+    for fam, rows in doc["families"].items():
+        fields = [f for f in ("value",) + RATIO_FIELDS
+                  if any(f in r for r in rows)]
+        out.append(f"{fam}:")
+        out.append("  round" + "".join(f"{f:>20}" for f in fields))
+        for r in rows:
+            cells = []
+            for f in fields:
+                v = r.get(f)
+                cell = "-" if v is None else f"{v:,.4g}"
+                if f in r["regressed"]:
+                    cell += " v"
+                cells.append(f"{cell:>20}")
+            out.append(f"  r{r['round']:<4}" + "".join(cells))
+    return "\n".join(out) if out else "(no BENCH_*.json rounds found)"
+
+
 def compare(base: dict[str, dict], cur: dict[str, dict], *,
             drop: float = 0.10, absolute: bool = False) -> dict:
     """-> {"failures": [...], "compared": [...], "only_*": [...]}."""
@@ -143,9 +217,13 @@ def main(argv=None) -> int:
         prog="benchtrend",
         description="fail on >10%% drops vs the last committed "
                     "BENCH_*.json (ratio fields; see module docstring)")
-    ap.add_argument("--current", required=True,
+    ap.add_argument("--current", default=None,
                     help="file of bench.py stdout (JSON metric lines); "
-                    "'-' reads stdin")
+                    "'-' reads stdin (required unless --history)")
+    ap.add_argument("--history", action="store_true",
+                    help="render the full BENCH_r01..rNN trajectory per "
+                    "metric family (rate + ratio columns, 'v' regression "
+                    "markers) instead of gating; always exits 0")
     ap.add_argument("--baseline", default=None,
                     help="baseline BENCH_*.json (default: highest-round "
                     "BENCH_*.json under --root)")
@@ -160,6 +238,14 @@ def main(argv=None) -> int:
                     help="metric family that must be present in the "
                     "current run (repeatable)")
     a = ap.parse_args(argv)
+
+    if a.history:
+        doc = history(a.root, drop=a.drop)
+        _log(render_history(doc))
+        print(json.dumps(doc, indent=1))
+        return 0
+    if not a.current:
+        ap.error("--current is required unless --history")
 
     base_path = a.baseline or latest_baseline(a.root)
     if base_path is None:

@@ -237,6 +237,41 @@ def prove_benchmark(labels: int, batch: int,
     return doc
 
 
+def romix_roofline(n: int, r: int = 1, p: int = 1,
+                   labels_per_sec: float | None = None,
+                   gbps: float | None = None) -> dict:
+    """Analytic memory-traffic roofline for one scrypt label.
+
+    ROMix moves the V scratch exactly twice per label: the fill phase
+    writes all N blocks of 128*r bytes, the mix phase reads N blocks
+    back in data-dependent order — 2*128*r*N bytes per label per
+    parallel chunk (p). Compute cost is 2 BlockMix passes of 2*r
+    Salsa20/8 cores each: 4*N*r*p Salsa20/8 calls per label. Both
+    follow from N/r/p alone, so a measured labels/s converts directly
+    into achieved DRAM/HBM bandwidth and (given a peak, via ``gbps``
+    or ``SPACEMESH_ROOFLINE_GBPS``) a utilization fraction — the
+    number that says whether the kernel is bandwidth-bound or leaving
+    the memory system idle."""
+    n, r, p = int(n), int(r), int(p)
+    bytes_per_label = 2 * 128 * r * n * p
+    out = {
+        "bytes_per_label": bytes_per_label,
+        "salsa20_8_per_label": 4 * n * r * p,
+    }
+    if gbps is None:
+        gbps = float(os.environ.get("SPACEMESH_ROOFLINE_GBPS", "0") or 0)
+    if labels_per_sec:
+        out["achieved_gbps"] = round(
+            bytes_per_label * float(labels_per_sec) / 1e9, 3)
+    if gbps > 0:
+        out["roofline_gbps"] = gbps
+        out["roofline_labels_per_sec"] = round(gbps * 1e9
+                                               / bytes_per_label, 1)
+        if labels_per_sec:
+            out["utilization"] = round(out["achieved_gbps"] / gbps, 4)
+    return out
+
+
 def romix_benchmark(n: int, batch: int, reps: int = 2,
                     include_pallas: bool | None = None,
                     probe: bool = True) -> dict:
@@ -300,6 +335,22 @@ def romix_benchmark(n: int, batch: int, reps: int = 2,
             _log(f"{impl}: failed ({type(e).__name__}: {e})")
             continue
         total = expand_s + romix_s + finish_s
+        rate = round(batch / total, 1)
+        # roofline against the ROMix phase alone (the only stage that
+        # touches V): the PBKDF2 envelope would dilute the bandwidth
+        # number with compute that moves no scratch memory
+        roof = romix_roofline(n, labels_per_sec=batch / romix_s)
+        line = (f"{impl}: {roof['bytes_per_label']:,} B/label, "
+                f"{roof['salsa20_8_per_label']:,} salsa20/8 calls/label")
+        if "achieved_gbps" in roof:
+            line += f", {roof['achieved_gbps']} GB/s achieved"
+        if "utilization" in roof:
+            line += (f" = {roof['utilization'] * 100:.1f}% of "
+                     f"{roof['roofline_gbps']} GB/s roofline")
+        elif "achieved_gbps" in roof:
+            line += (" (set SPACEMESH_ROOFLINE_GBPS=<peak> for a "
+                     "utilization fraction)")
+        _log(line)
         rows.append({
             "impl": impl, "chunk": chunk, "interpret": interpret,
             "stages": {"expand_s": round(expand_s, 4),
@@ -307,7 +358,8 @@ def romix_benchmark(n: int, batch: int, reps: int = 2,
                        "mix_s": round(max(romix_s - fill_s, 0.0), 4),
                        "finish_s": round(finish_s, 4)},
             "romix_s": round(romix_s, 4),
-            "labels_per_sec": round(batch / total, 1),
+            "labels_per_sec": rate,
+            "roofline": roof,
         })
     return {"scrypt_n": n, "batch": batch,
             "decision": decision.as_json(), "impls": rows}
@@ -395,21 +447,54 @@ def verify_farm_benchmark(items: int = 256, probe: bool = True) -> dict:
     }
 
 
+def _drop_hint(warnings: list[str]) -> str | None:
+    """A loud, actionable capacity hint when any capture dropped spans.
+
+    Drops are the one failure mode that silently corrupts every number
+    in a timeline (self-time, queue-wait splits, link counts all become
+    lower bounds), so the hint has to be impossible to miss."""
+    if not warnings:
+        return None
+    lines = ["!" * 66]
+    lines += [f"!!! {w}" for w in warnings]
+    lines += [
+        "!!! Self-time, wait/work splits and link counts below are",
+        "!!! LOWER BOUNDS. Re-capture with a larger span ring:",
+        "!!!   scenario scripts:  \"trace_capacity\": <spans>",
+        "!!!   capture-from-boot: SPACEMESH_TRACE=<spans>",
+        "!!!   verifyd replicas:  /debug/trace/start?capacity=<spans>",
+        "!" * 66,
+    ]
+    return "\n".join(lines)
+
+
 def timeline_view(path: str, top: int = 20) -> dict:
-    """Digest a captured span trace (tools view over
+    """Digest one or more captured span traces (tools view over
     utils/tracing.summarize): validates the trace-event JSON first, so a
     truncated or hand-edited capture fails loudly, not with a nonsense
-    flame summary."""
+    flame summary. A comma-separated list of captures (one per process)
+    is merged into a single federated timeline via
+    tracing.merge_captures before summarizing."""
     from ..utils import tracing
 
-    with open(path, encoding="utf-8") as f:
-        doc = json.load(f)
-    tracing.validate(doc)
+    docs = []
+    for one in str(path).split(","):
+        one = one.strip()
+        if not one:
+            continue
+        with open(one, encoding="utf-8") as f:
+            docs.append(json.load(f))
+    doc = docs[0] if len(docs) == 1 else tracing.merge_captures(docs)
+    warnings = tracing.validate(doc)
     summary = tracing.summarize(doc, top=top)
     _log(tracing.render_summary(summary))
+    hint = _drop_hint(warnings)
+    if hint:
+        _log(hint)
     other = doc.get("otherData", {})
     return {
         "trace": path,
+        "merged": len(docs) > 1,
         "captured_spans": other.get("captured_spans"),
         "dropped_spans": other.get("dropped_spans"),
         **summary,
@@ -432,9 +517,22 @@ def flight_view(path: str, top: int = 10) -> dict:
     for name, ent in (doc["breached_slos"] or {}).items():
         lines.append(f"  breached SLO {name}: value={ent['value']} "
                      f"target={ent['target']} burn={ent['burn']}")
+    for name, ent in (doc["procs"] or {}).items():
+        lines.append(f"  proc {name}: {ent['spans']} spans"
+                     + ("  [CRASHED — retained snapshot]"
+                        if ent["crashed"] else ""))
     _log("\n".join(lines))
-    _log(tracing.render_summary(tracing.summarize(bundle["trace"],
-                                                  top=top)))
+    # render over the MERGED timeline (parent + every procs/ child),
+    # the same doc digest() summarized — not the parent capture alone
+    procs = bundle.get("procs") or {}
+    child = [ent["trace"] for _, ent in sorted(procs.items())
+             if ent.get("trace") is not None]
+    merged = bundle["trace"] if not child else \
+        tracing.merge_captures([bundle["trace"]] + child)
+    _log(tracing.render_summary(tracing.summarize(merged, top=top)))
+    hint = _drop_hint(doc.get("trace_warnings") or [])
+    if hint:
+        _log(hint)
     return doc
 
 
@@ -494,10 +592,12 @@ def main(argv=None) -> int:
                     help="batch sizes for --warm")
     ap.add_argument("--warm-prove", action="store_true",
                     help="--warm also compiles the prover's scan step")
-    ap.add_argument("--timeline", metavar="TRACE_JSON", default=None,
+    ap.add_argument("--timeline", metavar="TRACE_JSON[,TRACE_JSON...]",
+                    default=None,
                     help="summarize a span-trace export (top spans by "
                     "self-time, per-stage wait-vs-work split) instead of "
-                    "benchmarking")
+                    "benchmarking; a comma-separated list merges one "
+                    "capture per process into a federated timeline")
     ap.add_argument("--timeline-top", type=int, default=20,
                     help="rows in the --timeline self-time ranking")
     ap.add_argument("--flight", metavar="BUNDLE_DIR", default=None,

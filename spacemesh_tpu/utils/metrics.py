@@ -679,6 +679,16 @@ sim_shard_imbalance = REGISTRY.gauge(
     "sim_shard_imbalance_ratio",
     "(max - min) / max of events fired across shards at finalize — "
     "0 is a perfectly balanced partition")
+sim_shard_worker_stats = REGISTRY.gauge(
+    "sim_shard_worker_stat",
+    "WORKER-side event-wheel stats set in the worker's own registry "
+    "just before each federated snapshot ships (labels: shard, stat); "
+    "the parent re-exposes them under proc=shard-<k> via obs.federate")
+federated_procs = REGISTRY.gauge(
+    "federated_procs",
+    "processes with a live federated snapshot in obs.federate "
+    "(labels: state=live|crashed); crashed snapshots are retained "
+    "for forensics until explicitly dropped")
 
 # runtime sanitizers (utils/sanitize.py, SPACEMESH_SANITIZE=1): each
 # recorded violation — a slow event-loop callback, an off-thread

@@ -46,6 +46,18 @@ and its ``parent`` id; cross-cutting links that are not parent/child
 (a verify-farm batch and its member requests) are recorded as explicit
 ``args`` references (``batch``/``members``) — see docs/OBSERVABILITY.md
 for how to follow them in Perfetto.
+
+Fleet federation (docs/OBSERVABILITY.md § Fleet observability): each
+process declares an identity with ``set_process_identity(role)`` —
+exports then carry ``otherData["proc"]`` (role, pid, clock domain) and
+a Perfetto ``process_name`` metadata event. ``merge_captures()``
+combines N such exports into one ``validate()``-clean timeline: span
+ids are rewritten per capture so rings that each started counting at 1
+cannot collide, and a span recorded with a ``link`` arg holding a
+``"<role>/<id>"`` token (built by ``link_token()`` on the sending side
+and shipped with the cross-process request) gets its ``parent``
+resolved to the merged id of the remote span — the cross-process
+parent edges the single-process tracer could never draw.
 """
 
 from __future__ import annotations
@@ -68,6 +80,46 @@ def current_id() -> int | None:
     """The enclosing span's id (None when untraced/disabled) — for
     handing to long-lived worker threads as an explicit parent."""
     return _current.get()
+
+
+# --- process identity (fleet federation provenance) ---------------------
+#
+# One role per process: the sharded sim fabric stamps its workers
+# "shard-<k>", verifyd fleet replicas are "replica-<name>", the parent
+# defaults to "pid-<pid>". merge_captures() keys cross-process link
+# tokens and per-proc provenance on this role.
+
+_proc_identity = {"role": None, "clock_domain": "wall"}
+
+
+def set_process_identity(role: str, clock_domain: str = "wall") -> None:
+    """Declare this process's role label (``shard-3``, ``replica-r1``)
+    and clock domain (``wall`` perf_counter µs, or ``virtual`` for sim
+    wheels that timestamp spans in virtual time). Carried in every
+    export's ``otherData["proc"]`` and as a Perfetto ``process_name``."""
+    _proc_identity["role"] = str(role)
+    _proc_identity["clock_domain"] = str(clock_domain)
+
+
+def process_identity() -> dict:
+    """This process's federation identity (role defaults to pid-N)."""
+    return {
+        "role": _proc_identity["role"] or f"pid-{os.getpid()}",
+        "pid": os.getpid(),
+        "clock_domain": _proc_identity["clock_domain"],
+    }
+
+
+def link_token(span_id: int | None = None) -> str | None:
+    """A globally-unique token naming a span of THIS process —
+    ``"<role>/<id>"`` — for shipping with a cross-process request.
+    The receiving side records it as a ``link`` attr on its own span;
+    ``merge_captures()`` resolves it into a real parent edge. None when
+    untraced (callers ship nothing)."""
+    sid = span_id if span_id is not None else _current.get()
+    if sid is None:
+        return None
+    return f"{process_identity()['role']}/{sid}"
 
 
 class _NopSpan:
@@ -239,7 +291,9 @@ class Tracer:
         """The capture as a Chrome trace-event / Perfetto JSON object."""
         total = self._recorded
         pid = os.getpid()
-        events = []
+        proc = process_identity()
+        events = [{"name": "process_name", "ph": "M", "pid": pid,
+                   "tid": 0, "args": {"name": proc["role"]}}]
         for tid, tname in sorted(self._tid_names.items()):
             events.append({"name": "thread_name", "ph": "M", "pid": pid,
                            "tid": tid, "args": {"name": tname}})
@@ -267,6 +321,7 @@ class Tracer:
                 "dropped_spans": max(0, total - len(recs)),
                 "capacity": self.capacity,
                 "started_at_unix": self._started_at,
+                "proc": proc,
             },
         }
 
@@ -319,17 +374,148 @@ def export_json(path: str) -> dict:
     return doc
 
 
+# --- federation: merge N process captures into one timeline -------------
+
+# per-capture span-id offset: every process's ring counts ids from 1, so
+# without rewriting, shard-0's span 17 and replica-r2's span 17 would
+# alias in the merged args graph
+_MERGE_ID_STRIDE = 1 << 32
+
+
+def merge_captures(captures) -> dict:
+    """Combine N ``export()`` documents into ONE ``validate()``-clean
+    timeline with per-process provenance.
+
+    * Each capture gets a distinct merged ``pid`` (1..N) and a Perfetto
+      ``process_name`` metadata event naming its role, so the merged
+      file renders as per-process tracks and ``summarize()`` can build
+      per-proc columns.
+    * Span ``id``/``parent`` (and ``batch`` references) are rewritten
+      with a per-capture offset — rings that each count from 1 must not
+      collide in the merged graph.
+    * A span whose args carry a ``link`` token (``"<role>/<id>"``, see
+      ``link_token()``) gets its ``parent`` resolved to the merged id
+      of the remote span; resolved/unresolved counts land in
+      ``otherData["links"]`` — "zero unresolved" is the scenario-level
+      assertion that no cross-process edge dangled.
+    * Timed events are globally re-sorted by ``ts`` (validate requires
+      one monotonic stream; metadata events are emitted first).
+    """
+    meta_events: list[dict] = []
+    timed: list[tuple] = []  # (ts, seq, event) — seq keeps sort stable
+    procs: list[dict] = []
+    token_map: dict[str, int] = {}
+    captured = dropped = 0
+    seq = 0
+    for idx, doc in enumerate(captures):
+        off = (idx + 1) * _MERGE_ID_STRIDE
+        mpid = idx + 1
+        other = dict(doc.get("otherData") or {})
+        proc = dict(other.get("proc") or {})
+        role = str(proc.get("role") or f"proc-{idx}")
+        proc_entry = {
+            "role": role,
+            "pid": proc.get("pid"),
+            "merged_pid": mpid,
+            "clock_domain": proc.get("clock_domain", "wall"),
+            "captured_spans": int(other.get("captured_spans", 0)),
+            "dropped_spans": int(other.get("dropped_spans", 0)),
+        }
+        procs.append(proc_entry)
+        captured += proc_entry["captured_spans"]
+        dropped += proc_entry["dropped_spans"]
+        meta_events.append({"name": "process_name", "ph": "M",
+                            "pid": mpid, "tid": 0, "args": {"name": role}})
+        for ev in doc.get("traceEvents", ()):
+            ev = dict(ev)
+            ev["pid"] = mpid
+            args = ev.get("args")
+            if ev.get("ph") == "M":
+                if ev.get("name") == "process_name":
+                    continue  # replaced by the role-named one above
+                meta_events.append(ev)
+                continue
+            if args:
+                args = dict(args)
+                sid = args.get("id")
+                if sid is not None:
+                    token_map.setdefault(f"{role}/{sid}", sid + off)
+                    args["id"] = sid + off
+                for ref in ("parent", "batch"):
+                    if args.get(ref) is not None:
+                        args[ref] = args[ref] + off
+                if args.get("members"):
+                    args["members"] = [m + off for m in args["members"]]
+                ev["args"] = args
+            timed.append((ev.get("ts", 0), seq, ev))
+            seq += 1
+    resolved = unresolved = 0
+    for _, _, ev in timed:
+        args = ev.get("args")
+        tok = args.get("link") if args else None
+        if tok is None:
+            continue
+        target = token_map.get(tok)
+        if target is not None:
+            args["parent"] = target
+            resolved += 1
+        else:
+            unresolved += 1
+    timed.sort(key=lambda t: (t[0], t[1]))
+    return {
+        "traceEvents": meta_events + [ev for _, _, ev in timed],
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "tool": "spacemesh_tpu.utils.tracing",
+            "merged": True,
+            "captured_spans": captured,
+            "dropped_spans": dropped,
+            "procs": procs,
+            "links": {"resolved": resolved, "unresolved": unresolved},
+        },
+    }
+
+
+def span_multiset_digest(doc) -> str:
+    """sha256 over the merged capture's ``(proc role, span name, count)``
+    multiset — the replay-stable identity of a capture. Timestamps, span
+    ids and durations are wall/ordering artifacts and stay out; under
+    the sim's deterministic virtual clock the multiset is a pure
+    function of (seed, W), so same seed ⇒ byte-identical digest."""
+    import hashlib
+
+    roles = {}
+    for ev in doc.get("traceEvents", ()):
+        if ev.get("ph") == "M" and ev.get("name") == "process_name":
+            roles[ev["pid"]] = ev["args"]["name"]
+    counts: dict[tuple, int] = {}
+    for ev in doc.get("traceEvents", ()):
+        if ev.get("ph") in ("X", "i"):
+            key = (roles.get(ev["pid"], str(ev["pid"])), ev["name"])
+            counts[key] = counts.get(key, 0) + 1
+    h = hashlib.sha256()
+    for (role, name), n in sorted(counts.items()):
+        h.update(f"{role}\x00{name}\x00{n}\n".encode())
+    return h.hexdigest()
+
+
 # --- validation (tests + the CI trace-smoke job) ------------------------
 
 _PHASES = {"X", "B", "E", "i", "M", "s", "f"}
 _REQUIRED = ("name", "ph", "pid", "tid")
 
 
-def validate(doc) -> None:
+def validate(doc) -> list[str]:
     """Raise ValueError unless ``doc`` is structurally valid trace-event
     JSON: required keys present, known phases, non-negative monotonic
     ``ts`` within the stream, ``dur`` on complete (X) events, and
-    matched B/E pairs per (pid, tid) if any are used."""
+    matched B/E pairs per (pid, tid) if any are used.
+
+    Returns a list of non-fatal WARNINGS — today, ring-drop accounting:
+    a capture whose ring evicted spans is structurally fine but
+    analytically lossy (the storm-1024 silent-eviction class), so every
+    caller that prints gets told to raise ``trace_capacity`` /
+    ``SPACEMESH_TRACE=<N>`` / ``?capacity=``."""
     if not isinstance(doc, dict) or not isinstance(
             doc.get("traceEvents"), list):
         raise ValueError("trace document must be {'traceEvents': [...]}")
@@ -367,6 +553,32 @@ def validate(doc) -> None:
     for key, stack in stacks.items():
         if stack:
             raise ValueError(f"unclosed B events on {key}: {stack}")
+    return drop_warnings(doc)
+
+
+def drop_warnings(doc) -> list[str]:
+    """Ring-eviction warnings for a capture (or each proc of a merged
+    capture): non-empty means the timeline is missing spans and any
+    span-count assertion on it is suspect."""
+    other = doc.get("otherData") or {}
+    warnings = []
+    procs = other.get("procs")
+    if procs:
+        for p in procs:
+            if p.get("dropped_spans"):
+                warnings.append(
+                    f"proc {p.get('role')}: ring dropped "
+                    f"{p['dropped_spans']} spans — raise trace_capacity "
+                    f"(script) / SPACEMESH_TRACE=<capacity> / "
+                    f"?capacity= on /debug/trace/start")
+    elif other.get("dropped_spans"):
+        cap = other.get("capacity")
+        warnings.append(
+            f"ring dropped {other['dropped_spans']} spans"
+            f"{f' (capacity {cap})' if cap else ''} — raise "
+            f"trace_capacity (script) / SPACEMESH_TRACE=<capacity> / "
+            f"?capacity= on /debug/trace/start")
+    return warnings
 
 
 # --- text flame summary (tools/profiler.py --timeline) ------------------
@@ -379,13 +591,37 @@ def summarize(doc, top: int = 20) -> dict:
     nested child spans on the same thread) and a per-stage queue-wait vs
     work split. The stage is the span name's dotted prefix ("prove" for
     "prove.read_wait"); wait spans are named with one of
-    {wait, stall, queue, idle, block}."""
+    {wait, stall, queue, idle, block}.
+
+    Merged captures additionally digest per-PROCESS: a ``procs`` table
+    (spans + self-time per role — the SZKP "is every worker saturated"
+    column) and ``cross_proc_links`` counting parent edges that cross a
+    process boundary, keyed "parent_span->child_span" (e.g. the
+    ``farm.request->verifyd.request`` edges the fleet federation
+    resolves). ``warnings`` carries ring-drop accounting."""
+    proc_names: dict[int, str] = {}
+    for ev in doc.get("traceEvents", ()):
+        if ev.get("ph") == "M" and ev.get("name") == "process_name":
+            proc_names[ev["pid"]] = ev["args"]["name"]
     per_tid: dict[tuple, list] = {}
+    id_home: dict[int, tuple[int, str]] = {}  # span id -> (pid, name)
     for ev in doc.get("traceEvents", ()):
         if ev.get("ph") == "X":
             per_tid.setdefault((ev["pid"], ev["tid"]), []).append(ev)
+            sid = (ev.get("args") or {}).get("id")
+            if sid is not None:
+                id_home[sid] = (ev["pid"], ev["name"])
     totals: dict[str, dict] = {}
     stages: dict[str, dict] = {}
+    procs: dict[int, dict] = {}
+    link_pairs: dict[str, int] = {}
+    for evs in per_tid.values():
+        for ev in evs:
+            parent = (ev.get("args") or {}).get("parent")
+            home = id_home.get(parent)
+            if home is not None and home[0] != ev["pid"]:
+                pair = f"{home[1]}->{ev['name']}"
+                link_pairs[pair] = link_pairs.get(pair, 0) + 1
     for evs in per_tid.values():
         evs.sort(key=lambda e: (e["ts"], -e.get("dur", 0)))
         stack: list = []  # (end_ts, name, child_dur_acc as 1-item list)
@@ -409,6 +645,9 @@ def summarize(doc, top: int = 20) -> dict:
             t["count"] += 1
             t["total_us"] += dur
             t["self_us"] += self_us
+            p = procs.setdefault(ev["pid"], {"spans": 0, "self_us": 0})
+            p["spans"] += 1
+            p["self_us"] += self_us
             stage = name.split(".", 1)[0]
             s = stages.setdefault(stage, {"wait_us": 0, "work_us": 0})
             leaf = name.rsplit(".", 1)[-1]
@@ -417,6 +656,9 @@ def summarize(doc, top: int = 20) -> dict:
             else:
                 s["work_us"] += self_us
     ranked = sorted(totals.items(), key=lambda kv: -kv[1]["self_us"])
+    proc_rows = [
+        {"proc": proc_names.get(pid, str(pid)), **v}
+        for pid, v in sorted(procs.items())]
     return {
         "spans": len([1 for evs in per_tid.values() for _ in evs]),
         "top_self_time": [{"name": k, **v} for k, v in ranked[:top]],
@@ -425,6 +667,12 @@ def summarize(doc, top: int = 20) -> dict:
                                           / max(v["wait_us"] + v["work_us"],
                                                 1), 3)}
                    for k, v in sorted(stages.items())},
+        "procs": proc_rows,
+        "cross_proc_links": {
+            "total": sum(link_pairs.values()),
+            "pairs": dict(sorted(link_pairs.items())),
+        },
+        "warnings": drop_warnings(doc),
     }
 
 
@@ -442,6 +690,21 @@ def render_summary(summary: dict) -> str:
         lines.append(f"{stage:<12} {s['work_us'] / 1000:>10.2f} "
                      f"{s['wait_us'] / 1000:>10.2f} "
                      f"{100 * s['wait_frac']:>6.1f}%")
+    proc_rows = summary.get("procs") or []
+    if len(proc_rows) > 1:
+        lines.append("")
+        lines.append(f"{'proc':<24} {'spans':>8} {'self ms':>10}")
+        for row in proc_rows:
+            lines.append(f"{row['proc']:<24} {row['spans']:>8} "
+                         f"{row['self_us'] / 1000:>10.2f}")
+        links = summary.get("cross_proc_links") or {}
+        lines.append("")
+        lines.append(f"cross-process parent links: {links.get('total', 0)}")
+        for pair, n in (links.get("pairs") or {}).items():
+            lines.append(f"  {pair}: {n}")
+    for warn in summary.get("warnings") or ():
+        lines.append("")
+        lines.append(f"WARNING: {warn}")
     return "\n".join(lines)
 
 

@@ -205,6 +205,11 @@ class FleetRouter:
         metrics.fleet_replica_load.remove(replica=name)
         metrics.fleet_replica_verify_seconds.remove_matching(replica=name)
         metrics.fleet_replica_sheds.remove_matching(replica=name)
+        # federation cardinality hygiene: a replica that LEAVES takes
+        # its proc= series with it (crashed replicas are never
+        # unregistered here — their snapshots stay flagged)
+        from ..obs.federate import FEDERATION
+        FEDERATION.drop(f"replica-{name}")
         self.stats["replicas_removed"] += 1
         metrics.fleet_replicas.set(len(self.replicas))
         return moved
@@ -386,6 +391,54 @@ class FleetRouter:
                               if mean > 0 else self.min_replicas))
         metrics.fleet_desired_replicas.set(desired)
         return {"scores": scores, "desired_replicas": desired}
+
+    # -- fleet observability: the pull-and-merge plane -------------------
+
+    async def start_captures(self, *, capacity: int | None = None) -> dict:
+        """Start a span capture on every replica that exposes the
+        /debug/trace surface (endpoints without it — fakes, legacy —
+        are skipped). Returns {replica: start doc | None}."""
+        out: dict = {}
+        for name, rep in sorted(self.replicas.items()):
+            ep = rep.endpoint
+            if not hasattr(ep, "trace_start"):
+                continue
+            try:
+                out[name] = await ep.trace_start(
+                    capacity=capacity, role=f"replica-{name}")
+            except Exception:  # noqa: BLE001 — a dead replica is not news
+                out[name] = None
+        return out
+
+    async def pull_captures(self) -> dict:
+        """Pull every reachable replica's trace capture AND metrics
+        exposition into the federation under ``replica-<name>``;
+        returns {proc: capture doc} for the pulled captures. A replica
+        that cannot be scraped is skipped (its breaker already tells
+        that story) — federation only ever holds real snapshots."""
+        from ..obs.federate import FEDERATION
+
+        pulled: dict = {}
+        for name, rep in sorted(self.replicas.items()):
+            ep = rep.endpoint
+            if not hasattr(ep, "trace_export"):
+                continue
+            proc = f"replica-{name}"
+            try:
+                doc = await ep.trace_export()
+                text = await ep.metrics_text()
+            except Exception:  # noqa: BLE001 — unreachable replica
+                continue
+            FEDERATION.parse_and_update(proc, text, trace=doc)
+            pulled[proc] = doc
+        return pulled
+
+    def merged_capture(self, parent: dict | None = None) -> dict | None:
+        """One validate-clean timeline over the parent capture and every
+        federated replica capture (``tracing.merge_captures``)."""
+        from ..obs.federate import FEDERATION
+
+        return FEDERATION.merged_capture(parent=parent)
 
     # -- introspection ---------------------------------------------------
 
@@ -716,6 +769,12 @@ class HttpReplicaEndpoint:
                 "items": [protocol.request_to_doc(r) for r in reqs]}
         if deadline_s is not None:
             body["deadline_s"] = deadline_s
+        # ship this caller's span identity so the replica's
+        # verifyd.request span can parent to our fleet.remote span in
+        # the merged fleet timeline
+        token = tracing.link_token()
+        if token:
+            body["trace_parent"] = token
         doc = await self._post("/v1/verify", body)
         verdicts = doc.get("verdicts")
         if doc.get("status") != "OK" or not isinstance(verdicts, list):
@@ -726,6 +785,33 @@ class HttpReplicaEndpoint:
         sess = await self._sess()
         async with sess.get(self.base_url + "/v1/stats") as resp:
             return await resp.json()
+
+    # -- fleet observability pulls (server.py /debug/trace/*) ----------
+
+    async def trace_start(self, *, capacity: int | None = None,
+                          role: str | None = None) -> dict:
+        """Start (or restart) a capture on the replica, stamping its
+        process identity so the merged timeline shows provenance."""
+        q = []
+        if capacity is not None:
+            q.append(f"capacity={int(capacity)}")
+        if role:
+            q.append(f"role={role}")
+        sess = await self._sess()
+        url = (self.base_url + "/debug/trace/start"
+               + ("?" + "&".join(q) if q else ""))
+        async with sess.get(url) as resp:
+            return await resp.json()
+
+    async def trace_export(self) -> dict:
+        sess = await self._sess()
+        async with sess.get(self.base_url + "/debug/trace/export") as resp:
+            return await resp.json()
+
+    async def metrics_text(self) -> str:
+        sess = await self._sess()
+        async with sess.get(self.base_url + "/metrics") as resp:
+            return await resp.text()
 
     async def aclose(self) -> None:
         if self._own_session and self._session is not None:

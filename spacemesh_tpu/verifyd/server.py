@@ -107,6 +107,12 @@ class VerifydServer:
         r.add_get("/metrics", self.metrics)
         r.add_get("/healthz", self.healthz)
         r.add_get("/readyz", self.readyz)
+        # span-trace capture, same surface as api/http.py — this is what
+        # FleetRouter.pull_captures() scrapes to build the merged fleet
+        # timeline (docs/OBSERVABILITY.md § Fleet observability)
+        r.add_get("/debug/trace/start", self.trace_start)
+        r.add_get("/debug/trace/stop", self.trace_stop)
+        r.add_get("/debug/trace/export", self.trace_export)
 
     # -- lifecycle ------------------------------------------------------
 
@@ -207,8 +213,10 @@ class VerifydServer:
             except (TypeError, ValueError):
                 raise protocol.ProtocolError(
                     "deadline_s: expected a number") from None
-        verdicts = await self.service.verify(str(cid), reqs, lane=lane,
-                                             deadline_s=deadline)
+        trace_parent = body.get("trace_parent")
+        verdicts = await self.service.verify(
+            str(cid), reqs, lane=lane, deadline_s=deadline,
+            trace_parent=(str(trace_parent) if trace_parent else None))
         return {"status": "OK", "verdicts": [bool(v) for v in verdicts]}
 
     # -- HTTP handlers --------------------------------------------------
@@ -274,8 +282,59 @@ class VerifydServer:
 
     async def metrics(self, req) -> web.Response:
         del req
-        return web.Response(text=REGISTRY.expose(),
+        from ..obs.federate import FEDERATION
+
+        # local registry first, then every federated proc= series (a
+        # router replica also federating its own children re-exports
+        # them — provenance survives one hop)
+        return web.Response(text=REGISTRY.expose() + FEDERATION.expose(),
                             content_type="text/plain")
+
+    # -- span-trace capture (mirror of api/http.py; the fleet pull
+    # plane's scrape surface) ------------------------------------------
+
+    async def trace_start(self, req) -> web.Response:
+        from ..utils import metrics, tracing
+
+        try:
+            capacity = req.query.get("capacity")
+            capacity = int(capacity) if capacity else None
+        except ValueError:
+            raise web.HTTPBadRequest(text="capacity must be an integer")
+        role = req.query.get("role")
+        if role:
+            tracing.set_process_identity(role)
+        tracing.start(capacity=capacity, jax_bridge=False)
+        metrics.trace_enabled_gauge.set(1)
+        metrics.trace_spans_gauge.set(0)
+        return web.json_response({
+            "enabled": True,
+            "capacity": tracing.TRACER.capacity,
+            "role": tracing.process_identity()["role"],
+        })
+
+    async def trace_stop(self, req) -> web.Response:
+        from ..utils import metrics, tracing
+
+        retained = tracing.stop()
+        metrics.trace_enabled_gauge.set(0)
+        metrics.trace_spans_gauge.set(tracing.TRACER.recorded())
+        return web.json_response({
+            "enabled": False,
+            "spans_retained": retained,
+            "spans_recorded": tracing.TRACER.recorded(),
+        })
+
+    async def trace_export(self, req) -> web.Response:
+        del req
+        from ..utils import metrics, tracing
+
+        metrics.trace_spans_gauge.set(tracing.TRACER.recorded())
+        # a big ring materializes AND serializes slowly; do both off
+        # the loop (export() tolerates concurrent recording)
+        body = await asyncio.to_thread(
+            lambda: json.dumps(tracing.export()))
+        return web.Response(text=body, content_type="application/json")
 
     async def healthz(self, req) -> web.Response:
         del req

@@ -407,10 +407,14 @@ class VerifydService:
 
     async def verify(self, client_id: str, reqs: list,
                      lane: Lane = Lane.GOSSIP,
-                     deadline_s: float | None = None) -> list[bool]:
+                     deadline_s: float | None = None,
+                     trace_parent: str | None = None) -> list[bool]:
         """Admit one request (a list of farm request objects) and await
         its verdicts.  Raises :class:`Shed` (typed) on rejection and
         :class:`VerifydClosed` when the service shuts down mid-flight.
+        ``trace_parent`` is an opaque caller-side span link token
+        (``tracing.link_token()``); merge_captures() resolves it into a
+        cross-process parent edge on the ``verifyd.request`` span.
         """
         cid = str(client_id)
         self.stats["requests"] += 1
@@ -462,9 +466,11 @@ class VerifydService:
                            f"predicted wait {est:.3f}s exceeds "
                            f"deadline {deadline_s:.3f}s",
                            retry_after_s=est)
-        sp = tracing.span("verifyd.request",
-                          {"client": cid, "lane": lane.name.lower(),
-                           "n": n} if tracing.is_enabled() else None)
+        attrs = ({"client": cid, "lane": lane.name.lower(), "n": n}
+                 if tracing.is_enabled() else None)
+        if attrs is not None and trace_parent:
+            attrs["link"] = trace_parent
+        sp = tracing.span("verifyd.request", attrs)
         with sp:
             parent = sp.id if tracing.is_enabled() else None
             loop = asyncio.get_running_loop()

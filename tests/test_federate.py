@@ -1,0 +1,507 @@
+"""Fleet-wide observability federation (PR 20, obs/federate.py).
+
+The collection plane's contracts: the strict escape-aware exposition
+parser round-trips hostile label values; pipe-shipped Registry.sample()
+documents and HTTP-scraped exposition text federate to identical
+triples; ``proc=`` series obey strict cardinality hygiene (gone on
+drop, retained+flagged on crash); ``tracing.merge_captures`` produces
+ONE validate-clean timeline with per-process provenance and resolved
+cross-process parent links; and the two end-to-end planes — sim shard
+workers over pipes, verifyd replicas over HTTP — both land a merged
+timeline with ≥1 cross-process link and zero leaked series after a
+clean teardown. Plus the satellites that ride on the same machinery:
+span-drop accounting surfaced as a loud profiler hint, the romix
+roofline model, flight bundles' ``procs/`` subdir, and benchtrend's
+``--history`` trajectory view.
+"""
+
+import asyncio
+import json
+import os
+import subprocess
+import sys
+import threading
+
+import pytest
+
+from spacemesh_tpu.obs import flight as flight_mod
+from spacemesh_tpu.obs.federate import (FEDERATION, Federation,
+                                        flatten_samples, parse_exposition)
+from spacemesh_tpu.sim import builtin, run_scenario
+from spacemesh_tpu.sim.shard import ShardedMeshHub
+from spacemesh_tpu.utils import metrics, tracing
+from spacemesh_tpu.tools import benchtrend
+from spacemesh_tpu.tools.profiler import (_drop_hint, romix_roofline,
+                                          timeline_view)
+
+
+@pytest.fixture(autouse=True)
+def _clean_slate(monkeypatch):
+    """Every test starts with no federated procs, no live capture and
+    the default process identity (tests here mutate all three)."""
+    monkeypatch.delenv("SPACEMESH_SIM_SHARDS", raising=False)
+    FEDERATION.clear()
+    if tracing.is_enabled():
+        tracing.stop()
+    yield
+    FEDERATION.clear()
+    if tracing.is_enabled():
+        tracing.stop()
+    tracing.set_process_identity(f"pid-{os.getpid()}")
+
+
+# --- the strict exposition parser --------------------------------------
+
+
+def test_parser_roundtrips_escaped_label_values():
+    reg = metrics.Registry()
+    g = reg.gauge("nasty_gauge", "hostile label values")
+    hostile = 'quote " backslash \\ newline \n done'
+    g.set(2.5, peer=hostile, plain="ok")
+    series = parse_exposition(reg.expose())
+    match = [(lb, v) for name, lb, v in series if name == "nasty_gauge"]
+    assert match == [({"peer": hostile, "plain": "ok"}, 2.5)]
+
+
+def test_parser_rejects_garbage_lines():
+    for bad in ("not a metric", 'x{a="1} 2', 'x{a="1"} ',
+                'x{=""} 1', "x 1 2 3"):
+        with pytest.raises(ValueError):
+            parse_exposition(bad)
+    # comments and blanks are fine
+    assert parse_exposition("# HELP x y\n\n") == []
+
+
+def test_flatten_samples_matches_expose_histograms_included():
+    """Pipe-shipped (sample) and HTTP-shipped (exposition) snapshots of
+    the same registry must federate to the SAME triples — or a shard
+    worker and a verifyd replica would disagree about one metric."""
+    reg = metrics.Registry()
+    reg.counter("c_total", "c").inc(3, kind="a")
+    reg.gauge("g", "g").set(1.5)
+    h = reg.histogram("h_seconds", "h", buckets=(0.1, 1.0))
+    h.observe(0.05, lane="x")
+    h.observe(5.0, lane="x")
+
+    def key(triples):
+        return sorted((n, tuple(sorted(lb.items())), v)
+                      for n, lb, v in triples)
+
+    assert key(flatten_samples(reg.sample())) == \
+        key(parse_exposition(reg.expose()))
+
+
+# --- the Federation container ------------------------------------------
+
+
+def test_federation_proc_lifecycle_and_cardinality_hygiene():
+    fed = Federation()
+    fed.update("w1", [("up", {"x": "1"}, 1.0)], trace={"traceEvents": []})
+    fed.update("w2", [("up", {}, 1.0)])
+    text = fed.expose()
+    series = parse_exposition(text)
+    assert {lb.get("proc") for _, lb, _ in series} == {"w1", "w2"}
+    # clean exit: drop removes EVERY series for that proc
+    assert fed.drop("w1") is True
+    assert all(lb.get("proc") != "w1"
+               for _, lb, _ in parse_exposition(fed.expose()))
+    assert fed.trace("w1") is None
+    # crash: snapshot retained AND flagged for forensics
+    fed.mark_crashed("w2")
+    series = parse_exposition(fed.expose())
+    assert ("federated_proc_crashed", {"proc": "w2"}, 1.0) in series
+    assert any(n == "up" and lb.get("proc") == "w2"
+               for n, lb, _ in series)
+    # a re-update means the process is evidently alive again
+    fed.update("w2", [("up", {}, 2.0)])
+    assert not fed.procs()["w2"]["crashed"]
+    fed.clear()
+    assert fed.expose() == "" and fed.procs() == {}
+
+
+def test_federation_gauges_track_live_and_crashed():
+    fed = Federation()  # private instance still drives the global gauge
+    fed.update("a", [])
+    fed.update("b", [])
+    fed.mark_crashed("b")
+    sample = metrics.REGISTRY.sample()["federated_procs"][1]
+    assert sample[(("state", "live"),)] == 1.0
+    assert sample[(("state", "crashed"),)] == 1.0
+    fed.clear()
+
+
+# --- merge_captures: provenance + cross-process links -------------------
+
+
+def _two_process_captures():
+    """Two REAL captures taken sequentially from the one in-process
+    tracer, wearing different process identities — the child's span
+    links to the parent's via the parent's link token."""
+    tracing.set_process_identity("parent")
+    tracing.start(capacity=256, jax_bridge=False)
+    with tracing.span("request", {"n": 1}, cat="test"):
+        token = tracing.link_token()
+    parent_doc = tracing.export()
+    tracing.stop()
+
+    tracing.set_process_identity("child", clock_domain="wall")
+    tracing.start(capacity=256, jax_bridge=False)
+    with tracing.span("handle", {"link": token}, cat="test"):
+        pass
+    with tracing.span("orphan", {"link": "ghost/12345"}, cat="test"):
+        pass
+    child_doc = tracing.export()
+    tracing.stop()
+    return parent_doc, child_doc
+
+
+def test_merge_captures_resolves_links_and_stamps_provenance():
+    parent_doc, child_doc = _two_process_captures()
+    merged = tracing.merge_captures([parent_doc, child_doc])
+    assert tracing.validate(merged) == []
+    od = merged["otherData"]
+    assert [p["role"] for p in od["procs"]] == ["parent", "child"]
+    assert od["links"] == {"resolved": 1, "unresolved": 1}
+    # the resolved child span now parents into the parent's timeline
+    spans = [e for e in merged["traceEvents"] if e.get("ph") == "X"]
+    handle = next(e for e in spans if e["name"] == "handle")
+    request = next(e for e in spans if e["name"] == "request")
+    assert handle["args"]["parent"] == request["args"]["id"]
+    assert handle["pid"] != request["pid"]
+
+
+def test_merged_digest_is_a_span_multiset_not_a_timestamp_hash():
+    parent_doc, child_doc = _two_process_captures()
+    d1 = tracing.span_multiset_digest(
+        tracing.merge_captures([parent_doc, child_doc]))
+    d2 = tracing.span_multiset_digest(
+        tracing.merge_captures([parent_doc, child_doc]))
+    assert d1 == d2
+    # dropping the child changes the multiset, hence the digest
+    assert d1 != tracing.span_multiset_digest(
+        tracing.merge_captures([parent_doc]))
+
+
+def test_federation_merged_capture_orders_procs_deterministically():
+    parent_doc, child_doc = _two_process_captures()
+    fed = Federation()
+    fed.update("child", [], trace=child_doc)
+    merged = fed.merged_capture(parent=parent_doc)
+    assert [p["role"] for p in merged["otherData"]["procs"]] == \
+        ["parent", "child"]
+    assert fed.merged_capture() is not None
+    assert Federation().merged_capture() is None
+
+
+# --- satellite: drop accounting ends in a loud profiler hint ------------
+
+
+def test_span_drops_surface_in_validate_and_profiler_hint(tmp_path):
+    tracing.set_process_identity("droppy")
+    tracing.start(capacity=4, jax_bridge=False)
+    for i in range(32):
+        with tracing.span(f"s{i}", cat="test"):
+            pass
+    doc = tracing.export()
+    tracing.stop()
+    assert doc["otherData"]["dropped_spans"] > 0
+    warnings = tracing.validate(doc)
+    assert warnings and any("dropped" in w for w in warnings)
+    hint = _drop_hint(warnings)
+    assert "SPACEMESH_TRACE" in hint and "trace_capacity" in hint
+    assert "LOWER BOUNDS" in hint
+    # the timeline view returns the warnings and exits clean
+    p = tmp_path / "trace.json"
+    p.write_text(json.dumps(doc))
+    view = timeline_view(str(p), top=5)
+    assert view["warnings"] == warnings
+    assert _drop_hint([]) is None
+
+
+def test_timeline_view_merges_comma_separated_captures(tmp_path):
+    parent_doc, child_doc = _two_process_captures()
+    pa, ch = tmp_path / "parent.json", tmp_path / "child.json"
+    pa.write_text(json.dumps(parent_doc))
+    ch.write_text(json.dumps(child_doc))
+    view = timeline_view(f"{pa},{ch}", top=5)
+    assert view["merged"] is True
+    assert [p["proc"] for p in view["procs"]] == ["parent", "child"]
+    assert view["cross_proc_links"]["total"] == 1
+    assert "request->handle" in view["cross_proc_links"]["pairs"]
+
+
+# --- satellite: the romix roofline model --------------------------------
+
+
+def test_romix_roofline_traffic_and_compute_model(monkeypatch):
+    monkeypatch.delenv("SPACEMESH_ROOFLINE_GBPS", raising=False)
+    r = romix_roofline(8192)
+    # ROMix moves V twice (fill writes, mix reads): 2 * 128 * N bytes
+    assert r["bytes_per_label"] == 2 * 128 * 8192
+    # 2N BlockMix passes of 2r Salsa20/8 cores: 4N at r=1
+    assert r["salsa20_8_per_label"] == 4 * 8192
+    assert "utilization" not in r and "achieved_gbps" not in r
+    # r/p scale both linearly
+    r2 = romix_roofline(8192, r=2, p=2)
+    assert r2["bytes_per_label"] == 4 * r["bytes_per_label"]
+    assert r2["salsa20_8_per_label"] == 4 * r["salsa20_8_per_label"]
+
+    full = romix_roofline(8192, labels_per_sec=1000.0, gbps=50.0)
+    assert full["achieved_gbps"] == pytest.approx(
+        2 * 128 * 8192 * 1000.0 / 1e9, rel=1e-3)
+    assert full["utilization"] == pytest.approx(
+        full["achieved_gbps"] / 50.0, abs=1e-3)
+    assert full["roofline_labels_per_sec"] == pytest.approx(
+        50e9 / full["bytes_per_label"], rel=1e-3)
+    # the peak defaults from the environment
+    monkeypatch.setenv("SPACEMESH_ROOFLINE_GBPS", "10")
+    assert romix_roofline(8192)["roofline_gbps"] == 10.0
+
+
+# --- satellite: flight bundles grow a procs/ subdir ---------------------
+
+
+def test_flight_bundle_federates_procs_and_digests_merged(tmp_path):
+    parent_doc, child_doc = _two_process_captures()
+    FEDERATION.update("shard-1", [("up", {}, 1.0)], trace=child_doc)
+    FEDERATION.update("shard-2", [("up", {}, 1.0)])
+    FEDERATION.mark_crashed("shard-2")
+    rec = flight_mod.FlightRecorder(tmp_path / "spool", min_interval_s=0)
+    path = rec.dump("test:procs", force=True)
+    assert path is not None
+
+    bundle = flight_mod.read_bundle(path)
+    assert set(bundle["procs"]) == {"shard-1", "shard-2"}
+    assert bundle["procs"]["shard-1"]["trace"] is not None
+    assert not bundle["procs"]["shard-1"]["crashed"]
+    assert bundle["procs"]["shard-2"]["crashed"]
+    assert 'proc="shard-1"' in bundle["procs"]["shard-1"]["metrics"]
+
+    doc = flight_mod.digest(bundle)
+    assert doc["procs"]["shard-2"]["crashed"] is True
+    # the summary ran over the MERGED timeline: the child's spans show
+    # up under its own proc row
+    roles = {p["proc"] for p in doc["proc_self_time"]}
+    assert "child" in roles
+
+
+# --- satellite: benchtrend --history ------------------------------------
+
+
+def _bench_round(root, n, value, ratio):
+    line = json.dumps({"metric": f"post_init_labels_per_sec_n{n}",
+                       "value": value, "vs_baseline": ratio,
+                       "bit_identical": True})
+    (root / f"BENCH_r{n:02d}.json").write_text(
+        json.dumps({"n": n, "tail": line + "\n"}))
+
+
+def test_benchtrend_history_renders_trajectory_with_markers(tmp_path,
+                                                            capsys):
+    _bench_round(tmp_path, 1, 100.0, 2.0)
+    _bench_round(tmp_path, 2, 104.0, 2.1)
+    _bench_round(tmp_path, 3, 50.0, 1.0)   # >10% round-over-round drop
+    doc = benchtrend.history(str(tmp_path), drop=0.10)
+    assert doc["rounds"] == [1, 2, 3]
+    rows = doc["families"]["post_init_labels_per_sec"]
+    assert [r["round"] for r in rows] == [1, 2, 3]
+    assert rows[0]["regressed"] == [] and rows[1]["regressed"] == []
+    assert set(rows[2]["regressed"]) == {"value", "vs_baseline"}
+    text = benchtrend.render_history(doc)
+    assert "post_init_labels_per_sec" in text and " v" in text
+    # report-only: exits 0 even with regressions in the trajectory
+    assert benchtrend.main(["--history", "--root", str(tmp_path)]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["families"]
+
+
+def test_benchtrend_gate_still_requires_current():
+    with pytest.raises(SystemExit):
+        benchtrend.main(["--root", "/nonexistent"])
+
+
+# --- end-to-end: the sharded sim fabric federates over pipes ------------
+
+
+@pytest.fixture(scope="module")
+def sharded_runs(tmp_path_factory):
+    """The SAME seeded 2-worker smoke run twice: one pass proves the
+    federation plane, the pair proves merged-capture determinism. The
+    federation's state is snapshotted IMMEDIATELY after each run (the
+    autouse fixture clears it between tests)."""
+    out = []
+    for tag in ("a", "b"):
+        FEDERATION.clear()
+        script = builtin("smoke", light=6)
+        script["shards"] = 2
+        r = run_scenario(script, tmp=tmp_path_factory.mktemp(f"fed-{tag}"))
+        out.append((r, FEDERATION.expose(), dict(FEDERATION.procs())))
+    return out
+
+
+def test_sharded_run_merges_a_validate_clean_fleet_timeline(sharded_runs):
+    r, _, _ = sharded_runs[0]
+    assert r.ok, [a for a in r.asserts if not a["ok"]]
+    kinds = {a["kind"]: a for a in r.asserts}
+    assert kinds["trace_valid"]["ok"]
+    assert kinds["merged_procs"]["ok"], kinds["merged_procs"]
+    assert kinds["cross_proc_links"]["ok"], kinds["cross_proc_links"]
+    # proc= series were LIVE during the run (asserted in-engine, where
+    # the workers still exist)
+    assert kinds["proc_series_live"]["ok"], kinds["proc_series_live"]
+    mt = r.stats["merged_trace"]
+    assert mt["procs"] == 2
+    assert mt["links"]["unresolved"] == 0
+    assert mt["links"]["resolved"] >= 1
+    assert mt["warnings"] == []
+
+
+def test_sharded_run_leaks_zero_proc_series_after_finalize(sharded_runs):
+    r, expose_text, procs = sharded_runs[0]
+    assert r.ok
+    # strict parse over the federation's own post-run exposition:
+    # clean worker exits took every proc= series with them
+    assert parse_exposition(expose_text) == []
+    assert not any(p.startswith("shard-") for p in procs)
+
+
+def test_sharded_merged_capture_digest_is_deterministic(sharded_runs):
+    (a, _, _), (b, _, _) = sharded_runs
+    assert a.stats["merged_trace"]["digest"] == \
+        b.stats["merged_trace"]["digest"]
+    assert a.digest == b.digest
+
+
+def test_crashed_worker_snapshot_is_retained_for_forensics(
+        tmp_path, monkeypatch):
+    """Kill worker 0 mid-run: the typed failure carries the dead
+    worker's last federated snapshot, and the federation RETAINS its
+    proc= series flagged crashed (clean-exit hygiene must not eat the
+    forensics)."""
+    calls = {"n": 0}
+    orig = ShardedMeshHub._flush_and_run
+
+    def killer(self, need, upto, inclusive):
+        calls["n"] += 1
+        if calls["n"] == 5:
+            self._workers[0].proc.kill()
+        return orig(self, need, upto, inclusive)
+
+    monkeypatch.setattr(ShardedMeshHub, "_flush_and_run", killer)
+    script = builtin("smoke", light=6)
+    script["shards"] = 2
+    r = run_scenario(script, tmp=tmp_path)
+    assert not r.ok
+    crash = next(a for a in r.asserts if a["kind"] == "shard_worker")
+    assert crash["last_metrics"] and crash["last_spans"]
+    procs = FEDERATION.procs()
+    crashed = {p: e for p, e in procs.items() if e["crashed"]}
+    assert crashed, procs
+    assert all(p.startswith("shard-") for p in crashed)
+    assert "federated_proc_crashed" in FEDERATION.expose()
+
+
+# --- end-to-end: verifyd replicas federate over HTTP --------------------
+
+
+def _boot_replica():
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    p = subprocess.Popen(
+        [sys.executable, "-m", "spacemesh_tpu.verifyd",
+         "--listen", "127.0.0.1:0", "--workers", "2"],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+        text=True, env=env)
+    doc = {}
+
+    def read():
+        line = p.stdout.readline()
+        if line:
+            doc.update(json.loads(line))
+
+    t = threading.Thread(target=read, daemon=True)
+    t.start()
+    t.join(90)
+    if not doc:
+        p.kill()
+        raise RuntimeError("verifyd replica did not boot in 90s")
+    return p, "http://" + doc["listening"]
+
+
+def test_fleet_router_pulls_and_merges_replica_captures():
+    """The real thing, no fakes: two verifyd replicas in their OWN
+    processes, captures started over /debug/trace, verify traffic
+    carrying trace_parent link tokens, the router pulling trace +
+    /metrics into the federation, and ONE validate-clean merged
+    timeline with replica provenance and resolved cross-process
+    links. Unregistering a replica drops its proc= series."""
+    aiohttp = pytest.importorskip("aiohttp")  # noqa: F841
+    from spacemesh_tpu.verify.farm import PowRequest
+    from spacemesh_tpu.verifyd.fleet import (FleetRouter,
+                                             HttpReplicaEndpoint)
+
+    replicas = [_boot_replica() for _ in range(2)]
+
+    async def go():
+        tracing.set_process_identity("fleet-parent")
+        tracing.start(capacity=8192, jax_bridge=False)
+        router = FleetRouter(seed=1)
+        endpoints = []
+        try:
+            for i, (_, url) in enumerate(replicas):
+                ep = HttpReplicaEndpoint(url)
+                endpoints.append(ep)
+                router.register_replica(f"r{i}", ep, own_endpoint=True)
+            started = await router.start_captures(capacity=4096)
+            assert started == {
+                "r0": {"enabled": True, "capacity": 4096,
+                       "role": "replica-r0"},
+                "r1": {"enabled": True, "capacity": 4096,
+                       "role": "replica-r1"}}
+            req = PowRequest(challenge=b"\x01" * 32,
+                             node_id=b"\x02" * 32,
+                             difficulty=b"\xff" * 32, nonce=1)
+            for name, rep in sorted(router.replicas.items()):
+                await rep.endpoint.register(f"cli-{name}")
+                with tracing.span("fleet.remote", {"replica": name}):
+                    got = await rep.endpoint.verify(
+                        [req], client=f"cli-{name}")
+                assert len(got) == 1
+            pulled = await router.pull_captures()
+            assert set(pulled) == {"replica-r0", "replica-r1"}
+            for proc, doc in pulled.items():
+                assert doc["otherData"]["proc"]["role"] == proc
+
+            merged = router.merged_capture(parent=tracing.export())
+            assert tracing.validate(merged) == []
+            od = merged["otherData"]
+            assert [p["role"] for p in od["procs"]] == \
+                ["fleet-parent", "replica-r0", "replica-r1"]
+            assert od["links"]["unresolved"] == 0
+            assert od["links"]["resolved"] >= 2
+
+            # every replica's series re-exposed under proc= provenance
+            series = parse_exposition(FEDERATION.expose())
+            for proc in ("replica-r0", "replica-r1"):
+                assert any(lb.get("proc") == proc
+                           for _, lb, _ in series), proc
+            # a replica that LEAVES takes its proc= series with it
+            router.unregister_replica("r0")
+            assert "replica-r0" not in FEDERATION.procs()
+            assert all(lb.get("proc") != "replica-r0" for _, lb, _ in
+                       parse_exposition(FEDERATION.expose()))
+        finally:
+            tracing.stop()
+            for ep in endpoints:
+                await ep.aclose()
+            await router.aclose()
+
+    try:
+        asyncio.run(go())
+    finally:
+        for p, _ in replicas:
+            p.terminate()
+        for p, _ in replicas:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
